@@ -1,0 +1,112 @@
+"""Headline benchmark: ResNet-50 synthetic training throughput per chip.
+
+Mirrors the reference's measurement vehicle
+(``examples/pytorch_synthetic_benchmark.py:107-120``: img/sec mean over
+timed iterations of a synthetic-data training loop).  Baseline for
+``vs_baseline`` is the reference's published per-GPU throughput:
+1656.82 images/sec on 16 Pascal GPUs => 103.55 img/sec/GPU
+(``docs/benchmarks.rst:31-43``, BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BASELINE_IMG_SEC_PER_DEVICE = 1656.82 / 16.0
+
+
+def main():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet50
+    from horovod_tpu.parallel import make_mesh
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = make_mesh({"hvd": n}, devices=devices)
+
+    per_device_batch = 64
+    batch = per_device_batch * n
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+
+    rng = jax.random.PRNGKey(0)
+    x_host = np.random.RandomState(0).randn(
+        batch, 224, 224, 3).astype(np.float32)
+    y_host = np.random.RandomState(1).randint(0, 1000, (batch,))
+
+    variables = jax.jit(lambda r, x: model.init(r, x, train=True))(
+        rng, jnp.zeros((1, 224, 224, 3), jnp.float32))
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                   named_axes=("hvd",))
+    opt_state = opt.init(params)
+
+    def per_shard_step(params, batch_stats, opt_state, x, y):
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            one_hot = jax.nn.one_hot(y, 1000)
+            loss = -jnp.mean(
+                jnp.sum(jax.nn.log_softmax(logits) * one_hot, axis=-1))
+            return loss, updates["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_stats = jax.tree.map(
+            lambda s: jax.lax.pmean(s, "hvd"), new_stats)
+        updates, new_opt_state = opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_stats, new_opt_state, \
+            jax.lax.pmean(loss, "hvd")
+
+    step = jax.jit(shard_map(
+        per_shard_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P("hvd"), P("hvd")),
+        out_specs=(P(), P(), P(), P()),
+    ), donate_argnums=(0, 1, 2))
+
+    sharded = NamedSharding(mesh, P("hvd"))
+    x = jax.device_put(x_host, sharded)
+    y = jax.device_put(y_host, sharded)
+
+    # warmup + compile
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, x, y)
+    jax.block_until_ready(loss)
+
+    iters = 20
+    start = time.perf_counter()
+    for _ in range(iters):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, x, y)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+
+    img_sec = batch * iters / elapsed
+    img_sec_per_device = img_sec / n
+    print(json.dumps({
+        "metric": "resnet50_synthetic_img_sec_per_chip",
+        "value": round(img_sec_per_device, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_sec_per_device / BASELINE_IMG_SEC_PER_DEVICE,
+                             3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

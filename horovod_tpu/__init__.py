@@ -1,0 +1,61 @@
+"""horovod_tpu — a TPU-native distributed deep-learning training framework.
+
+Provides the capabilities of Horovod v0.19.2 (reference: /root/reference,
+``horovod/__init__.py``) re-designed TPU-first:
+
+- process/topology model: ``init()``, ``rank()``, ``size()``, ``local_rank()``,
+  ``local_size()``, ``cross_rank()``, ``cross_size()`` (reference:
+  ``horovod/common/basics.py:22``)
+- named asynchronous collectives with tensor fusion, response cache, timeline,
+  stall inspection and Join semantics (reference: ``horovod/common/operations.cc``)
+- the data plane is JAX/XLA collectives (``psum`` / ``all_gather`` /
+  ``ppermute``) compiled over a :class:`jax.sharding.Mesh` — ICI within a
+  slice, DCN across slices — instead of MPI/NCCL/Gloo.
+
+The top-level module exposes the JAX-native binding.  Framework bindings live
+in ``horovod_tpu.torch``, ``horovod_tpu.tensorflow`` (gated),
+``horovod_tpu.keras`` (gated) and ``horovod_tpu.mxnet`` (gated).
+"""
+
+__version__ = "0.1.0"
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mesh,
+    nccl_built,
+    mpi_built,
+    gloo_built,
+    xla_built,
+    mpi_enabled,
+    gloo_enabled,
+    xla_enabled,
+)
+from horovod_tpu.common.ops_enum import Average, Sum, Adasum  # noqa: F401
+from horovod_tpu.ops.eager import (  # noqa: F401
+    allreduce,
+    allreduce_async,
+    allgather,
+    allgather_async,
+    broadcast,
+    broadcast_async,
+    alltoall,
+    alltoall_async,
+    grouped_allreduce,
+    synchronize,
+    poll,
+    join,
+)
+from horovod_tpu.jax_api import (  # noqa: F401
+    DistributedOptimizer,
+    broadcast_parameters,
+    allreduce_gradients,
+)
+from horovod_tpu.common.compression import Compression  # noqa: F401

@@ -1,0 +1,238 @@
+"""Process model and global state: ``init`` / ``rank`` / ``size`` / ...
+
+The reference implements this as ctypes calls into the C core
+(``horovod/common/basics.py:22`` HorovodBasics over ``operations.cc:663-797``).
+Here the state is Python-owned; the native core (when built) plugs in as the
+controller implementation underneath.
+
+Two operating modes (see ``horovod_tpu/common/topology.py``):
+
+- **device-rank** (default): every addressable JAX device is a logical rank.
+  Per-rank user code runs on threads — ``run_parallel(fn)`` mirrors the
+  reference's test pattern of executing the same rank-parameterized function
+  on every rank.
+- **process-rank**: ``hvdrun`` wired the ``HVD_RANK``/... env contract; one
+  process per worker.
+"""
+
+import contextlib
+import threading
+
+from horovod_tpu.common import topology as topology_mod
+from horovod_tpu.common.config import Config
+from horovod_tpu.utils.logging import get_logger
+from horovod_tpu.utils.timeline import Timeline
+
+_state = None
+_state_lock = threading.Lock()
+_tls = threading.local()
+
+
+class _GlobalState:
+    def __init__(self, topology, devices, config, executor, controller,
+                 timeline):
+        self.topology = topology
+        self.devices = devices
+        self.config = config
+        self.executor = executor
+        self.controller = controller
+        self.timeline = timeline
+
+
+def init(comm=None, controller=None):
+    """Initialize horovod_tpu.
+
+    ``comm`` is accepted for API parity with the reference (an MPI
+    communicator there); passing a list of jax devices restricts the rank set
+    to those devices.
+    """
+    global _state
+    with _state_lock:
+        if _state is not None:
+            return
+        import jax  # deferred so env vars set before init still apply
+
+        config = Config.from_env()
+        if controller:
+            config.controller = controller
+
+        env_topology = topology_mod.from_env()
+        if env_topology is not None and env_topology.size > 1:
+            # process-rank mode: multi-process collectives arrive with the
+            # native TCP controller; topology queries work regardless.
+            topology = env_topology
+            devices = jax.local_devices()
+        elif isinstance(comm, (list, tuple)) and comm:
+            devices = list(comm)
+            topology = topology_mod.from_devices(devices, 0, 1)
+        else:
+            devices = jax.local_devices()
+            topology = topology_mod.from_devices(
+                devices, jax.process_index(), jax.process_count())
+
+        from horovod_tpu.ops.xla_executor import XlaExecutor
+        executor = XlaExecutor(devices)
+
+        timeline = Timeline(config.timeline_path,
+                            config.timeline_mark_cycles)
+
+        impl = None
+        if config.controller == "native":
+            try:
+                from horovod_tpu.ops.native_controller import NativeController
+                impl = NativeController(topology, executor, timeline, config)
+            except (ImportError, OSError) as exc:
+                get_logger().debug(
+                    "native core unavailable (%s); falling back to the "
+                    "python controller", exc)
+        if impl is None:
+            if topology.size > len(devices):
+                raise RuntimeError(
+                    f"topology spans {topology.size} ranks but only "
+                    f"{len(devices)} devices are addressable in this "
+                    f"process; multi-process collectives require the native "
+                    f"TCP controller (HVD_CONTROLLER=native under hvdrun)")
+            from horovod_tpu.ops.python_controller import PythonController
+            impl = PythonController(topology, executor, timeline, config)
+        impl.start()
+
+        _state = _GlobalState(topology, devices, config, executor, impl,
+                              timeline)
+
+
+def shutdown():
+    global _state
+    with _state_lock:
+        if _state is None:
+            return
+        _state.controller.shutdown()
+        _state.timeline.close()
+        _state = None
+
+
+def is_initialized() -> bool:
+    return _state is not None
+
+
+def _get_state() -> _GlobalState:
+    if _state is None:
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call hvd.init() first")
+    return _state
+
+
+# ----------------------------------------------------------- rank model -----
+@contextlib.contextmanager
+def rank_context(local_rank: int):
+    """Bind the calling thread to a logical rank (device-rank mode)."""
+    previous = getattr(_tls, "local_rank", None)
+    _tls.local_rank = local_rank
+    try:
+        yield
+    finally:
+        _tls.local_rank = previous
+
+
+def _current_local_rank() -> int:
+    return getattr(_tls, "local_rank", None) or 0
+
+
+def rank() -> int:
+    state = _get_state()
+    topo = state.topology
+    if topo.mode == "process":
+        return topo.rank
+    return topo.cross_rank * topo.local_size + _current_local_rank()
+
+
+def size() -> int:
+    return _get_state().topology.size
+
+
+def local_rank() -> int:
+    state = _get_state()
+    if state.topology.mode == "process":
+        return state.topology.local_rank
+    return _current_local_rank()
+
+
+def local_size() -> int:
+    return _get_state().topology.local_size
+
+
+def cross_rank() -> int:
+    return _get_state().topology.cross_rank
+
+
+def cross_size() -> int:
+    return _get_state().topology.cross_size
+
+
+def mesh():
+    """The 1-D jax Mesh over all logical ranks (axis name ``"hvd"``)."""
+    return _get_state().executor.mesh
+
+
+def run_parallel(fn, num_ranks=None):
+    """Run ``fn`` once per logical rank on separate threads and return the
+    per-rank results.  ``fn`` may take zero args or the rank as one arg.
+
+    This is the device-rank analog of the reference's "same script on every
+    rank" execution model (SURVEY §4): inside ``fn``, ``hvd.rank()`` etc.
+    reflect the calling thread's rank.
+    """
+    import inspect
+
+    state = _get_state()
+    n = num_ranks or state.topology.local_size
+    results = [None] * n
+    errors = [None] * n
+    wants_rank = len(inspect.signature(fn).parameters) >= 1
+
+    def worker(r):
+        with rank_context(r):
+            try:
+                results[r] = fn(r) if wants_rank else fn()
+            except BaseException as exc:  # noqa: BLE001 — reraised below
+                errors[r] = exc
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True,
+                                name=f"hvd-rank-{r}")
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for exc in errors:
+        if exc is not None:
+            raise exc
+    return results
+
+
+# ------------------------------------------------------ capability probes ---
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    return True
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def xla_enabled() -> bool:
+    return True

@@ -1,0 +1,55 @@
+"""Runtime configuration, resolved from env vars at ``hvd.init()`` time.
+
+Reference: knob parsing in ``horovod/common/operations.cc:404-500`` and
+``horovod/common/utils/env_parser.cc``.
+"""
+
+import dataclasses
+
+from horovod_tpu.utils import env as env_util
+
+
+@dataclasses.dataclass
+class Config:
+    fusion_threshold_bytes: int = env_util.DEFAULT_FUSION_THRESHOLD
+    cycle_time_ms: float = env_util.DEFAULT_CYCLE_TIME_MS
+    cache_capacity: int = env_util.DEFAULT_CACHE_CAPACITY
+    timeline_path: str | None = None
+    timeline_mark_cycles: bool = False
+    stall_check_disable: bool = False
+    stall_warning_seconds: float = env_util.DEFAULT_STALL_WARNING_SECONDS
+    stall_shutdown_seconds: float = 0.0
+    controller: str = "native"
+    autotune: bool = False
+    autotune_log: str | None = None
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        return cls(
+            fusion_threshold_bytes=env_util.get_int(
+                env_util.HVD_FUSION_THRESHOLD,
+                env_util.DEFAULT_FUSION_THRESHOLD),
+            cycle_time_ms=env_util.get_float(
+                env_util.HVD_CYCLE_TIME, env_util.DEFAULT_CYCLE_TIME_MS),
+            cache_capacity=env_util.get_int(
+                env_util.HVD_CACHE_CAPACITY, env_util.DEFAULT_CACHE_CAPACITY),
+            timeline_path=env_util.get_str(env_util.HVD_TIMELINE),
+            timeline_mark_cycles=env_util.get_bool(
+                env_util.HVD_TIMELINE_MARK_CYCLES),
+            stall_check_disable=env_util.get_bool(
+                env_util.HVD_STALL_CHECK_DISABLE),
+            stall_warning_seconds=env_util.get_float(
+                env_util.HVD_STALL_CHECK_TIME_SECONDS,
+                env_util.DEFAULT_STALL_WARNING_SECONDS),
+            stall_shutdown_seconds=env_util.get_float(
+                env_util.HVD_STALL_SHUTDOWN_TIME_SECONDS, 0.0),
+            controller=env_util.get_str(env_util.HVD_CONTROLLER, "native"),
+            autotune=env_util.get_bool(env_util.HVD_AUTOTUNE),
+            autotune_log=env_util.get_str(env_util.HVD_AUTOTUNE_LOG),
+            hierarchical_allreduce=env_util.get_bool(
+                env_util.HVD_HIERARCHICAL_ALLREDUCE),
+            hierarchical_allgather=env_util.get_bool(
+                env_util.HVD_HIERARCHICAL_ALLGATHER),
+        )

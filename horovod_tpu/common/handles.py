@@ -1,0 +1,81 @@
+"""Async-op handles.
+
+The reference exposes integer handles managed by a poll/wait map
+(``horovod/torch/handle_manager.{h,cc}``).  Core operations here return
+:class:`Handle` objects; the torch binding wraps them in integers for drop-in
+API fidelity.
+"""
+
+import threading
+
+
+class HvdError(RuntimeError):
+    """Raised when a collective fails (reference: Response::ERROR path)."""
+
+
+class Handle:
+    """Completion handle for one rank's view of one collective."""
+
+    __slots__ = ("_event", "_result", "_error", "name")
+
+    def __init__(self, name=""):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+        self.name = name
+
+    def set_result(self, result):
+        self._result = result
+        self._event.set()
+
+    def set_error(self, message):
+        self._error = HvdError(message)
+        self._event.set()
+
+    def poll(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"collective '{self.name}' did not complete within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class HandleManager:
+    """Integer-handle indirection used by the torch binding.
+
+    Mirrors ``horovod/torch/handle_manager.cc:47`` (AllocateHandle /
+    MarkDone via the underlying Handle / PollHandle / WaitForCompletion).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._handles = {}
+
+    def allocate(self, handle: Handle) -> int:
+        with self._lock:
+            idx = self._next
+            self._next += 1
+            self._handles[idx] = handle
+        return idx
+
+    def get(self, idx: int) -> Handle:
+        with self._lock:
+            if idx not in self._handles:
+                raise ValueError(f"unknown handle {idx}")
+            return self._handles[idx]
+
+    def poll(self, idx: int) -> bool:
+        return self.get(idx).poll()
+
+    def wait(self, idx: int, timeout=None):
+        handle = self.get(idx)
+        try:
+            return handle.wait(timeout)
+        finally:
+            with self._lock:
+                self._handles.pop(idx, None)

@@ -1,0 +1,42 @@
+"""Reduction-op enum shared by every binding.
+
+Mirrors the reference's ``ReduceOp`` surface (``horovod/torch/mpi_ops.py:60``:
+Average / Sum / Adasum) plus the internal request types
+(``horovod/common/message.h:47``).
+"""
+
+import enum
+
+
+class ReduceOp(enum.IntEnum):
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+
+
+class RequestType(enum.IntEnum):
+    """What a rank asks the coordinator for (reference: message.h RequestType)."""
+
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+
+
+class ResponseType(enum.IntEnum):
+    """What the coordinator tells ranks to run (reference: message.h ResponseType)."""
+
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+    ERROR = 6

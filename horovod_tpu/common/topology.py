@@ -1,0 +1,77 @@
+"""Process/device topology: the rank model.
+
+The reference derives rank / local_rank / cross_rank from MPI communicator
+splits (``horovod/common/mpi/mpi_context.cc:147-156``).  On TPU the natural
+analog is the pod-slice coordinate system:
+
+- ``rank``        — global logical worker id (one worker per chip)
+- ``local_rank``  — chip index within this host (reference: shared-memory comm)
+- ``cross_rank``  — host index (reference: cross communicator)
+
+Two operating modes:
+
+- **device-rank** (single-controller SPMD, the TPU-native default): one Python
+  process drives every addressable device; each device is one logical rank.
+  Eager collectives are issued from per-rank threads (see
+  ``horovod_tpu.common.basics.run_parallel``) and executed as XLA collectives
+  over the mesh.
+- **process-rank**: one process per worker, launched by ``hvdrun`` which wires
+  the ``HVD_RANK``/``HVD_SIZE``/... env contract exactly like the reference
+  launcher does (``horovod/run/gloo_run.py:152-157``).
+"""
+
+import dataclasses
+import os
+
+from horovod_tpu.utils import env as env_util
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    mode: str  # "device" | "process"
+
+    @property
+    def is_homogeneous(self) -> bool:
+        return self.size == self.local_size * self.cross_size
+
+
+def from_env() -> "Topology | None":
+    """Build topology from the hvdrun env contract, if present."""
+    if os.environ.get(env_util.HVD_RANK) is None:
+        return None
+    rank = env_util.get_int(env_util.HVD_RANK, 0)
+    size = env_util.get_int(env_util.HVD_SIZE, 1)
+    local_rank = env_util.get_int(env_util.HVD_LOCAL_RANK, rank)
+    local_size = env_util.get_int(env_util.HVD_LOCAL_SIZE, size)
+    cross_rank = env_util.get_int(env_util.HVD_CROSS_RANK, 0)
+    cross_size = env_util.get_int(env_util.HVD_CROSS_SIZE, 1)
+    return Topology(rank, size, local_rank, local_size, cross_rank, cross_size,
+                    mode="process")
+
+
+def from_devices(devices, process_index: int, process_count: int,
+                 this_rank: int = 0) -> Topology:
+    """Device-rank topology: every addressable device is a logical rank.
+
+    ``local_*`` is the within-process device axis; ``cross_*`` the process
+    (host) axis — mirroring the reference's LOCAL (shared-memory) and CROSS
+    communicators on pod-slice coordinates.
+    """
+    local_size = len(devices)
+    size = local_size * process_count
+    local_rank = this_rank % local_size
+    return Topology(
+        rank=process_index * local_size + local_rank,
+        size=size,
+        local_rank=local_rank,
+        local_size=local_size,
+        cross_rank=process_index,
+        cross_size=process_count,
+        mode="device",
+    )

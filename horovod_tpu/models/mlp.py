@@ -1,0 +1,19 @@
+"""Small MLP used by tests and the MNIST-style examples (the reference's
+``examples/pytorch_mnist.py`` analog)."""
+
+from typing import Sequence
+
+import flax.linen as nn
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 64, 10)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f)(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x

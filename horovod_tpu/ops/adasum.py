@@ -1,0 +1,97 @@
+"""Adasum: scale-invariant gradient combination.
+
+The reference implements Adasum as a recursive vector-halving
+distance-doubling (VHDD) allreduce in C++ (``horovod/common/ops/adasum/
+adasum.h:194-330``): at each level, ranks exchange half-buffers with
+``rank ^ level``, compute dot products and squared norms (allreduced over
+per-level reduction communicators) and combine
+
+    a' = (1 - a.b / (2 |a|^2)) * a  +  (1 - a.b / (2 |b|^2)) * b
+
+The TPU-native formulation keeps the same pairing tree (rank r pairs with
+r ^ 2^level) but expresses it as XLA ops inside the compiled step:
+``all_gather`` the per-rank contributions over the mesh axis, then reduce the
+leading axis pairwise.  XLA schedules the gather on ICI; the combine is pure
+VPU work.  (A ppermute-based VHDD variant — exchange halves, psum the
+dot/norm scalars — is the planned optimization for large tensors.)
+
+``adasum_reference`` is the numpy oracle used by the tests, mirroring the
+reference's pure-Python reference implementation in
+``test_adasum_pytorch.py``.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.common.compression import Compression
+
+
+def _pair_coefficients(dot, norm_a, norm_b):
+    """Safe Adasum pair coefficients; zero-norm operand contributes plain
+    addition (reference: adasum.h DispatchComputeDotAndNormSqrds guards)."""
+    a_coeff = jnp.where(norm_a > 0, 1.0 - dot / (2.0 * norm_a), 1.0)
+    b_coeff = jnp.where(norm_b > 0, 1.0 - dot / (2.0 * norm_b), 1.0)
+    return a_coeff, b_coeff
+
+
+def adasum_pair(a, b):
+    """Combine two same-shaped tensors with the Adasum formula."""
+    af = a.astype(jnp.float32).reshape(-1)
+    bf = b.astype(jnp.float32).reshape(-1)
+    dot = jnp.dot(af, bf)
+    norm_a = jnp.dot(af, af)
+    norm_b = jnp.dot(bf, bf)
+    a_coeff, b_coeff = _pair_coefficients(dot, norm_a, norm_b)
+    return (a_coeff * af + b_coeff * bf).reshape(a.shape).astype(a.dtype)
+
+
+def adasum_reduce_stacked(stacked):
+    """Reduce a [N, ...] stacked tensor along axis 0 with VHDD pairing
+    (rank r pairs with r ^ 2^level).  N must be a power of two."""
+    n = stacked.shape[0]
+    if n & (n - 1):
+        raise ValueError(f"Adasum requires a power-of-two rank count, got {n}")
+    level = stacked
+    while level.shape[0] > 1:
+        half = level.shape[0] // 2
+        pairs = level.reshape((half, 2) + level.shape[1:])
+        combined = jax.vmap(adasum_pair)(pairs[:, 0], pairs[:, 1])
+        level = combined
+    return level[0]
+
+
+def adasum_reduce_pytree(grads, named_axes=("hvd",), compression=None):
+    """SPMD Adasum: inside shard_map, gather contributions over the mesh
+    axes and tree-combine them.  Every rank computes the identical result."""
+    compression = compression or Compression.none
+    axis = named_axes if isinstance(named_axes, str) else tuple(named_axes)
+
+    def reduce_leaf(g):
+        compressed, ctx = compression.compress(g)
+        gathered = jax.lax.all_gather(compressed, axis)
+        reduced = adasum_reduce_stacked(gathered)
+        return compression.decompress(reduced, ctx)
+
+    return jax.tree.map(reduce_leaf, grads)
+
+
+def adasum_reference(tensors):
+    """Numpy oracle for tests: VHDD pairing over a list of per-rank numpy
+    arrays."""
+    level = [np.asarray(t, dtype=np.float64) for t in tensors]
+    if len(level) & (len(level) - 1):
+        raise ValueError("power-of-two rank count required")
+    while len(level) > 1:
+        combined = []
+        for i in range(0, len(level), 2):
+            a, b = level[i].reshape(-1), level[i + 1].reshape(-1)
+            dot = float(a @ b)
+            norm_a = float(a @ a)
+            norm_b = float(b @ b)
+            a_coeff = 1.0 - dot / (2.0 * norm_a) if norm_a > 0 else 1.0
+            b_coeff = 1.0 - dot / (2.0 * norm_b) if norm_b > 0 else 1.0
+            combined.append(
+                (a_coeff * a + b_coeff * b).reshape(level[i].shape))
+        level = combined
+    return level[0]

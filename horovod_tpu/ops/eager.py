@@ -1,0 +1,140 @@
+"""Public eager collective API: named asynchronous tensor operations.
+
+Mirrors the reference's op surface (``horovod/torch/mpi_ops.py``: sync/async
+pairs, auto-generated names, Average/Sum/Adasum ops, prescale/postscale,
+``synchronize``/``poll``, ``join``), executed through the controller +
+XLA data plane instead of MPI/NCCL.
+"""
+
+import threading
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.handles import Handle
+from horovod_tpu.common.ops_enum import Adasum, Average, ReduceOp, RequestType, Sum
+from horovod_tpu.ops.python_controller import EagerRequest
+
+_tls = threading.local()
+
+
+def _auto_name(kind: str) -> str:
+    """Per-rank sequence-numbered names, matching across ranks when call
+    order matches (reference: handle-derived names in mpi_ops.py)."""
+    counters = getattr(_tls, "counters", None)
+    if counters is None:
+        counters = _tls.counters = {}
+    n = counters.get(kind, 0)
+    counters[kind] = n + 1
+    return f"{kind}.noname.{n}"
+
+
+def _resolve_op(op, average):
+    """Reference semantics (torch/mpi_ops.py:94-129): exactly one of op /
+    average may be set; default is Average."""
+    if op is not None and average is not None:
+        raise ValueError("cannot specify both op and average")
+    if op is None:
+        op = Average if average in (None, True) else Sum
+    return ReduceOp(op)
+
+
+def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
+            prescale_factor=1.0, postscale_factor=1.0, splits=None) -> Handle:
+    state = basics._get_state()
+    committed = state.executor.commit(tensor, basics.local_rank()) \
+        if tensor is not None else None
+    handle = Handle(name)
+    state.controller.enqueue(EagerRequest(
+        rank=basics.rank(), req_type=req_type, name=name, tensor=committed,
+        handle=handle, op=op, root_rank=root_rank,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        splits=splits))
+    return handle
+
+
+# ------------------------------------------------------------- allreduce ----
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0) -> Handle:
+    op = _resolve_op(op, average)
+    req_type = RequestType.ADASUM if op == Adasum else RequestType.ALLREDUCE
+    return _submit(req_type, tensor, name or _auto_name("allreduce"),
+                   op=op, prescale_factor=prescale_factor,
+                   postscale_factor=postscale_factor)
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0):
+    return synchronize(allreduce_async(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor))
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None):
+    """Allreduce a list of tensors as one negotiation group; fusion batches
+    them into single XLA programs."""
+    base = name or _auto_name("grouped_allreduce")
+    handles = [
+        allreduce_async(t, average=average, name=f"{base}.{i}", op=op)
+        for i, t in enumerate(tensors)
+    ]
+    return [synchronize(h) for h in handles]
+
+
+# ------------------------------------------------------------- allgather ----
+def allgather_async(tensor, name=None) -> Handle:
+    return _submit(RequestType.ALLGATHER, tensor,
+                   name or _auto_name("allgather"))
+
+
+def allgather(tensor, name=None):
+    return synchronize(allgather_async(tensor, name=name))
+
+
+# ------------------------------------------------------------- broadcast ----
+def broadcast_async(tensor, root_rank, name=None) -> Handle:
+    return _submit(RequestType.BROADCAST, tensor,
+                   name or _auto_name("broadcast"), root_rank=root_rank)
+
+
+def broadcast(tensor, root_rank, name=None):
+    return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+
+# -------------------------------------------------------------- alltoall ----
+def alltoall_async(tensor, splits=None, name=None) -> Handle:
+    if splits is None:
+        n = basics.size()
+        dim0 = int(tensor.shape[0])
+        if dim0 % n != 0:
+            raise ValueError(
+                f"alltoall without explicit splits requires the first "
+                f"dimension ({dim0}) to be divisible by size ({n})")
+        splits = [dim0 // n] * n
+    return _submit(RequestType.ALLTOALL, tensor,
+                   name or _auto_name("alltoall"), splits=list(splits))
+
+
+def alltoall(tensor, splits=None, name=None):
+    result, _ = synchronize(alltoall_async(tensor, splits=splits, name=name))
+    return result
+
+
+# ------------------------------------------------------------ completion ----
+def synchronize(handle: Handle, timeout=None):
+    """Block until the async op completes and return its result
+    (reference: mpi_ops.synchronize / HandleManager.WaitForCompletion)."""
+    return handle.wait(timeout)
+
+
+def poll(handle: Handle) -> bool:
+    return handle.poll()
+
+
+def join() -> int:
+    """Signal that this rank has no more data; outstanding allreduces from
+    other ranks proceed with zero stand-ins from this rank.  Blocks until
+    every rank has joined and returns the last rank to join (reference:
+    torch/mpi_ops_v2.cc:240 DoJoin, controller.cc joined handling)."""
+    state = basics._get_state()
+    handle = Handle("join")
+    state.controller.join(basics.rank(), handle)
+    return handle.wait()

@@ -1,0 +1,403 @@
+"""Pure-Python coordination loop (fallback / reference controller).
+
+Implements the reference's coordinator protocol (``horovod/common/
+controller.cc:62`` ComputeResponseList) for the single-process device-rank
+mode: per-rank threads enqueue named requests; a background coordination
+thread counts readiness across ranks, validates agreement, fuses compatible
+allreduces into buckets and dispatches them to the XLA executor.  The
+negotiation that costs the reference 1-2 network round-trips per cycle
+(MPI_Gatherv + MPI_Bcast) is process-local here; in multi-process mode the
+native TCP controller plays that role.
+
+Also hosts the reference's auxiliary semantics:
+
+- **Join** (``controller.cc:219-221,263-273``): joined ranks stop
+  contributing; allreduces proceed with zero stand-ins; the join handle
+  completes when every rank has joined.
+- **StallInspector** (``stall_inspector.cc``): warn when some ranks submitted
+  a tensor and others didn't for longer than the stall window; optionally
+  shut down.
+- **Timeline** phases NEGOTIATE_* / op activities.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from horovod_tpu.common.ops_enum import ReduceOp, RequestType
+from horovod_tpu.utils.logging import get_logger
+
+
+@dataclasses.dataclass
+class EagerRequest:
+    rank: int
+    req_type: RequestType
+    name: str
+    tensor: object  # committed jax.Array (None for join)
+    handle: object
+    op: ReduceOp = ReduceOp.SUM
+    root_rank: int = -1
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+    splits: list | None = None
+
+
+class _NameEntry:
+    __slots__ = ("first_ts", "req_type", "requests", "stall_warned")
+
+    def __init__(self, req_type):
+        self.first_ts = time.monotonic()
+        self.req_type = req_type
+        self.requests = {}
+        self.stall_warned = False
+
+
+class GroupEntry:
+    """One named tensor inside a fused response — the executor's unit of
+    work (reference: TensorTableEntry, common.h:233-250)."""
+
+    __slots__ = ("name", "shape", "dtype", "tensors", "handles", "root_rank",
+                 "splits", "op", "prescale_factor", "postscale_factor")
+
+    def __init__(self, name, shape, dtype, tensors, handles, root_rank=-1,
+                 splits=None, op=ReduceOp.SUM, prescale_factor=1.0,
+                 postscale_factor=1.0):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+        self.tensors = tensors
+        self.handles = handles
+        self.root_rank = root_rank
+        self.splits = splits
+        self.op = op
+        self.prescale_factor = prescale_factor
+        self.postscale_factor = postscale_factor
+
+
+class PythonController:
+    def __init__(self, topology, executor, timeline, config):
+        self._topo = topology
+        self._executor = executor
+        self._timeline = timeline
+        self._config = config
+        self._size = topology.size
+        self._lock = threading.Lock()
+        self._wakeup = threading.Event()
+        self._queue = []
+        self._table = {}  # name -> _NameEntry, insertion-ordered
+        self._joined = set()
+        self._joined_view = set()  # per-cycle snapshot, coordinator-only
+        self._join_handles = {}
+        self._running = False
+        self._shutdown_error = None
+        self._thread = None
+        self._log = get_logger()
+
+    # ----------------------------------------------------------- producer API
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-coordinator")
+        self._thread.start()
+
+    def enqueue(self, request: EagerRequest):
+        with self._lock:
+            if not self._running:
+                request.handle.set_error("horovod_tpu has been shut down")
+                return
+            if self._shutdown_error is not None:
+                request.handle.set_error(self._shutdown_error)
+                return
+            self._queue.append(request)
+        self._wakeup.set()
+
+    def join(self, rank, handle):
+        with self._lock:
+            self._joined.add(rank)
+            self._join_handles[rank] = handle
+        self._wakeup.set()
+
+    def shutdown(self):
+        with self._lock:
+            self._running = False
+        self._wakeup.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        with self._lock:
+            for request in self._queue:
+                request.handle.set_error("horovod_tpu has been shut down")
+            self._queue.clear()
+            for entry in self._table.values():
+                for request in entry.requests.values():
+                    request.handle.set_error(
+                        "horovod_tpu has been shut down")
+            self._table.clear()
+
+    # ------------------------------------------------------- coordinator loop
+    def _loop(self):
+        cycle_s = self._config.cycle_time_ms / 1000.0
+        while True:
+            self._wakeup.wait(timeout=cycle_s)
+            self._wakeup.clear()
+            with self._lock:
+                if not self._running:
+                    return
+                pending, self._queue = self._queue, []
+            self._timeline.mark_cycle()
+            try:
+                self._run_cycle(pending)
+            except Exception as exc:  # noqa: BLE001 — never kill the loop
+                self._log.error("coordinator cycle failed: %s", exc)
+                self._fail_all(str(exc))
+
+    def _fail_all(self, message):
+        for entry in self._table.values():
+            for request in entry.requests.values():
+                request.handle.set_error(message)
+        self._table.clear()
+
+    def _run_cycle(self, pending):
+        # snapshot joined state once per cycle (rank threads mutate it under
+        # the lock; iterating the live set would race)
+        with self._lock:
+            self._joined_view = set(self._joined)
+
+        # 1. absorb new requests into the message table
+        for request in pending:
+            entry = self._table.get(request.name)
+            if entry is None:
+                entry = _NameEntry(request.req_type)
+                self._table[request.name] = entry
+                self._timeline.begin(
+                    request.name, f"NEGOTIATE_{request.req_type.name}")
+            if request.rank in entry.requests:
+                request.handle.set_error(
+                    f"duplicate request for tensor '{request.name}' from "
+                    f"rank {request.rank} before previous one completed")
+                continue
+            entry.requests[request.rank] = request
+            self._timeline.instant(request.name, f"{request.rank}")
+
+        # 2. stall inspection
+        if not self._config.stall_check_disable:
+            self._check_stalls()
+
+        # 3. collect ready responses in deterministic (arrival) order
+        ready_names = []
+        needed = set(range(self._size)) - self._joined_view
+        for name, entry in self._table.items():
+            if needed.issubset(entry.requests.keys()):
+                ready_names.append(name)
+
+        responses = []
+        for name in ready_names:
+            entry = self._table.pop(name)
+            self._timeline.end(name)
+            group = self._construct_response(name, entry)
+            if group is not None:
+                responses.append((entry.req_type, group))
+
+        # 4. fuse + dispatch
+        self._dispatch(responses)
+
+        # 5. join barrier: everyone joined -> complete join handles with the
+        # last rank to join (dict preserves join-call order)
+        with self._lock:
+            if self._joined and len(self._joined) == self._size \
+                    and not self._table and not self._queue:
+                last = next(reversed(self._join_handles))
+                for handle in self._join_handles.values():
+                    handle.set_result(last)
+                self._join_handles.clear()
+                self._joined.clear()
+
+    # ------------------------------------------------------------- validation
+    def _construct_response(self, name, entry):
+        """Validate cross-rank agreement (reference: controller.cc:378
+        ConstructResponse) and build a GroupEntry, or error every handle."""
+        requests = entry.requests
+
+        def error(message):
+            for request in requests.values():
+                request.handle.set_error(message)
+            return None
+
+        types = {r.req_type for r in requests.values()}
+        if len(types) > 1:
+            return error(
+                f"mismatched collective types for tensor '{name}': "
+                f"{sorted(t.name for t in types)}")
+        req_type = entry.req_type
+
+        if self._joined_view and req_type in (RequestType.ALLGATHER,
+                                              RequestType.BROADCAST,
+                                              RequestType.ALLTOALL):
+            return error(
+                f"{req_type.name} is not supported while ranks have joined")
+
+        dtypes = {np.dtype(r.tensor.dtype).name for r in requests.values()
+                  if r.tensor is not None}
+        if len(dtypes) > 1:
+            return error(
+                f"mismatched dtypes for tensor '{name}': {sorted(dtypes)}")
+
+        any_req = next(iter(requests.values()))
+        shape = tuple(any_req.tensor.shape)
+        dtype = any_req.tensor.dtype
+
+        if req_type in (RequestType.ALLREDUCE, RequestType.ADASUM):
+            ops = {r.op for r in requests.values()}
+            if len(ops) > 1:
+                return error(f"mismatched reduce ops for tensor '{name}'")
+            pre = {r.prescale_factor for r in requests.values()}
+            post = {r.postscale_factor for r in requests.values()}
+            if len(pre) > 1 or len(post) > 1:
+                return error(f"mismatched scale factors for tensor '{name}'")
+            shapes = {tuple(r.tensor.shape) for r in requests.values()}
+            if len(shapes) > 1:
+                return error(
+                    f"mismatched shapes for allreduce '{name}': "
+                    f"{sorted(shapes)}")
+        elif req_type == RequestType.ALLGATHER:
+            ndims = {r.tensor.ndim for r in requests.values()}
+            if len(ndims) > 1:
+                return error(
+                    f"mismatched tensor ranks for allgather '{name}'")
+            if 0 in ndims:
+                return error(
+                    f"allgather '{name}': 0-d tensors are not supported; "
+                    f"reshape to (1,) first")
+            trailing = {tuple(r.tensor.shape[1:]) for r in requests.values()}
+            if len(trailing) > 1:
+                return error(
+                    f"mismatched trailing dimensions for allgather '{name}'")
+        elif req_type == RequestType.BROADCAST:
+            roots = {r.root_rank for r in requests.values()}
+            if len(roots) > 1:
+                return error(
+                    f"mismatched root ranks for broadcast '{name}'")
+            shapes = {tuple(r.tensor.shape) for r in requests.values()}
+            if len(shapes) > 1:
+                return error(
+                    f"mismatched shapes for broadcast '{name}'")
+        elif req_type == RequestType.ALLTOALL:
+            for r in requests.values():
+                if len(r.splits) != self._size:
+                    return error(
+                        f"alltoall '{name}': splits must have one entry per "
+                        f"rank ({self._size}), got {len(r.splits)}")
+                if sum(r.splits) != r.tensor.shape[0]:
+                    return error(
+                        f"alltoall '{name}': splits sum "
+                        f"{sum(r.splits)} != first dimension "
+                        f"{r.tensor.shape[0]}")
+
+        tensors = {rank: r.tensor for rank, r in requests.items()}
+        for joined_rank in self._joined_view:
+            tensors.setdefault(joined_rank, None)
+        handles = {rank: r.handle for rank, r in requests.items()}
+        return GroupEntry(
+            name=name, shape=shape, dtype=dtype, tensors=tensors,
+            handles=handles, root_rank=any_req.root_rank,
+            splits={rank: r.splits for rank, r in requests.items()},
+            op=any_req.op, prescale_factor=any_req.prescale_factor,
+            postscale_factor=any_req.postscale_factor)
+
+    # ----------------------------------------------------------------- fusion
+    def _dispatch(self, responses):
+        """Fuse compatible allreduces into <= fusion_threshold buckets
+        (reference: controller.cc:640 FuseResponses) and execute."""
+        fusion_bytes = self._config.fusion_threshold_bytes
+        bucket = []
+        bucket_key = None
+        bucket_bytes = 0
+
+        def safe(execute, groups):
+            try:
+                execute()
+            except Exception as exc:  # noqa: BLE001 — surface on handles
+                self._log.error("collective execution failed: %s", exc)
+                for g in groups:
+                    for handle in g.handles.values():
+                        handle.set_error(f"collective execution failed: {exc}")
+
+        def flush():
+            nonlocal bucket, bucket_bytes, bucket_key
+            if bucket:
+                groups = bucket
+                safe(lambda: self._execute_allreduce_bucket(groups), groups)
+                bucket, bucket_bytes, bucket_key = [], 0, None
+
+        for req_type, group in responses:
+            if req_type == RequestType.ALLREDUCE:
+                itemsize = np.dtype(group.dtype).itemsize
+                nbytes = itemsize * int(np.prod(group.shape or (1,)))
+                key = (np.dtype(group.dtype).name, int(group.op),
+                       group.prescale_factor, group.postscale_factor)
+                if bucket and (key != bucket_key
+                               or bucket_bytes + nbytes > fusion_bytes):
+                    flush()
+                bucket.append(group)
+                bucket_key = key
+                bucket_bytes += nbytes
+            else:
+                flush()
+                safe(lambda: self._execute_single(req_type, group), [group])
+        flush()
+
+    def _execute_allreduce_bucket(self, groups):
+        first = groups[0]
+        self._timeline_begin_groups(groups, "ALLREDUCE")
+        self._executor.allreduce_fused(
+            groups, op=first.op,
+            prescale_factor=first.prescale_factor,
+            postscale_factor=first.postscale_factor)
+        self._timeline_end_groups(groups)
+
+    def _execute_single(self, req_type, group):
+        self._timeline_begin_groups([group], req_type.name)
+        if req_type == RequestType.ALLGATHER:
+            self._executor.allgather(group)
+        elif req_type == RequestType.BROADCAST:
+            self._executor.broadcast(group)
+        elif req_type == RequestType.ALLTOALL:
+            self._executor.alltoall(group)
+        elif req_type == RequestType.ADASUM:
+            self._executor.adasum(group)
+        self._timeline_end_groups([group])
+
+    def _timeline_begin_groups(self, groups, phase):
+        for g in groups:
+            self._timeline.begin(g.name, phase)
+
+    def _timeline_end_groups(self, groups):
+        for g in groups:
+            self._timeline.end(g.name)
+
+    # ------------------------------------------------------------------ stall
+    def _check_stalls(self):
+        now = time.monotonic()
+        warn_after = self._config.stall_warning_seconds
+        shutdown_after = self._config.stall_shutdown_seconds
+        for name, entry in list(self._table.items()):
+            age = now - entry.first_ts
+            if age > warn_after and not entry.stall_warned:
+                ready = sorted(entry.requests.keys())
+                missing = sorted(set(range(self._size))
+                                 - set(ready) - self._joined_view)
+                self._log.warning(
+                    "One or more tensors were submitted to be reduced, "
+                    "gathered or broadcasted by subset of ranks and are "
+                    "waiting for remainder of ranks for more than %ds. "
+                    "Stalled tensor: %s ready ranks: %s, waiting on: %s",
+                    int(warn_after), name, ready, missing)
+                entry.stall_warned = True
+            if shutdown_after > 0 and age > shutdown_after:
+                message = (f"stalled tensor '{name}' exceeded shutdown "
+                           f"threshold of {shutdown_after}s")
+                self._log.error(message)
+                self._shutdown_error = message
+                self._fail_all(message)
+                return

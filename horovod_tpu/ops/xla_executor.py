@@ -1,0 +1,291 @@
+"""XLA collective executor — the TPU data plane.
+
+This is the TPU-native replacement for the reference's collective backends
+(``horovod/common/ops/{nccl,mpi,gloo}_operations.cc``): fused groups built by
+the controller are staged into a stacked, mesh-sharded ``jax.Array`` (the
+fusion buffer) and executed by ONE compiled XLA program per steady-state
+signature — ``lax.psum`` / ``lax.all_gather`` over the ``hvd`` mesh axis rides
+ICI within a slice and DCN across slices.
+
+Design notes (vs the reference):
+
+- The reference caches NCCL communicators and reuses a persistent 64 MB fusion
+  buffer (``fusion_buffer_manager.cc``).  Here the analogous steady-state
+  object is the **compiled executable**: programs are memoized by fused-group
+  signature (op, dtype, shapes, scale factors), so a training loop's recurring
+  gradient buckets hit the XLA executable cache after the first step — the
+  ResponseCache idea (``response_cache.cc``) mapped onto the compilation model.
+- Fusion-buffer "memcpy in/out" (``collective_operations.cc:44``) becomes a
+  per-rank jitted concat/split running on that rank's device; XLA fuses the
+  reshape/cast/scale into the collective program.
+- GPU ready-events + finalizer threads (``gpu_operations.h:92``) are
+  unnecessary: JAX's async dispatch returns immediately and consumers block
+  only when they touch the result.
+"""
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.5 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from horovod_tpu.common.ops_enum import ReduceOp
+
+AXIS = "hvd"
+
+
+def _shard_map_gathered(body, mesh, in_specs, out_specs):
+    """shard_map whose body returns an all-gathered (hence device-invariant,
+    but not statically-inferrable-as-replicated) value."""
+    try:
+        return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:  # older jax spells it check_rep
+        return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
+
+def _prod(shape):
+    return int(math.prod(shape)) if shape else 1
+
+
+class XlaExecutor:
+    """Executes fused collective groups as compiled XLA programs over a 1-D
+    device mesh whose axis enumerates logical ranks."""
+
+    def __init__(self, devices):
+        self.devices = list(devices)
+        self.num_ranks = len(self.devices)
+        self.mesh = Mesh(np.array(self.devices), (AXIS,))
+        self._sharded = NamedSharding(self.mesh, P(AXIS))
+        # caches are touched only from the coordinator thread
+        self._fuse_in_cache = {}
+        self._allreduce_cache = {}
+        self._allgather_cache = {}
+
+    # ------------------------------------------------------------------ utils
+    def commit(self, tensor, rank):
+        """Pin a rank's tensor to its device (no-op if already there)."""
+        dev = self.devices[rank]
+        if isinstance(tensor, jax.Array):
+            try:
+                if tensor.devices() == {dev}:
+                    return tensor
+            except Exception:  # noqa: BLE001 — fall through to device_put
+                pass
+        return jax.device_put(tensor, dev)
+
+    def _shard_for(self, replicated, rank):
+        """Zero-copy view of a replicated array's shard on rank's device."""
+        dev = self.devices[rank]
+        for shard in replicated.addressable_shards:
+            if shard.device == dev:
+                return shard.data
+        raise RuntimeError(f"no addressable shard on {dev}")
+
+    def _stack(self, per_rank_bufs, shard_shape, dtype):
+        global_shape = (self.num_ranks,) + tuple(shard_shape[1:])
+        return jax.make_array_from_single_device_arrays(
+            global_shape, self._sharded, per_rank_bufs)
+
+    # ------------------------------------------------------- fusion buffer in
+    def _fuse_in(self, tensors, sizes, dtype):
+        """Concat one rank's tensors into a flat [1, total] buffer on its
+        device (reference: MemcpyInFusionBuffer)."""
+        key = (tuple(sizes), np.dtype(dtype).name)
+        fn = self._fuse_in_cache.get(key)
+        if fn is None:
+            def fuse(*ts):
+                return jnp.concatenate(
+                    [t.reshape(-1) for t in ts]).reshape(1, -1)
+            fn = jax.jit(fuse)
+            self._fuse_in_cache[key] = fn
+        return fn(*tensors)
+
+    def _zeros_buf(self, total, dtype, rank):
+        """Zero stand-in buffer for a joined rank (reference:
+        tensor_queue.cc GetTensorEntriesFromResponse joined path)."""
+        return jax.device_put(np.zeros((1, total), dtype=dtype),
+                              self.devices[rank])
+
+    # -------------------------------------------------------------- allreduce
+    def allreduce_fused(self, entries, op, prescale_factor, postscale_factor):
+        """Execute a fused allreduce group.
+
+        ``entries`` is a list of group entries with ``.shape``, ``.dtype``,
+        ``.tensors`` (rank -> committed array, or None for joined ranks) and
+        ``.handles`` (rank -> Handle).  All entries share one dtype.
+        """
+        shapes = tuple(tuple(e.shape) for e in entries)
+        sizes = [_prod(s) for s in shapes]
+        total = sum(sizes)
+        dtype = entries[0].dtype
+
+        bufs = []
+        for rank in range(self.num_ranks):
+            tensors = [e.tensors.get(rank) for e in entries]
+            if any(t is None for t in tensors):
+                bufs.append(self._zeros_buf(total, dtype, rank))
+            else:
+                bufs.append(self._fuse_in(tensors, sizes, dtype))
+        garr = self._stack(bufs, (1, total), dtype)
+
+        key = (shapes, np.dtype(dtype).name, int(op),
+               float(prescale_factor), float(postscale_factor))
+        fn = self._allreduce_cache.get(key)
+        if fn is None:
+            num_ranks = self.num_ranks
+
+            def body(shard):  # shard: [1, total] on one rank
+                x = shard
+                if prescale_factor != 1.0:
+                    x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+                return jax.lax.psum(x, AXIS)
+
+            def fused(g):
+                red = _shard_map(body, mesh=self.mesh, in_specs=P(AXIS),
+                                 out_specs=P())(g)
+                flat = red.reshape(-1)
+                if op == ReduceOp.AVERAGE:
+                    flat = flat / jnp.asarray(num_ranks, dtype=flat.dtype)
+                if postscale_factor != 1.0:
+                    flat = flat * jnp.asarray(postscale_factor,
+                                              dtype=flat.dtype)
+                outs = []
+                offset = 0
+                for size, shape in zip(sizes, shapes):
+                    outs.append(
+                        jax.lax.slice(flat, (offset,),
+                                      (offset + size,)).reshape(shape))
+                    offset += size
+                return tuple(outs)
+
+            fn = jax.jit(fused, donate_argnums=0)
+            self._allreduce_cache[key] = fn
+
+        outs = fn(garr)
+        for entry, out in zip(entries, outs):
+            for rank, handle in entry.handles.items():
+                handle.set_result(self._shard_for(out, rank))
+
+    # -------------------------------------------------------------- allgather
+    def allgather(self, entry):
+        """Allgather with per-rank variable first dimension (reference:
+        controller.cc:453-518 computes recvcounts/displacements; here the
+        compiled program pads to max(dim0), all-gathers over the mesh and
+        concatenates the valid rows)."""
+        shapes = tuple(tuple(entry.tensors[r].shape)
+                       for r in range(self.num_ranks))
+        dtype = entry.dtype
+        dims0 = [s[0] if s else 1 for s in shapes]
+        rest = shapes[0][1:]
+        max0 = max(dims0)
+
+        key = (shapes, np.dtype(dtype).name)
+        fn = self._allgather_cache.get(key)
+        if fn is None:
+            def pad(t, n0=max0):
+                padded = jnp.zeros((1, n0) + t.shape[1:], dtype=t.dtype)
+                return jax.lax.dynamic_update_slice(
+                    padded, t[None], (0,) * (t.ndim + 1))
+
+            def body(shard):  # [1, max0, *rest]
+                return jax.lax.all_gather(shard[0], AXIS)  # [N, max0, *rest]
+
+            def gather(g):
+                full = _shard_map_gathered(body, self.mesh, P(AXIS), P())(g)
+                parts = [jax.lax.slice_in_dim(full[i], 0, dims0[i], axis=0)
+                         for i in range(self.num_ranks)]
+                return jnp.concatenate(parts, axis=0)
+
+            fn = (jax.jit(pad), jax.jit(gather, donate_argnums=0))
+            self._allgather_cache[key] = fn
+
+        pad_fn, gather_fn = fn
+        bufs = [pad_fn(entry.tensors[r]) for r in range(self.num_ranks)]
+        garr = self._stack(bufs, (1, max0) + rest, dtype)
+        out = gather_fn(garr)
+        for rank, handle in entry.handles.items():
+            handle.set_result(self._shard_for(out, rank))
+
+    # -------------------------------------------------------------- broadcast
+    def broadcast(self, entry):
+        """Replicate the root rank's tensor to every rank's device via an XLA
+        transfer (reference: MPIBroadcast / NCCLBroadcast)."""
+        src = entry.tensors[entry.root_rank]
+        replicated = jax.device_put(src, NamedSharding(self.mesh, P()))
+        for rank, handle in entry.handles.items():
+            handle.set_result(self._shard_for(replicated, rank))
+
+    # ----------------------------------------------------------------- adasum
+    def adasum(self, entry):
+        """Adasum reduction of one named tensor (reference:
+        AdasumMPIAllreduceOp / AdasumGpuAllreduceOp).  Zero stand-ins from
+        joined ranks fall out naturally: a zero-norm operand contributes
+        plain addition."""
+        from horovod_tpu.ops.adasum import adasum_reduce_stacked
+
+        shape = tuple(entry.shape)
+        total = _prod(shape)
+        dtype = entry.dtype
+        bufs = []
+        for rank in range(self.num_ranks):
+            t = entry.tensors.get(rank)
+            if t is None:
+                bufs.append(self._zeros_buf(total, dtype, rank))
+            else:
+                bufs.append(self._fuse_in([t], [total], dtype))
+        garr = self._stack(bufs, (1, total), dtype)
+
+        key = ("adasum", shape, np.dtype(dtype).name)
+        fn = self._allreduce_cache.get(key)
+        if fn is None:
+            def fused(g):
+                def body(shard):
+                    gathered = jax.lax.all_gather(shard[0], AXIS)
+                    return adasum_reduce_stacked(gathered)
+                return _shard_map_gathered(
+                    body, self.mesh, P(AXIS), P())(g).reshape(shape)
+
+            fn = jax.jit(fused, donate_argnums=0)
+            self._allreduce_cache[key] = fn
+
+        out = fn(garr)
+        for rank, handle in entry.handles.items():
+            handle.set_result(self._shard_for(out, rank))
+
+    # --------------------------------------------------------------- alltoall
+    def alltoall(self, entry):
+        """Variable-split all-to-all (API parity with later reference
+        versions; also the Ulysses sequence-parallel primitive).
+
+        Host-orchestrated v1: splits differ per rank so there is no single
+        static program; each destination concatenates its segments on its own
+        device.
+        """
+        num_ranks = self.num_ranks
+        offsets = {}
+        for src in range(num_ranks):
+            splits = entry.splits[src]
+            off, offs = 0, []
+            for n in splits:
+                offs.append((off, n))
+                off += n
+            offsets[src] = offs
+
+        for dst in range(num_ranks):
+            pieces = []
+            for src in range(num_ranks):
+                off, n = offsets[src][dst]
+                piece = jax.lax.slice_in_dim(entry.tensors[src], off, off + n,
+                                             axis=0)
+                pieces.append(jax.device_put(piece, self.devices[dst]))
+            out = jnp.concatenate(pieces, axis=0)
+            recv_splits = [offsets[src][dst][1] for src in range(num_ranks)]
+            entry.handles[dst].set_result((out, recv_splits))

@@ -1,0 +1,6 @@
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    data_parallel_mesh,
+    hierarchical_mesh,
+    MeshAxes,
+)

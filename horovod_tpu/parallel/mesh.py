@@ -1,0 +1,95 @@
+"""Device-mesh construction: the TPU topology model.
+
+The reference's process topology is rank / local_rank / cross_rank over
+GLOBAL / LOCAL / CROSS MPI communicators (``horovod/common/mpi/
+mpi_context.cc:147-156``).  On TPU the analog is a ``jax.sharding.Mesh``
+whose axes map onto the interconnect hierarchy: in-slice axes ride ICI,
+the cross-slice axis rides DCN.  All parallelism in this framework is
+expressed as sharding over these named axes.
+
+Canonical axis names (used by ``horovod_tpu.parallel`` and the models):
+
+- ``dp``     data parallelism (gradient psum; the reference's only strategy)
+- ``fsdp``   fully-sharded data parallelism (params sharded over dp axis)
+- ``tp``     tensor parallelism (matmul sharding)
+- ``pp``     pipeline parallelism (layer sharding)
+- ``sp``     sequence/context parallelism (ring attention / Ulysses)
+- ``ep``     expert parallelism (MoE)
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxes:
+    DP = "dp"
+    FSDP = "fsdp"
+    TP = "tp"
+    PP = "pp"
+    SP = "sp"
+    EP = "ep"
+    HVD = "hvd"  # the flat rank axis used by the eager collective path
+
+
+def make_mesh(axis_shapes=None, *, devices=None) -> Mesh:
+    """Build a mesh from ``{axis_name: size}``; one axis may be -1 to absorb
+    the remaining devices (like a reshape).
+
+    ``make_mesh()`` returns the flat data-parallel mesh over all devices.
+    Axis order follows insertion order of ``axis_shapes`` — put the
+    fastest-communicating axis (tp/sp) last so it lands on adjacent ICI
+    neighbors.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if not axis_shapes:
+        axis_shapes = {MeshAxes.DP: n}
+    names = list(axis_shapes.keys())
+    sizes = list(axis_shapes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = math.prod(s for s in sizes if s != -1)
+    if -1 in sizes:
+        if n % known != 0:
+            raise ValueError(
+                f"{n} devices not divisible by fixed axes product {known}")
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} need "
+            f"{math.prod(sizes)} devices, have {n}")
+    array = np.array(devices).reshape(sizes)
+    return Mesh(array, tuple(names))
+
+
+def data_parallel_mesh(devices=None) -> Mesh:
+    return make_mesh({MeshAxes.DP: -1}, devices=devices)
+
+
+def hierarchical_mesh(local_size=None, devices=None) -> Mesh:
+    """2-D (cross, local) mesh mirroring the reference's hierarchical
+    allreduce topology (``nccl_operations.cc:162-289``): reduce-scatter over
+    ``local`` (ICI), allreduce over ``cross`` (DCN), allgather over
+    ``local``."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if local_size is None:
+        # devices on the same host share .process_index
+        per_proc = {}
+        for d in devices:
+            per_proc.setdefault(d.process_index, []).append(d)
+        local_size = len(next(iter(per_proc.values())))
+    if len(devices) % local_size != 0:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by local_size "
+            f"{local_size}")
+    return make_mesh({"cross": len(devices) // local_size,
+                      "local": local_size}, devices=devices)

@@ -1,0 +1,81 @@
+"""Environment-variable configuration surface.
+
+Mirrors the reference's knob list (``horovod/common/common.h:61-88`` and
+``horovod/common/utils/env_parser.cc``) under the ``HVD_`` prefix, with
+TPU-appropriate defaults.  The launcher additionally exposes every knob as an
+``hvdrun`` CLI flag and a YAML config-file key, keeping the reference's
+tri-surface config model.
+"""
+
+import os
+
+# --- knob names (reference: horovod/common/common.h:61-88) -------------------
+HVD_FUSION_THRESHOLD = "HVD_FUSION_THRESHOLD"          # bytes, default 64 MB
+HVD_CYCLE_TIME = "HVD_CYCLE_TIME"                      # ms, default 1.0
+HVD_CACHE_CAPACITY = "HVD_CACHE_CAPACITY"              # default 1024
+HVD_TIMELINE = "HVD_TIMELINE"                          # path -> enable timeline
+HVD_TIMELINE_MARK_CYCLES = "HVD_TIMELINE_MARK_CYCLES"
+HVD_STALL_CHECK_DISABLE = "HVD_STALL_CHECK_DISABLE"
+HVD_STALL_CHECK_TIME_SECONDS = "HVD_STALL_CHECK_TIME_SECONDS"
+HVD_STALL_SHUTDOWN_TIME_SECONDS = "HVD_STALL_SHUTDOWN_TIME_SECONDS"
+HVD_HIERARCHICAL_ALLREDUCE = "HVD_HIERARCHICAL_ALLREDUCE"
+HVD_HIERARCHICAL_ALLGATHER = "HVD_HIERARCHICAL_ALLGATHER"
+HVD_AUTOTUNE = "HVD_AUTOTUNE"
+HVD_AUTOTUNE_LOG = "HVD_AUTOTUNE_LOG"
+HVD_AUTOTUNE_WARMUP_SAMPLES = "HVD_AUTOTUNE_WARMUP_SAMPLES"
+HVD_AUTOTUNE_STEADY_STATE_SAMPLES = "HVD_AUTOTUNE_STEADY_STATE_SAMPLES"
+HVD_LOG_LEVEL = "HVD_LOG_LEVEL"
+HVD_LOG_HIDE_TIME = "HVD_LOG_HIDE_TIME"
+HVD_CONTROLLER = "HVD_CONTROLLER"                      # native | python | tcp
+HVD_CPU_OPERATIONS = "HVD_CPU_OPERATIONS"              # xla | ring | python
+HVD_ADASUM_CHUNK_SIZE = "HVD_ADASUM_CHUNK_SIZE"
+HVD_NUM_STREAMS = "HVD_NUM_STREAMS"
+
+# --- launcher -> worker contract (reference: gloo_run.py:152-157,261-273) ----
+HVD_RANK = "HVD_RANK"
+HVD_SIZE = "HVD_SIZE"
+HVD_LOCAL_RANK = "HVD_LOCAL_RANK"
+HVD_LOCAL_SIZE = "HVD_LOCAL_SIZE"
+HVD_CROSS_RANK = "HVD_CROSS_RANK"
+HVD_CROSS_SIZE = "HVD_CROSS_SIZE"
+HVD_RENDEZVOUS_ADDR = "HVD_RENDEZVOUS_ADDR"
+HVD_RENDEZVOUS_PORT = "HVD_RENDEZVOUS_PORT"
+HVD_CONTROLLER_ADDR = "HVD_CONTROLLER_ADDR"
+HVD_IFACE = "HVD_IFACE"
+
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
+DEFAULT_CYCLE_TIME_MS = 1.0
+DEFAULT_CACHE_CAPACITY = 1024
+DEFAULT_STALL_WARNING_SECONDS = 60
+
+
+def get_int(name, default=0):
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    try:
+        return int(value)
+    except ValueError:
+        return default
+
+
+def get_float(name, default=0.0):
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    try:
+        return float(value)
+    except ValueError:
+        return default
+
+
+def get_bool(name, default=False):
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    return value.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_str(name, default=None):
+    value = os.environ.get(name)
+    return default if value in (None, "") else value

@@ -1,0 +1,42 @@
+"""Leveled logging, the Python face of the core's logger.
+
+Mirrors the reference's glog-style macros (``horovod/common/logging.h``):
+levels TRACE/DEBUG/INFO/WARNING/ERROR/FATAL selected by ``HVD_LOG_LEVEL``,
+timestamps suppressible with ``HVD_LOG_HIDE_TIME``.
+"""
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+logging.addLevelName(5, "TRACE")
+
+_logger = None
+
+
+def get_logger():
+    global _logger
+    if _logger is not None:
+        return _logger
+    logger = logging.getLogger("horovod_tpu")
+    level_name = os.environ.get("HVD_LOG_LEVEL", "warning").strip().lower()
+    logger.setLevel(_LEVELS.get(level_name, logging.WARNING))
+    handler = logging.StreamHandler(sys.stderr)
+    if os.environ.get("HVD_LOG_HIDE_TIME", "").lower() in ("1", "true"):
+        fmt = "[%(levelname)s] %(message)s"
+    else:
+        fmt = "%(asctime)s [%(levelname)s] %(message)s"
+    handler.setFormatter(logging.Formatter(fmt))
+    logger.addHandler(handler)
+    logger.propagate = False
+    _logger = logger
+    return logger

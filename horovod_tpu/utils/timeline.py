@@ -1,0 +1,126 @@
+"""Chrome-tracing timeline for per-tensor collective lifecycles.
+
+Mirrors the reference Timeline (``horovod/common/timeline.{h,cc}``): enabled
+by ``HVD_TIMELINE=<file>``, one trace row (pid) per tensor name, phases
+NEGOTIATE_<OP> (with per-rank ready ticks) → QUEUE → <OP> with nested
+activities (fusion-buffer staging, XLA dispatch), ending with an output-size
+annotation.  A dedicated writer thread drains an unbounded queue so the hot
+path never blocks on file IO (reference uses a boost lockfree SPSC queue,
+``timeline.h:68``).  Load the output in ``chrome://tracing`` / Perfetto.
+
+The native (C++) core has its own writer; this Python implementation backs the
+``python`` controller and is also used as the fallback when the native core is
+not built.
+"""
+
+import json
+import queue
+import threading
+import time
+
+
+class TimelineWriter:
+    """Background JSON writer (reference: TimelineWriter, timeline.cc:47)."""
+
+    def __init__(self, path):
+        self._path = path
+        self._queue = queue.Queue()
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="hvd-timeline-writer")
+        self._running = True
+        self._thread.start()
+
+    def enqueue(self, record: dict):
+        if self._running:
+            self._queue.put(record)
+
+    def _run(self):
+        while True:
+            record = self._queue.get()
+            if record is None:
+                break
+            if not self._first:
+                self._file.write(",\n")
+            self._first = False
+            self._file.write(json.dumps(record))
+        self._file.write("\n]\n")
+        self._file.close()
+
+    def close(self):
+        if self._running:
+            self._running = False
+            self._queue.put(None)
+            self._thread.join(timeout=5)
+
+
+class Timeline:
+    """Per-tensor lifecycle recorder. All ranks share rank-0's file, as in the
+    reference (rank 0 writes for everyone)."""
+
+    def __init__(self, path=None, mark_cycles=False):
+        self._writer = TimelineWriter(path) if path else None
+        self._mark_cycles = mark_cycles
+        self._lock = threading.Lock()
+        self._pids = {}
+        self._next_pid = 1
+        self._start = time.monotonic()
+
+    @property
+    def enabled(self):
+        return self._writer is not None
+
+    def _ts(self):
+        return int((time.monotonic() - self._start) * 1e6)
+
+    def _pid(self, tensor_name):
+        with self._lock:
+            pid = self._pids.get(tensor_name)
+            if pid is None:
+                pid = self._next_pid
+                self._next_pid += 1
+                self._pids[tensor_name] = pid
+                self._writer.enqueue({
+                    "name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": tensor_name},
+                })
+            return pid
+
+    def begin(self, tensor_name, phase):
+        if not self.enabled:
+            return
+        self._writer.enqueue({"name": phase, "ph": "B", "ts": self._ts(),
+                              "pid": self._pid(tensor_name), "tid": 0})
+
+    def end(self, tensor_name, args=None):
+        if not self.enabled:
+            return
+        record = {"ph": "E", "ts": self._ts(),
+                  "pid": self._pid(tensor_name), "tid": 0}
+        if args:
+            record["args"] = args
+        self._writer.enqueue(record)
+
+    def instant(self, tensor_name, name):
+        """Per-rank ready tick during negotiation (reference:
+        controller.cc:797-809 RecordNegotiate ticks)."""
+        if not self.enabled:
+            return
+        self._writer.enqueue({"name": name, "ph": "i", "ts": self._ts(),
+                              "pid": self._pid(tensor_name), "tid": 0,
+                              "s": "p"})
+
+    def mark_cycle(self):
+        """Background-loop cycle marker (HVD_TIMELINE_MARK_CYCLES; reference:
+        operations.cc:562-565)."""
+        if self.enabled and self._mark_cycles:
+            pid = self._pid("CYCLE")
+            self._writer.enqueue({"name": "CYCLE", "ph": "i", "ts": self._ts(),
+                                  "pid": pid, "tid": 0, "s": "g"})
+
+    def close(self):
+        if self._writer:
+            self._writer.close()
+            self._writer = None

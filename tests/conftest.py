@@ -1,0 +1,39 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference CI pattern (SURVEY §4): "multi-node" is simulated
+locally — there as N processes under the launcher, here as 8 XLA host
+devices so sharding/collective code paths are exercised for real.
+Env vars MUST be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Some TPU plugins (e.g. the axon tunnel) ignore the JAX_PLATFORMS env var;
+# force the CPU backend programmatically as well.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def hvd():
+    """Session-wide initialized horovod_tpu (device-rank mode, 8 ranks)."""
+    import horovod_tpu as hvd_module
+
+    hvd_module.init()
+    yield hvd_module
+    hvd_module.shutdown()
+
+
+@pytest.fixture(scope="session")
+def hvd_init(hvd):
+    """Alias fixture for tests that import horovod_tpu directly."""
+    return hvd

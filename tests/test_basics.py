@@ -1,0 +1,36 @@
+"""Process-model tests (reference: test_torch.py rank/size assertions and
+basics probes)."""
+
+
+def test_init_idempotent(hvd):
+    hvd.init()
+    assert hvd.is_initialized()
+
+
+def test_size_and_ranks(hvd):
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+    assert hvd.cross_size() == 1
+    assert hvd.rank() == 0  # main thread defaults to rank 0
+    assert hvd.local_rank() == 0
+    assert hvd.cross_rank() == 0
+
+
+def test_run_parallel_rank_context(hvd):
+    from horovod_tpu.common import basics
+
+    ranks = basics.run_parallel(lambda r: (hvd.rank(), hvd.local_rank()))
+    assert ranks == [(r, r) for r in range(8)]
+
+
+def test_capability_probes(hvd):
+    assert hvd.xla_built() and hvd.xla_enabled()
+    assert not hvd.mpi_built() and not hvd.mpi_enabled()
+    assert not hvd.gloo_built() and not hvd.gloo_enabled()
+    assert not hvd.nccl_built()
+
+
+def test_mesh(hvd):
+    mesh = hvd.mesh()
+    assert mesh.axis_names == ("hvd",)
+    assert mesh.devices.size == 8

@@ -1,0 +1,265 @@
+"""Eager collective correctness, rank-parameterized against numpy oracles
+(reference: test_torch.py / test_tensorflow.py patterns)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.handles import HvdError
+
+N = 8
+
+
+def _per_rank(fn):
+    return basics.run_parallel(fn)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32, jnp.bfloat16])
+def test_allreduce_average(hvd, dtype):
+    if dtype is np.int32:
+        pytest.skip("average on ints divides; covered by sum test")
+    data = [np.arange(16, dtype=np.float32).reshape(4, 4) * (r + 1)
+            for r in range(N)]
+    expected = np.mean(np.stack(data), axis=0)
+
+    def fn(r):
+        return np.asarray(
+            hvd.allreduce(jnp.asarray(data[r], dtype=dtype),
+                          name=f"avg.{np.dtype(dtype).name}"),
+            dtype=np.float32)
+
+    for out in _per_rank(fn):
+        np.testing.assert_allclose(out, expected, rtol=1e-2)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32])
+def test_allreduce_sum(hvd, dtype):
+    data = [(np.arange(12) * (r + 1)).astype(dtype).reshape(3, 4)
+            for r in range(N)]
+    expected = np.sum(np.stack(data), axis=0)
+
+    def fn(r):
+        return np.asarray(hvd.allreduce(
+            jnp.asarray(data[r]), op=hvd.Sum,
+            name=f"sum.{np.dtype(dtype).name}"))
+
+    for out in _per_rank(fn):
+        np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_allreduce_scalar_and_odd_shapes(hvd):
+    for shape in [(), (1,), (7,), (3, 5, 2)]:
+        data = [np.asarray(np.random.RandomState(r).randn(*shape),
+                           dtype=np.float32)
+                for r in range(N)]
+        expected = np.sum(np.stack(data), axis=0)
+
+        def fn(r, data=data, shape=shape):
+            return np.asarray(hvd.allreduce(
+                jnp.asarray(data[r]), op=hvd.Sum, name=f"odd.{shape}"))
+
+        for out in _per_rank(fn):
+            np.testing.assert_allclose(out, expected, rtol=1e-4)
+
+
+def test_allreduce_prescale_postscale(hvd):
+    data = [np.full((4,), float(r + 1), np.float32) for r in range(N)]
+    expected = np.sum(np.stack(data) * 0.5, axis=0) * 2.0
+
+    def fn(r):
+        return np.asarray(hvd.allreduce(
+            jnp.asarray(data[r]), op=hvd.Sum, name="scaled",
+            prescale_factor=0.5, postscale_factor=2.0))
+
+    for out in _per_rank(fn):
+        np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_allreduce_async_poll(hvd):
+    def fn(r):
+        handle = hvd.allreduce_async(jnp.ones((8,)) * r, op=hvd.Sum,
+                                     name="async")
+        out = hvd.synchronize(handle)
+        assert hvd.poll(handle)
+        return np.asarray(out)
+
+    expected = np.full((8,), sum(range(N)), np.float32)
+    for out in _per_rank(fn):
+        np.testing.assert_allclose(out, expected)
+
+
+def test_fusion_many_small_tensors(hvd):
+    """Many small named tensors in flight at once -> fused buckets."""
+    num_tensors = 32
+
+    def fn(r):
+        handles = [
+            hvd.allreduce_async(jnp.full((5,), float(r + i), jnp.float32),
+                                op=hvd.Sum, name=f"fuse.{i}")
+            for i in range(num_tensors)
+        ]
+        return [np.asarray(hvd.synchronize(h)) for h in handles]
+
+    results = _per_rank(fn)
+    for i in range(num_tensors):
+        expected = np.full((5,), sum(r + i for r in range(N)), np.float32)
+        for r in range(N):
+            np.testing.assert_allclose(results[r][i], expected)
+
+
+def test_grouped_allreduce(hvd):
+    def fn(r):
+        outs = hvd.grouped_allreduce(
+            [jnp.full((3,), float(r)), jnp.full((2, 2), float(2 * r))],
+            op=hvd.Sum, name="grp")
+        return [np.asarray(o) for o in outs]
+
+    results = _per_rank(fn)
+    total = sum(range(N))
+    for r in range(N):
+        np.testing.assert_allclose(results[r][0], np.full((3,), total))
+        np.testing.assert_allclose(results[r][1],
+                                   np.full((2, 2), 2.0 * total))
+
+
+def test_allreduce_shape_mismatch_errors(hvd):
+    def fn(r):
+        shape = (3,) if r == 0 else (4,)
+        with pytest.raises(HvdError, match="mismatched shapes"):
+            hvd.allreduce(jnp.ones(shape), name="bad.shape")
+        return True
+
+    assert all(_per_rank(fn))
+
+
+def test_allreduce_dtype_mismatch_errors(hvd):
+    def fn(r):
+        dtype = jnp.float32 if r == 0 else jnp.int32
+        with pytest.raises(HvdError, match="mismatched dtypes"):
+            hvd.allreduce(jnp.ones((3,), dtype=dtype), name="bad.dtype")
+        return True
+
+    assert all(_per_rank(fn))
+
+
+def test_mismatched_collective_types_error(hvd):
+    def fn(r):
+        with pytest.raises(HvdError, match="mismatched collective types"):
+            if r == 0:
+                hvd.allreduce(jnp.ones((3,)), name="bad.kind")
+            else:
+                hvd.allgather(jnp.ones((3,)), name="bad.kind")
+        return True
+
+    assert all(_per_rank(fn))
+
+
+def test_allgather_uniform(hvd):
+    data = [np.full((2, 3), float(r), np.float32) for r in range(N)]
+    expected = np.concatenate(data, axis=0)
+
+    def fn(r):
+        return np.asarray(hvd.allgather(jnp.asarray(data[r]), name="ag.u"))
+
+    for out in _per_rank(fn):
+        np.testing.assert_allclose(out, expected)
+
+
+def test_allgather_variable_dim0(hvd):
+    """Per-rank variable first dimension (reference: controller.cc:453-518)."""
+    data = [np.full((r + 1, 2), float(r), np.float32) for r in range(N)]
+    expected = np.concatenate(data, axis=0)
+
+    def fn(r):
+        return np.asarray(hvd.allgather(jnp.asarray(data[r]), name="ag.v"))
+
+    for out in _per_rank(fn):
+        np.testing.assert_allclose(out, expected)
+
+
+def test_allgather_trailing_mismatch_errors(hvd):
+    def fn(r):
+        shape = (2, 3) if r == 0 else (2, 4)
+        with pytest.raises(HvdError, match="trailing dimensions"):
+            hvd.allgather(jnp.ones(shape), name="ag.bad")
+        return True
+
+    assert all(_per_rank(fn))
+
+
+def test_broadcast(hvd):
+    def fn(r):
+        out = hvd.broadcast(jnp.full((4,), float(r), jnp.float32),
+                            root_rank=3, name="bc")
+        return np.asarray(out)
+
+    for out in _per_rank(fn):
+        np.testing.assert_allclose(out, np.full((4,), 3.0))
+
+
+def test_broadcast_root_mismatch_errors(hvd):
+    def fn(r):
+        with pytest.raises(HvdError, match="root ranks"):
+            hvd.broadcast(jnp.ones((2,)), root_rank=r % 2, name="bc.bad")
+        return True
+
+    assert all(_per_rank(fn))
+
+
+def test_alltoall_equal_splits(hvd):
+    def fn(r):
+        data = jnp.arange(N * 2, dtype=jnp.float32).reshape(N * 2, 1) + 100 * r
+        return np.asarray(hvd.alltoall(data, name="a2a"))
+
+    results = _per_rank(fn)
+    for dst in range(N):
+        expected = np.concatenate([
+            (np.arange(N * 2).reshape(N * 2, 1)
+             + 100 * src)[2 * dst:2 * dst + 2]
+            for src in range(N)
+        ]).astype(np.float32)
+        np.testing.assert_allclose(results[dst], expected)
+
+
+def test_join_uneven_steps(hvd):
+    """Ranks do different numbers of allreduces then join; missing ranks
+    contribute zeros (reference: controller.cc joined handling, torch
+    join())."""
+    steps = [2 if r < 2 else 4 for r in range(N)]
+
+    def fn(r):
+        outs = []
+        for i in range(steps[r]):
+            outs.append(np.asarray(hvd.allreduce(
+                jnp.full((2,), 1.0, jnp.float32), op=hvd.Sum,
+                name=f"join.step{i}")))
+        last = hvd.join()
+        return outs, last
+
+    results = _per_rank(fn)
+    for r in range(N):
+        outs, last = results[r]
+        np.testing.assert_allclose(outs[0], np.full((2,), 8.0))
+        np.testing.assert_allclose(outs[1], np.full((2,), 8.0))
+        if steps[r] == 4:
+            # ranks 0,1 joined; only 6 contributors
+            np.testing.assert_allclose(outs[2], np.full((2,), 6.0))
+            np.testing.assert_allclose(outs[3], np.full((2,), 6.0))
+        # ranks 0,1 joined first; the last joiner is one of the late ranks
+        assert 2 <= last < N
+
+
+def test_adasum_matches_reference(hvd):
+    from horovod_tpu.ops.adasum import adasum_reference
+
+    rng = np.random.RandomState(42)
+    data = [rng.randn(16).astype(np.float32) for _ in range(N)]
+    expected = adasum_reference(data)
+
+    def fn(r):
+        return np.asarray(hvd.allreduce(jnp.asarray(data[r]), op=hvd.Adasum,
+                                        name="adasum"))
+
+    for out in _per_rank(fn):
+        np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)

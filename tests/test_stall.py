@@ -1,0 +1,77 @@
+"""Stall-inspector tests (reference: test/test_stall.py — one rank lags,
+expect a warning, then shutdown when HVD_STALL_SHUTDOWN is exceeded)."""
+
+import os
+import subprocess
+import sys
+
+WARN_SCRIPT = r"""
+import time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+
+hvd.init()
+def fn(r):
+    if r == 0:
+        time.sleep(3.0)
+    hvd.allreduce(jnp.ones((2,)), name="stall.tensor", op=hvd.Sum)
+basics.run_parallel(fn)
+hvd.shutdown()
+print("COMPLETED")
+"""
+
+SHUTDOWN_SCRIPT = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.common.handles import HvdError
+
+hvd.init()
+def fn(r):
+    if r == 0:
+        return "skipped"
+    try:
+        hvd.allreduce(jnp.ones((2,)), name="stall.tensor", op=hvd.Sum)
+        return "no-error"
+    except HvdError:
+        return "error"
+results = basics.run_parallel(fn)
+assert results[0] == "skipped"
+assert all(r == "error" for r in results[1:]), results
+hvd.shutdown()
+print("SHUTDOWN-OK")
+"""
+
+
+def _run(script, extra_env):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    })
+    env.update(extra_env)
+    return subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300,
+                          cwd=os.path.dirname(os.path.dirname(__file__)))
+
+
+def test_stall_warning():
+    result = _run(WARN_SCRIPT, {"HVD_STALL_CHECK_TIME_SECONDS": "1"})
+    assert result.returncode == 0, result.stderr
+    assert "COMPLETED" in result.stdout
+    assert "Stalled tensor: stall.tensor" in result.stderr
+    assert "waiting on: [0]" in result.stderr
+
+
+def test_stall_shutdown():
+    result = _run(SHUTDOWN_SCRIPT, {
+        "HVD_STALL_CHECK_TIME_SECONDS": "1",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "2",
+    })
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert "SHUTDOWN-OK" in result.stdout

@@ -1,0 +1,55 @@
+"""Timeline tests (reference: test/test_timeline.py — run ops with the
+timeline env var set, parse the JSON, assert NEGOTIATE/op events exist).
+
+Run in a subprocess so HVD_TIMELINE is set before init, exactly as the
+reference drives it purely via env vars.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+SCRIPT = r"""
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+
+hvd.init()
+def fn(r):
+    hvd.allreduce(jnp.ones((4,)) * r, name="timeline.tensor", op=hvd.Sum)
+    hvd.allgather(jnp.ones((2, 2)), name="timeline.gather")
+basics.run_parallel(fn)
+hvd.shutdown()
+"""
+
+
+def test_timeline_events(tmp_path):
+    timeline_file = tmp_path / "timeline.json"
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "HVD_TIMELINE": str(timeline_file),
+        "HVD_TIMELINE_MARK_CYCLES": "1",
+    })
+    result = subprocess.run([sys.executable, "-c", SCRIPT], env=env,
+                            capture_output=True, text=True, timeout=300,
+                            cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert result.returncode == 0, result.stderr
+
+    events = json.loads(timeline_file.read_text())
+    names = {e.get("name") for e in events}
+    assert "NEGOTIATE_ALLREDUCE" in names
+    assert "ALLREDUCE" in names
+    assert "NEGOTIATE_ALLGATHER" in names
+    assert "ALLGATHER" in names
+    assert "CYCLE" in names
+    # per-tensor pids registered via metadata events
+    meta = [e for e in events if e.get("ph") == "M"]
+    registered = {e["args"]["name"] for e in meta}
+    assert "timeline.tensor" in registered
+    assert "timeline.gather" in registered
