@@ -1,0 +1,69 @@
+// C boundary for ctypes (the reference exposes extern "C" basics the same
+// way: horovod/common/operations.cc:663-797 consumed by
+// horovod/common/basics.py).  All blocking entry points release the GIL on
+// the Python side automatically because ctypes drops it around foreign
+// calls.
+#include <cstring>
+
+#include "core.h"
+
+using hvd::Core;
+using hvd::CoreConfig;
+
+extern "C" {
+
+void* hvd_core_create(int size) {
+  return new Core(CoreConfig::FromEnv(size));
+}
+
+void hvd_core_start(void* core) { static_cast<Core*>(core)->Start(); }
+
+void hvd_core_shutdown(void* core) { static_cast<Core*>(core)->Shutdown(); }
+
+void hvd_core_destroy(void* core) { delete static_cast<Core*>(core); }
+
+// Returns 0 on success; -1 with the error copied into err_buf otherwise.
+int hvd_core_enqueue(void* core, const uint8_t* data, size_t len,
+                     char* err_buf, size_t err_cap) {
+  std::string error;
+  if (static_cast<Core*>(core)->Enqueue(data, len, &error)) return 0;
+  if (err_buf && err_cap > 0) {
+    strncpy(err_buf, error.c_str(), err_cap - 1);
+    err_buf[err_cap - 1] = '\0';
+  }
+  return -1;
+}
+
+void hvd_core_join(void* core, int rank, uint64_t req_id) {
+  static_cast<Core*>(core)->Join(rank, req_id);
+}
+
+// Blocks until a batch is available (GIL released by ctypes).  The returned
+// buffer is owned by the caller; free with hvd_core_free.
+uint8_t* hvd_core_next_batch(void* core, size_t* out_len) {
+  std::vector<uint8_t> batch = static_cast<Core*>(core)->NextBatch();
+  uint8_t* out = static_cast<uint8_t*>(malloc(batch.size()));
+  memcpy(out, batch.data(), batch.size());
+  *out_len = batch.size();
+  return out;
+}
+
+void hvd_core_free(uint8_t* buf) { free(buf); }
+
+void hvd_core_mark_done(void* core, uint64_t batch_id, const char* error) {
+  static_cast<Core*>(core)->MarkDone(batch_id, error);
+}
+
+uint64_t hvd_core_cache_hits(void* core) {
+  return static_cast<Core*>(core)->cache_hits();
+}
+
+uint64_t hvd_core_cache_misses(void* core) {
+  return static_cast<Core*>(core)->cache_misses();
+}
+
+uint64_t hvd_core_cache_size(void* core) {
+  return static_cast<Core*>(core)->cache_size();
+}
+
+}  // extern "C"

@@ -1,0 +1,89 @@
+#include "common.h"
+
+#include <ctime>
+#include <mutex>
+
+namespace hvd {
+
+LogLevel MinLogLevel() {
+  static LogLevel level = [] {
+    std::string v = EnvStr("HVD_LOG_LEVEL", "warning");
+    if (v == "trace") return LogLevel::kTrace;
+    if (v == "debug") return LogLevel::kDebug;
+    if (v == "info") return LogLevel::kInfo;
+    if (v == "error") return LogLevel::kError;
+    if (v == "fatal") return LogLevel::kFatal;
+    return LogLevel::kWarning;
+  }();
+  return level;
+}
+
+bool LogHideTimestamps() {
+  static bool hide = EnvBool("HVD_LOG_HIDE_TIME", false);
+  return hide;
+}
+
+void LogMessage(LogLevel level, const std::string& msg) {
+  static std::mutex mu;
+  static const char* names[] = {"TRACE", "DEBUG", "INFO",
+                                "WARNING", "ERROR", "FATAL"};
+  std::lock_guard<std::mutex> lock(mu);
+  if (!LogHideTimestamps()) {
+    char buf[32];
+    time_t now = time(nullptr);
+    struct tm tm_buf;
+    localtime_r(&now, &tm_buf);
+    strftime(buf, sizeof(buf), "%F %T", &tm_buf);
+    fprintf(stderr, "%s ", buf);
+  }
+  fprintf(stderr, "[%s] [hvd-core] %s\n",
+          names[static_cast<int>(level)], msg.c_str());
+  if (level == LogLevel::kFatal) abort();
+}
+
+int64_t EnvInt(const char* name, int64_t dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  char* end = nullptr;
+  int64_t out = strtoll(v, &end, 10);
+  return end == v ? dflt : out;
+}
+
+double EnvDouble(const char* name, double dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  char* end = nullptr;
+  double out = strtod(v, &end);
+  return end == v ? dflt : out;
+}
+
+bool EnvBool(const char* name, bool dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  std::string s(v);
+  return s == "1" || s == "true" || s == "yes" || s == "on";
+}
+
+std::string EnvStr(const char* name, const std::string& dflt) {
+  const char* v = getenv(name);
+  return (v && *v) ? std::string(v) : dflt;
+}
+
+CoreConfig CoreConfig::FromEnv(int size) {
+  CoreConfig c;
+  c.size = size;
+  c.fusion_threshold_bytes =
+      EnvInt("HVD_FUSION_THRESHOLD", c.fusion_threshold_bytes);
+  c.cycle_time_ms = EnvDouble("HVD_CYCLE_TIME", c.cycle_time_ms);
+  c.cache_capacity = EnvInt("HVD_CACHE_CAPACITY", c.cache_capacity);
+  c.timeline_path = EnvStr("HVD_TIMELINE", "");
+  c.timeline_mark_cycles = EnvBool("HVD_TIMELINE_MARK_CYCLES", false);
+  c.stall_check_disable = EnvBool("HVD_STALL_CHECK_DISABLE", false);
+  c.stall_warning_sec =
+      EnvDouble("HVD_STALL_CHECK_TIME_SECONDS", c.stall_warning_sec);
+  c.stall_shutdown_sec =
+      EnvDouble("HVD_STALL_SHUTDOWN_TIME_SECONDS", c.stall_shutdown_sec);
+  return c;
+}
+
+}  // namespace hvd

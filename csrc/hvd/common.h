@@ -1,0 +1,137 @@
+// Common types for the horovod_tpu native core.
+//
+// TPU-native re-design of the reference core runtime (reference:
+// horovod/common/common.h, logging.h, utils/env_parser.cc).  The native core
+// coordinates named collectives across logical ranks: it owns the background
+// cycle loop, tensor queue, negotiation, response cache, fusion planning,
+// stall inspection and timeline.  Tensor DATA never enters this layer — the
+// XLA data plane (Python/JAX) executes the fused programs; the core works on
+// metadata only.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+// ---------------------------------------------------------------- data types
+enum class DataType : uint8_t {
+  kFloat32 = 0,
+  kFloat64 = 1,
+  kBFloat16 = 2,
+  kFloat16 = 3,
+  kInt8 = 4,
+  kInt16 = 5,
+  kInt32 = 6,
+  kInt64 = 7,
+  kUInt8 = 8,
+  kBool = 9,
+};
+
+inline size_t DataTypeSize(DataType t) {
+  switch (t) {
+    case DataType::kFloat64:
+    case DataType::kInt64:
+      return 8;
+    case DataType::kFloat32:
+    case DataType::kInt32:
+      return 4;
+    case DataType::kBFloat16:
+    case DataType::kFloat16:
+    case DataType::kInt16:
+      return 2;
+    default:
+      return 1;
+  }
+}
+
+enum class RequestType : uint8_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  kJoin = 3,
+  kAdasum = 4,
+  kAlltoall = 5,
+};
+
+enum class ResponseType : uint8_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  kJoin = 3,
+  kAdasum = 4,
+  kAlltoall = 5,
+  kError = 6,
+};
+
+enum class ReduceOp : uint8_t { kAverage = 0, kSum = 1, kAdasum = 2 };
+
+// -------------------------------------------------------------------- status
+struct Status {
+  bool ok = true;
+  std::string message;
+  static Status OK() { return {}; }
+  static Status Error(std::string msg) { return {false, std::move(msg)}; }
+};
+
+// ------------------------------------------------------------------- logging
+enum class LogLevel : int {
+  kTrace = 0,
+  kDebug = 1,
+  kInfo = 2,
+  kWarning = 3,
+  kError = 4,
+  kFatal = 5,
+};
+
+LogLevel MinLogLevel();       // from HVD_LOG_LEVEL
+bool LogHideTimestamps();     // from HVD_LOG_HIDE_TIME
+void LogMessage(LogLevel level, const std::string& msg);
+
+class LogStream {
+ public:
+  explicit LogStream(LogLevel level) : level_(level) {}
+  ~LogStream() {
+    if (level_ >= MinLogLevel()) LogMessage(level_, stream_.str());
+  }
+  template <typename T>
+  LogStream& operator<<(const T& v) {
+    stream_ << v;
+    return *this;
+  }
+
+ private:
+  LogLevel level_;
+  std::ostringstream stream_;
+};
+
+#define HVD_LOG(level) ::hvd::LogStream(::hvd::LogLevel::k##level)
+
+// ----------------------------------------------------------------------- env
+int64_t EnvInt(const char* name, int64_t dflt);
+double EnvDouble(const char* name, double dflt);
+bool EnvBool(const char* name, bool dflt);
+std::string EnvStr(const char* name, const std::string& dflt);
+
+// -------------------------------------------------------------------- config
+// Reference knob set: horovod/common/operations.cc:404-500.
+struct CoreConfig {
+  int size = 1;
+  int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
+  double cycle_time_ms = 1.0;
+  int64_t cache_capacity = 1024;
+  std::string timeline_path;
+  bool timeline_mark_cycles = false;
+  bool stall_check_disable = false;
+  double stall_warning_sec = 60.0;
+  double stall_shutdown_sec = 0.0;
+
+  static CoreConfig FromEnv(int size);
+};
+
+}  // namespace hvd

@@ -1,0 +1,562 @@
+#include "core.h"
+
+#include <algorithm>
+#include <sstream>
+
+namespace hvd {
+
+// ------------------------------------------------------------ ResponseCache
+bool ResponseCache::Matches(const Signature& sig, const Request& req) const {
+  return sig.type == req.type && sig.dtype == req.dtype &&
+         sig.shape == req.shape && sig.op == req.op &&
+         sig.root_rank == req.root_rank && sig.prescale == req.prescale &&
+         sig.postscale == req.postscale;
+}
+
+ResponseCache::State ResponseCache::Lookup(const Request& req) const {
+  auto it = entries_.find(req.name);
+  if (it == entries_.end()) {
+    ++misses_;
+    return State::kMiss;
+  }
+  if (Matches(it->second.first, req)) {
+    ++hits_;
+    return State::kHit;
+  }
+  return State::kInvalid;
+}
+
+int ResponseCache::Put(const Request& req) {
+  auto it = entries_.find(req.name);
+  if (it != entries_.end()) {
+    lru_.erase(it->second.second);
+    lru_.push_front(req.name);
+    it->second.first = Signature{req.type,      req.dtype,   req.shape,
+                                 req.op,        req.root_rank, req.prescale,
+                                 req.postscale, it->second.first.bit};
+    it->second.second = lru_.begin();
+    return it->second.first.bit;
+  }
+  if (entries_.size() >= capacity_ && !lru_.empty()) {
+    entries_.erase(lru_.back());
+    lru_.pop_back();
+  }
+  lru_.push_front(req.name);
+  int bit = next_bit_++;
+  entries_.emplace(req.name,
+                   std::make_pair(Signature{req.type, req.dtype, req.shape,
+                                            req.op, req.root_rank,
+                                            req.prescale, req.postscale, bit},
+                                  lru_.begin()));
+  return bit;
+}
+
+void ResponseCache::Invalidate(const std::string& name) {
+  auto it = entries_.find(name);
+  if (it == entries_.end()) return;
+  lru_.erase(it->second.second);
+  entries_.erase(it);
+}
+
+// --------------------------------------------------------------------- Core
+Core::Core(const CoreConfig& config)
+    : config_(config),
+      cache_(static_cast<size_t>(config.cache_capacity)) {}
+
+Core::~Core() { Shutdown(); }
+
+void Core::Start() {
+  {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    if (running_) return;
+    running_ = true;
+  }
+  timeline_.Open(config_.timeline_path, config_.timeline_mark_cycles);
+  bg_thread_ = std::thread(&Core::BackgroundLoop, this);
+}
+
+void Core::Shutdown() {
+  {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    if (!running_) return;
+    running_ = false;
+  }
+  wakeup_.notify_all();
+  if (bg_thread_.joinable()) bg_thread_.join();
+  // Publish the shutdown sentinel so the dispatcher exits (reference:
+  // ResponseList::shutdown flag).
+  ResponseBatch batch;
+  batch.shutdown = true;
+  {
+    std::lock_guard<std::mutex> lock(out_mu_);
+    out_queue_.push_back(batch.Encode());
+  }
+  out_cv_.notify_all();
+  timeline_.Close();
+}
+
+bool Core::Enqueue(const uint8_t* data, size_t len, std::string* error) {
+  Reader r(data, len);
+  Request req = Request::Decode(&r);
+  if (!r.ok()) {
+    *error = "malformed request";
+    return false;
+  }
+  {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    if (!running_) {
+      *error = "horovod_tpu has been shut down";
+      return false;
+    }
+    if (!shutdown_error_.empty()) {
+      *error = shutdown_error_;
+      return false;
+    }
+  }
+  tensor_queue_.Push(std::move(req));
+  wakeup_.notify_one();
+  return true;
+}
+
+void Core::Join(int32_t rank, uint64_t req_id) {
+  {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    if (joined_.insert(rank).second) {
+      join_order_.push_back(rank);
+    }
+    join_req_ids_[rank] = req_id;
+  }
+  wakeup_.notify_one();
+}
+
+std::vector<uint8_t> Core::NextBatch() {
+  std::unique_lock<std::mutex> lock(out_mu_);
+  out_cv_.wait(lock, [&] { return !out_queue_.empty(); });
+  std::vector<uint8_t> out = std::move(out_queue_.front());
+  out_queue_.pop_front();
+  return out;
+}
+
+void Core::MarkDone(uint64_t batch_id, const char* error_or_null) {
+  std::vector<std::string> names;
+  {
+    std::lock_guard<std::mutex> lock(out_mu_);
+    auto it = in_flight_.find(batch_id);
+    if (it == in_flight_.end()) return;
+    names = std::move(it->second);
+    in_flight_.erase(it);
+  }
+  for (const auto& name : names) {
+    timeline_.End(name);
+    if (error_or_null != nullptr) cache_.Invalidate(name);
+  }
+}
+
+void Core::BackgroundLoop() {
+  // Reference: operations.cc:550 RunLoopOnce under a ~cycle_time wait.
+  auto cycle =
+      std::chrono::duration<double, std::milli>(config_.cycle_time_ms);
+  std::unique_lock<std::mutex> lock(state_mu_);
+  while (running_) {
+    wakeup_.wait_for(lock, cycle);
+    if (!running_) break;
+    lock.unlock();
+    timeline_.MarkCycle();
+    RunCycle();
+    lock.lock();
+  }
+  lock.unlock();
+  // Drain: fail anything still pending so no handle hangs.
+  FailAllPending("horovod_tpu has been shut down");
+}
+
+void Core::RunCycle() {
+  {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    joined_view_ = joined_;
+  }
+
+  // 1. absorb new requests (reference: PopMessagesFromQueue).
+  for (Request& req : tensor_queue_.Drain()) {
+    auto it = std::find_if(table_.begin(), table_.end(),
+                           [&](const auto& kv) { return kv.first == req.name; });
+    if (it == table_.end()) {
+      NameEntry entry;
+      entry.first_ts = Clock::now();
+      entry.type = req.type;
+      timeline_.Begin(req.name,
+                      std::string("NEGOTIATE_") +
+                          (req.type == RequestType::kAllreduce ? "ALLREDUCE"
+                           : req.type == RequestType::kAllgather ? "ALLGATHER"
+                           : req.type == RequestType::kBroadcast ? "BROADCAST"
+                           : req.type == RequestType::kAlltoall ? "ALLTOALL"
+                           : req.type == RequestType::kAdasum   ? "ADASUM"
+                                                                : "JOIN"));
+      table_.emplace_back(req.name, std::move(entry));
+      it = std::prev(table_.end());
+    }
+    NameEntry& entry = it->second;
+    if (entry.requests.count(req.rank)) {
+      // Duplicate before completion: error just this request.
+      Response resp;
+      resp.type = ResponseType::kError;
+      resp.error = "duplicate request for tensor '" + req.name +
+                   "' from rank " + std::to_string(req.rank) +
+                   " before previous one completed";
+      ResponseEntry re;
+      re.name = req.name;
+      re.ranks.push_back(req.rank);
+      re.req_ids.push_back(req.req_id);
+      resp.entries.push_back(std::move(re));
+      PublishBatch({std::move(resp)});
+      continue;
+    }
+    timeline_.Instant(req.name, std::to_string(req.rank));
+    entry.requests.emplace(req.rank, std::move(req));
+  }
+
+  // 2. stall inspection (reference: stall_inspector.cc).
+  if (!config_.stall_check_disable) CheckStalls();
+
+  // 3. collect ready names in arrival order — the deterministic execution
+  // order all ranks observe (reference: rank-0 response ordering).
+  std::vector<Response> ready;
+  size_t needed = static_cast<size_t>(config_.size) - joined_view_.size();
+  for (auto it = table_.begin(); it != table_.end();) {
+    NameEntry& entry = it->second;
+    size_t have = 0;
+    for (const auto& kv : entry.requests) {
+      if (!joined_view_.count(kv.first)) ++have;
+    }
+    if (have >= needed && needed > 0) {
+      timeline_.End(it->first);
+      ready.push_back(ConstructResponse(it->first, entry));
+      it = table_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+
+  // 4. fuse + publish.
+  FuseAndPublish(std::move(ready));
+
+  // 5. join barrier: all ranks joined and nothing pending -> complete joins
+  // with the last rank to join (reference: controller joined handling).
+  std::vector<std::pair<int32_t, uint64_t>> join_done;
+  int32_t last_rank = -1;
+  {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    if (!joined_.empty() &&
+        joined_.size() == static_cast<size_t>(config_.size) &&
+        table_.empty() && tensor_queue_.Size() == 0) {
+      last_rank = join_order_.back();
+      for (const auto& kv : join_req_ids_) {
+        join_done.emplace_back(kv.first, kv.second);
+      }
+      join_req_ids_.clear();
+      join_order_.clear();
+      joined_.clear();
+    }
+  }
+  if (!join_done.empty()) {
+    Response resp;
+    resp.type = ResponseType::kJoin;
+    ResponseEntry re;
+    re.name = "join";
+    re.root_rank = last_rank;  // payload: the last rank to join
+    for (const auto& kv : join_done) {
+      re.ranks.push_back(kv.first);
+      re.req_ids.push_back(kv.second);
+    }
+    resp.entries.push_back(std::move(re));
+    PublishBatch({std::move(resp)});
+  }
+}
+
+Response Core::ConstructResponse(const std::string& name, NameEntry& entry) {
+  auto error = [&](const std::string& message) {
+    Response resp;
+    resp.type = ResponseType::kError;
+    resp.error = message;
+    ResponseEntry re;
+    re.name = name;
+    for (const auto& kv : entry.requests) {
+      re.ranks.push_back(kv.first);
+      re.req_ids.push_back(kv.second.req_id);
+    }
+    resp.entries.push_back(std::move(re));
+    return resp;
+  };
+
+  const Request& first = entry.requests.begin()->second;
+
+  for (const auto& kv : entry.requests) {
+    if (kv.second.type != entry.type) {
+      return error("mismatched collective types for tensor '" + name + "'");
+    }
+  }
+
+  if (!joined_view_.empty() && (entry.type == RequestType::kAllgather ||
+                                entry.type == RequestType::kBroadcast ||
+                                entry.type == RequestType::kAlltoall)) {
+    const char* tname = entry.type == RequestType::kAllgather ? "ALLGATHER"
+                        : entry.type == RequestType::kBroadcast ? "BROADCAST"
+                                                                : "ALLTOALL";
+    return error(std::string(tname) +
+                 " is not supported while ranks have joined");
+  }
+
+  for (const auto& kv : entry.requests) {
+    if (kv.second.dtype != first.dtype) {
+      return error("mismatched dtypes for tensor '" + name + "'");
+    }
+  }
+
+  switch (entry.type) {
+    case RequestType::kAllreduce:
+    case RequestType::kAdasum: {
+      for (const auto& kv : entry.requests) {
+        const Request& r = kv.second;
+        if (r.op != first.op) {
+          return error("mismatched reduce ops for tensor '" + name + "'");
+        }
+        if (r.prescale != first.prescale || r.postscale != first.postscale) {
+          return error("mismatched scale factors for tensor '" + name + "'");
+        }
+        if (r.shape != first.shape) {
+          return error("mismatched shapes for allreduce '" + name + "'");
+        }
+      }
+      break;
+    }
+    case RequestType::kAllgather: {
+      for (const auto& kv : entry.requests) {
+        const Request& r = kv.second;
+        if (r.shape.size() != first.shape.size()) {
+          return error("mismatched tensor ranks for allgather '" + name +
+                       "'");
+        }
+        if (r.shape.empty()) {
+          return error("allgather '" + name +
+                       "': 0-d tensors are not supported; reshape to (1,) "
+                       "first");
+        }
+        if (!std::equal(r.shape.begin() + 1, r.shape.end(),
+                        first.shape.begin() + 1, first.shape.end())) {
+          return error("mismatched trailing dimensions for allgather '" +
+                       name + "'");
+        }
+      }
+      break;
+    }
+    case RequestType::kBroadcast: {
+      for (const auto& kv : entry.requests) {
+        const Request& r = kv.second;
+        if (r.root_rank != first.root_rank) {
+          return error("mismatched root ranks for broadcast '" + name + "'");
+        }
+        if (r.shape != first.shape) {
+          return error("mismatched shapes for broadcast '" + name + "'");
+        }
+      }
+      break;
+    }
+    case RequestType::kAlltoall: {
+      for (const auto& kv : entry.requests) {
+        const Request& r = kv.second;
+        if (r.splits.size() != static_cast<size_t>(config_.size)) {
+          return error("alltoall '" + name +
+                       "': splits must have one entry per rank (" +
+                       std::to_string(config_.size) + "), got " +
+                       std::to_string(r.splits.size()));
+        }
+        int64_t total = 0;
+        for (int64_t s : r.splits) total += s;
+        int64_t dim0 = r.shape.empty() ? 0 : r.shape[0];
+        if (total != dim0) {
+          return error("alltoall '" + name + "': splits sum " +
+                       std::to_string(total) + " != first dimension " +
+                       std::to_string(dim0));
+        }
+      }
+      break;
+    }
+    default:
+      break;
+  }
+
+  // Cache bookkeeping: record the steady-state signature (reference puts
+  // executed responses in the cache so the next cycle takes the fast path).
+  cache_.Lookup(first);
+  cache_.Put(first);
+
+  Response resp;
+  switch (entry.type) {
+    case RequestType::kAllreduce: resp.type = ResponseType::kAllreduce; break;
+    case RequestType::kAllgather: resp.type = ResponseType::kAllgather; break;
+    case RequestType::kBroadcast: resp.type = ResponseType::kBroadcast; break;
+    case RequestType::kAdasum:    resp.type = ResponseType::kAdasum;    break;
+    case RequestType::kAlltoall:  resp.type = ResponseType::kAlltoall;  break;
+    default:                      resp.type = ResponseType::kError;     break;
+  }
+  resp.op = first.op;
+  resp.dtype = first.dtype;
+  resp.prescale = first.prescale;
+  resp.postscale = first.postscale;
+  resp.fused_bytes = first.ByteSize();
+  ResponseEntry re;
+  re.name = name;
+  re.root_rank = first.root_rank;
+  for (const auto& kv : entry.requests) {
+    re.ranks.push_back(kv.first);
+    re.req_ids.push_back(kv.second.req_id);
+  }
+  for (int32_t j : joined_view_) {
+    if (!entry.requests.count(j)) re.joined.push_back(j);
+  }
+  resp.entries.push_back(std::move(re));
+  return resp;
+}
+
+void Core::FuseAndPublish(std::vector<Response> ready) {
+  if (ready.empty()) return;
+  std::vector<Response> out;
+  ptrdiff_t bucket = -1;  // index into out (push_back may reallocate)
+  int64_t bucket_bytes = 0;
+
+  for (Response& resp : ready) {
+    if (resp.type == ResponseType::kAllreduce && resp.error.empty()) {
+      bool compatible =
+          bucket >= 0 && out[bucket].dtype == resp.dtype &&
+          out[bucket].op == resp.op && out[bucket].prescale == resp.prescale &&
+          out[bucket].postscale == resp.postscale &&
+          bucket_bytes + resp.fused_bytes <= config_.fusion_threshold_bytes;
+      if (compatible) {
+        bucket_bytes += resp.fused_bytes;
+        for (auto& e : resp.entries) {
+          out[bucket].entries.push_back(std::move(e));
+        }
+      } else {
+        out.push_back(std::move(resp));
+        bucket = static_cast<ptrdiff_t>(out.size()) - 1;
+        bucket_bytes = out[bucket].fused_bytes;
+      }
+    } else {
+      out.push_back(std::move(resp));
+      bucket = -1;
+      bucket_bytes = 0;
+    }
+  }
+  PublishBatch(std::move(out));
+}
+
+void Core::PublishBatch(std::vector<Response> responses) {
+  if (responses.empty()) return;
+  ResponseBatch batch;
+  std::vector<std::string> names;
+  for (auto& resp : responses) {
+    const char* phase = resp.type == ResponseType::kAllreduce ? "ALLREDUCE"
+                        : resp.type == ResponseType::kAllgather ? "ALLGATHER"
+                        : resp.type == ResponseType::kBroadcast ? "BROADCAST"
+                        : resp.type == ResponseType::kAlltoall ? "ALLTOALL"
+                        : resp.type == ResponseType::kAdasum   ? "ADASUM"
+                        : resp.type == ResponseType::kJoin     ? "JOIN"
+                                                               : "ERROR";
+    if (resp.type != ResponseType::kError &&
+        resp.type != ResponseType::kJoin) {
+      for (const auto& e : resp.entries) {
+        timeline_.Begin(e.name, phase);
+        names.push_back(e.name);
+      }
+    }
+    batch.responses.push_back(std::move(resp));
+  }
+  {
+    std::lock_guard<std::mutex> lock(out_mu_);
+    batch.batch_id = next_batch_id_++;
+    if (!names.empty()) in_flight_[batch.batch_id] = std::move(names);
+    out_queue_.push_back(batch.Encode());
+  }
+  out_cv_.notify_one();
+}
+
+void Core::CheckStalls() {
+  auto now = Clock::now();
+  for (auto& kv : table_) {
+    NameEntry& entry = kv.second;
+    double age =
+        std::chrono::duration<double>(now - entry.first_ts).count();
+    if (age > config_.stall_warning_sec && !entry.stall_warned) {
+      std::ostringstream ready, missing;
+      ready << "[";
+      bool first = true;
+      for (const auto& r : entry.requests) {
+        ready << (first ? "" : ", ") << r.first;
+        first = false;
+      }
+      ready << "]";
+      missing << "[";
+      first = true;
+      for (int32_t r = 0; r < config_.size; ++r) {
+        if (!entry.requests.count(r) && !joined_view_.count(r)) {
+          missing << (first ? "" : ", ") << r;
+          first = false;
+        }
+      }
+      missing << "]";
+      HVD_LOG(Warning)
+          << "One or more tensors were submitted to be reduced, gathered or "
+             "broadcasted by subset of ranks and are waiting for remainder "
+             "of ranks for more than "
+          << static_cast<int>(config_.stall_warning_sec)
+          << "s. Stalled tensor: " << kv.first
+          << " ready ranks: " << ready.str()
+          << ", waiting on: " << missing.str();
+      entry.stall_warned = true;
+    }
+    if (config_.stall_shutdown_sec > 0 && age > config_.stall_shutdown_sec) {
+      std::string message = "stalled tensor '" + kv.first +
+                            "' exceeded shutdown threshold of " +
+                            std::to_string(config_.stall_shutdown_sec) + "s";
+      HVD_LOG(Error) << message;
+      {
+        std::lock_guard<std::mutex> lock(state_mu_);
+        shutdown_error_ = message;
+      }
+      FailAllPending(message);
+      return;
+    }
+  }
+}
+
+void Core::FailAllPending(const std::string& message) {
+  std::vector<Response> errors;
+  for (auto& kv : table_) {
+    Response resp;
+    resp.type = ResponseType::kError;
+    resp.error = message;
+    ResponseEntry re;
+    re.name = kv.first;
+    for (const auto& r : kv.second.requests) {
+      re.ranks.push_back(r.first);
+      re.req_ids.push_back(r.second.req_id);
+    }
+    resp.entries.push_back(std::move(re));
+    errors.push_back(std::move(resp));
+  }
+  table_.clear();
+  for (Request& req : tensor_queue_.Drain()) {
+    Response resp;
+    resp.type = ResponseType::kError;
+    resp.error = message;
+    ResponseEntry re;
+    re.name = req.name;
+    re.ranks.push_back(req.rank);
+    re.req_ids.push_back(req.req_id);
+    resp.entries.push_back(std::move(re));
+    errors.push_back(std::move(resp));
+  }
+  if (!errors.empty()) PublishBatch(std::move(errors));
+}
+
+}  // namespace hvd

@@ -1,0 +1,182 @@
+// The native coordination core: background cycle loop + negotiation.
+//
+// TPU-native re-design of the reference's core runtime
+// (horovod/common/operations.cc BackgroundThreadLoop/RunLoopOnce,
+// controller.cc ComputeResponseList/FuseResponses, tensor_queue.cc,
+// response_cache.cc, stall_inspector.cc).  Differences by design:
+//
+// - Tensor data never crosses into this layer.  Rank threads enqueue
+//   METADATA requests; the core negotiates readiness, validates cross-rank
+//   agreement, fuses compatible allreduces into buckets, and publishes
+//   ResponseBatches.  A Python dispatcher thread (blocked in NextBatch with
+//   the GIL released) executes each batch as ONE compiled XLA program over
+//   the device mesh and reports completion via MarkDone.
+// - The reference's network control plane (MPI gather/bcast of request
+//   lists) collapses to a process-local table in single-process mode; the
+//   TCP controller (multi-process mode) reuses this same negotiation code
+//   with a socket transport underneath.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <list>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+#include "timeline.h"
+
+namespace hvd {
+
+using Clock = std::chrono::steady_clock;
+
+// ---------------------------------------------------------------------------
+// TensorQueue: producer side of the coordination loop (reference:
+// horovod/common/tensor_queue.{h,cc} — mutex-protected FIFO of pending
+// requests, drained once per cycle).
+class TensorQueue {
+ public:
+  void Push(Request req) {
+    std::lock_guard<std::mutex> lock(mu_);
+    queue_.push_back(std::move(req));
+  }
+  std::vector<Request> Drain() {
+    std::lock_guard<std::mutex> lock(mu_);
+    std::vector<Request> out(std::make_move_iterator(queue_.begin()),
+                             std::make_move_iterator(queue_.end()));
+    queue_.clear();
+    return out;
+  }
+  size_t Size() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return queue_.size();
+  }
+
+ private:
+  std::mutex mu_;
+  std::deque<Request> queue_;
+};
+
+// ---------------------------------------------------------------------------
+// ResponseCache: steady-state signature cache (reference:
+// horovod/common/response_cache.{h,cc}).  In the reference a cache hit lets
+// workers skip the coordinator round trip by agreeing on cached bit
+// positions.  Here the position list plays the same role for the TCP
+// controller's bitvector fast path, and hit statistics feed autotuning.
+class ResponseCache {
+ public:
+  enum class State { kMiss, kHit, kInvalid };
+
+  explicit ResponseCache(size_t capacity) : capacity_(capacity) {}
+
+  // Classify a request against the cached signature for its name.
+  State Lookup(const Request& req) const;
+  // Record the signature of an executed response; evicts LRU beyond
+  // capacity.  Returns the cache bit position assigned to this name.
+  int Put(const Request& req);
+  void Invalidate(const std::string& name);
+  size_t size() const { return entries_.size(); }
+  uint64_t hits() const { return hits_; }
+  uint64_t misses() const { return misses_; }
+
+ private:
+  struct Signature {
+    RequestType type;
+    DataType dtype;
+    std::vector<int64_t> shape;
+    ReduceOp op;
+    int32_t root_rank;
+    double prescale, postscale;
+    int bit;  // stable position for cross-rank bitvector agreement
+  };
+  bool Matches(const Signature& sig, const Request& req) const;
+
+  size_t capacity_;
+  mutable uint64_t hits_ = 0;
+  mutable uint64_t misses_ = 0;
+  int next_bit_ = 0;
+  std::list<std::string> lru_;  // front = most recent
+  std::unordered_map<std::string,
+                     std::pair<Signature, std::list<std::string>::iterator>>
+      entries_;
+};
+
+// ---------------------------------------------------------------------------
+// Core: the background coordination loop.
+class Core {
+ public:
+  explicit Core(const CoreConfig& config);
+  ~Core();
+
+  void Start();
+  void Shutdown();
+
+  // Producer API (rank threads, via the C boundary).  Returns false with
+  // *error set if the core is shut down or in a stall-shutdown state.
+  bool Enqueue(const uint8_t* data, size_t len, std::string* error);
+  void Join(int32_t rank, uint64_t req_id);
+
+  // Dispatcher API.  NextBatch blocks until work or shutdown.
+  std::vector<uint8_t> NextBatch();
+  void MarkDone(uint64_t batch_id, const char* error_or_null);
+
+  // Introspection (tests, autotune).
+  uint64_t cache_hits() const { return cache_.hits(); }
+  uint64_t cache_misses() const { return cache_.misses(); }
+  size_t cache_size() const { return cache_.size(); }
+
+ private:
+  struct NameEntry {
+    Clock::time_point first_ts;
+    RequestType type;
+    std::map<int32_t, Request> requests;  // rank -> request
+    bool stall_warned = false;
+  };
+
+  void BackgroundLoop();
+  void RunCycle();
+  // Validate cross-rank agreement and build an (unfused) response
+  // (reference: controller.cc:378 ConstructResponse).
+  Response ConstructResponse(const std::string& name, NameEntry& entry);
+  // Bucket compatible allreduces under the fusion threshold (reference:
+  // controller.cc:640 FuseResponses).
+  void FuseAndPublish(std::vector<Response> ready);
+  void PublishBatch(std::vector<Response> responses);
+  void CheckStalls();
+  void FailAllPending(const std::string& message);
+
+  CoreConfig config_;
+  Timeline timeline_;
+  TensorQueue tensor_queue_;
+  ResponseCache cache_;
+
+  std::mutex state_mu_;
+  std::condition_variable wakeup_;
+  bool running_ = false;
+  std::string shutdown_error_;
+  std::set<int32_t> joined_;
+  std::vector<int32_t> join_order_;
+  std::map<int32_t, uint64_t> join_req_ids_;
+  std::thread bg_thread_;
+
+  // Coordinator-thread-only state.
+  std::vector<std::pair<std::string, NameEntry>> table_;  // arrival order
+  std::set<int32_t> joined_view_;
+
+  // Completion queue toward the dispatcher.
+  std::mutex out_mu_;
+  std::condition_variable out_cv_;
+  std::deque<std::vector<uint8_t>> out_queue_;
+  uint64_t next_batch_id_ = 1;
+  std::unordered_map<uint64_t, std::vector<std::string>> in_flight_;
+};
+
+}  // namespace hvd

@@ -1,0 +1,112 @@
+#include "message.h"
+
+namespace hvd {
+
+void Request::Encode(Writer* w) const {
+  w->U64(req_id);
+  w->I32(rank);
+  w->U8(static_cast<uint8_t>(type));
+  w->U8(static_cast<uint8_t>(op));
+  w->U8(static_cast<uint8_t>(dtype));
+  w->I32(root_rank);
+  w->F64(prescale);
+  w->F64(postscale);
+  w->Str(name);
+  w->U32(static_cast<uint32_t>(shape.size()));
+  for (int64_t d : shape) w->I64(d);
+  w->U32(static_cast<uint32_t>(splits.size()));
+  for (int64_t s : splits) w->I64(s);
+}
+
+Request Request::Decode(Reader* r) {
+  Request q;
+  q.req_id = r->U64();
+  q.rank = r->I32();
+  q.type = static_cast<RequestType>(r->U8());
+  q.op = static_cast<ReduceOp>(r->U8());
+  q.dtype = static_cast<DataType>(r->U8());
+  q.root_rank = r->I32();
+  q.prescale = r->F64();
+  q.postscale = r->F64();
+  q.name = r->Str();
+  uint32_t nd = r->U32();
+  for (uint32_t i = 0; i < nd; ++i) q.shape.push_back(r->I64());
+  uint32_t ns = r->U32();
+  for (uint32_t i = 0; i < ns; ++i) q.splits.push_back(r->I64());
+  return q;
+}
+
+void ResponseEntry::Encode(Writer* w) const {
+  w->Str(name);
+  w->U32(static_cast<uint32_t>(ranks.size()));
+  for (size_t i = 0; i < ranks.size(); ++i) {
+    w->I32(ranks[i]);
+    w->U64(req_ids[i]);
+  }
+  w->U32(static_cast<uint32_t>(joined.size()));
+  for (int32_t j : joined) w->I32(j);
+  w->I32(root_rank);
+}
+
+ResponseEntry ResponseEntry::Decode(Reader* r) {
+  ResponseEntry e;
+  e.name = r->Str();
+  uint32_t n = r->U32();
+  for (uint32_t i = 0; i < n; ++i) {
+    e.ranks.push_back(r->I32());
+    e.req_ids.push_back(r->U64());
+  }
+  uint32_t nj = r->U32();
+  for (uint32_t i = 0; i < nj; ++i) e.joined.push_back(r->I32());
+  e.root_rank = r->I32();
+  return e;
+}
+
+void Response::Encode(Writer* w) const {
+  w->U8(static_cast<uint8_t>(type));
+  w->U8(static_cast<uint8_t>(op));
+  w->U8(static_cast<uint8_t>(dtype));
+  w->F64(prescale);
+  w->F64(postscale);
+  w->Str(error);
+  w->U32(static_cast<uint32_t>(entries.size()));
+  for (const auto& e : entries) e.Encode(w);
+}
+
+Response Response::Decode(Reader* r) {
+  Response resp;
+  resp.type = static_cast<ResponseType>(r->U8());
+  resp.op = static_cast<ReduceOp>(r->U8());
+  resp.dtype = static_cast<DataType>(r->U8());
+  resp.prescale = r->F64();
+  resp.postscale = r->F64();
+  resp.error = r->Str();
+  uint32_t n = r->U32();
+  for (uint32_t i = 0; i < n; ++i) {
+    resp.entries.push_back(ResponseEntry::Decode(r));
+  }
+  return resp;
+}
+
+std::vector<uint8_t> ResponseBatch::Encode() const {
+  Writer w;
+  w.U64(batch_id);
+  w.U8(shutdown ? 1 : 0);
+  w.U32(static_cast<uint32_t>(responses.size()));
+  for (const auto& resp : responses) resp.Encode(&w);
+  return w.data();
+}
+
+ResponseBatch ResponseBatch::Decode(const uint8_t* data, size_t len) {
+  Reader r(data, len);
+  ResponseBatch b;
+  b.batch_id = r.U64();
+  b.shutdown = r.U8() != 0;
+  uint32_t n = r.U32();
+  for (uint32_t i = 0; i < n; ++i) {
+    b.responses.push_back(Response::Decode(&r));
+  }
+  return b;
+}
+
+}  // namespace hvd

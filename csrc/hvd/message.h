@@ -1,0 +1,144 @@
+// Wire messages: what ranks ask for and what the coordinator answers.
+//
+// TPU-native analog of the reference's Request/Response message classes and
+// FlatBuffers schema (reference: horovod/common/message.{h,cc},
+// horovod/common/wire/message.fbs).  Serialization is a compact hand-rolled
+// little-endian codec (wire.h-style length-prefixed fields) shared by the
+// C-API boundary (core <-> Python dispatcher) and the TCP controller
+// transport, so one format serves both the in-process and the
+// cross-process paths.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvd {
+
+// ------------------------------------------------------------------- codec
+class Writer {
+ public:
+  void U8(uint8_t v) { buf_.push_back(v); }
+  void I32(int32_t v) { Raw(&v, 4); }
+  void U32(uint32_t v) { Raw(&v, 4); }
+  void U64(uint64_t v) { Raw(&v, 8); }
+  void I64(int64_t v) { Raw(&v, 8); }
+  void F64(double v) { Raw(&v, 8); }
+  void Str(const std::string& s) {
+    U32(static_cast<uint32_t>(s.size()));
+    Raw(s.data(), s.size());
+  }
+  const std::vector<uint8_t>& data() const { return buf_; }
+
+ private:
+  void Raw(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    buf_.insert(buf_.end(), b, b + n);
+  }
+  std::vector<uint8_t> buf_;
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t len) : p_(data), end_(data + len) {}
+  uint8_t U8() { return *Take(1); }
+  int32_t I32() { int32_t v; memcpy(&v, Take(4), 4); return v; }
+  uint32_t U32() { uint32_t v; memcpy(&v, Take(4), 4); return v; }
+  uint64_t U64() { uint64_t v; memcpy(&v, Take(8), 8); return v; }
+  int64_t I64() { int64_t v; memcpy(&v, Take(8), 8); return v; }
+  double F64() { double v; memcpy(&v, Take(8), 8); return v; }
+  std::string Str() {
+    uint32_t n = U32();
+    const uint8_t* p = Take(n);
+    return std::string(reinterpret_cast<const char*>(p), n);
+  }
+  bool ok() const { return ok_; }
+
+ private:
+  const uint8_t* Take(size_t n) {
+    if (p_ + n > end_) {
+      ok_ = false;
+      static uint8_t zeros[8] = {0};
+      return zeros;
+    }
+    const uint8_t* out = p_;
+    p_ += n;
+    return out;
+  }
+  const uint8_t* p_;
+  const uint8_t* end_;
+  bool ok_ = true;
+};
+
+// ----------------------------------------------------------------- messages
+// One rank's announcement that a named tensor is ready (reference:
+// message.h:47 Request).
+struct Request {
+  uint64_t req_id = 0;
+  int32_t rank = 0;
+  RequestType type = RequestType::kAllreduce;
+  ReduceOp op = ReduceOp::kSum;
+  DataType dtype = DataType::kFloat32;
+  int32_t root_rank = -1;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::string name;
+  std::vector<int64_t> shape;
+  std::vector<int64_t> splits;
+
+  int64_t NumElements() const {
+    int64_t n = 1;
+    for (int64_t d : shape) n *= d;
+    return n;
+  }
+  int64_t ByteSize() const {
+    return NumElements() * static_cast<int64_t>(DataTypeSize(dtype));
+  }
+
+  void Encode(Writer* w) const;
+  static Request Decode(Reader* r);
+};
+
+// One fused group entry: a named tensor with the per-rank request ids the
+// dispatcher uses to look tensors up (reference: TensorTableEntry).
+struct ResponseEntry {
+  std::string name;
+  std::vector<int32_t> ranks;      // ranks that submitted
+  std::vector<uint64_t> req_ids;   // parallel to ranks
+  std::vector<int32_t> joined;     // ranks substituted with zeros
+  int32_t root_rank = -1;
+
+  void Encode(Writer* w) const;
+  static ResponseEntry Decode(Reader* r);
+};
+
+// A fused bucket: one XLA program's worth of work (reference: message.h:132
+// Response after FuseResponses).
+struct Response {
+  ResponseType type = ResponseType::kAllreduce;
+  ReduceOp op = ReduceOp::kSum;
+  DataType dtype = DataType::kFloat32;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::string error;  // for kError: applies to every entry
+  std::vector<ResponseEntry> entries;
+  int64_t fused_bytes = 0;  // fusion accounting only; not serialized
+
+  void Encode(Writer* w) const;
+  static Response Decode(Reader* r);
+};
+
+// What the dispatcher receives per wakeup (reference: ResponseList with
+// shutdown flag).
+struct ResponseBatch {
+  uint64_t batch_id = 0;
+  bool shutdown = false;
+  std::vector<Response> responses;
+
+  std::vector<uint8_t> Encode() const;
+  static ResponseBatch Decode(const uint8_t* data, size_t len);
+};
+
+}  // namespace hvd

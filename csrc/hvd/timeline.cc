@@ -1,0 +1,119 @@
+#include "timeline.h"
+
+#include <cinttypes>
+
+namespace hvd {
+
+void Timeline::Open(const std::string& path, bool mark_cycles) {
+  if (path.empty()) return;
+  file_ = fopen(path.c_str(), "w");
+  if (!file_) return;
+  mark_cycles_ = mark_cycles;
+  start_ = std::chrono::steady_clock::now();
+  fputs("[\n", file_);
+  running_ = true;
+  writer_ = std::thread(&Timeline::WriterLoop, this);
+}
+
+int64_t Timeline::NowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - start_)
+      .count();
+}
+
+int Timeline::Pid(const std::string& tensor) {
+  auto it = pids_.find(tensor);
+  if (it != pids_.end()) return it->second;
+  int pid = next_pid_++;
+  pids_[tensor] = pid;
+  char buf[512];
+  snprintf(buf, sizeof(buf),
+           "{\"name\": \"process_name\", \"ph\": \"M\", \"pid\": %d, "
+           "\"args\": {\"name\": \"%s\"}}",
+           pid, tensor.c_str());
+  Enqueue(buf);
+  return pid;
+}
+
+void Timeline::Begin(const std::string& tensor, const std::string& phase) {
+  if (!enabled()) return;
+  int pid = Pid(tensor);
+  char buf[512];
+  snprintf(buf, sizeof(buf),
+           "{\"name\": \"%s\", \"ph\": \"B\", \"ts\": %" PRId64
+           ", \"pid\": %d, \"tid\": 0}",
+           phase.c_str(), NowUs(), pid);
+  Enqueue(buf);
+}
+
+void Timeline::End(const std::string& tensor) {
+  if (!enabled()) return;
+  int pid = Pid(tensor);
+  char buf[256];
+  snprintf(buf, sizeof(buf),
+           "{\"ph\": \"E\", \"ts\": %" PRId64 ", \"pid\": %d, \"tid\": 0}",
+           NowUs(), pid);
+  Enqueue(buf);
+}
+
+void Timeline::Instant(const std::string& tensor, const std::string& name) {
+  if (!enabled()) return;
+  int pid = Pid(tensor);
+  char buf[512];
+  snprintf(buf, sizeof(buf),
+           "{\"name\": \"%s\", \"ph\": \"i\", \"ts\": %" PRId64
+           ", \"pid\": %d, \"tid\": 0, \"s\": \"p\"}",
+           name.c_str(), NowUs(), pid);
+  Enqueue(buf);
+}
+
+void Timeline::MarkCycle() {
+  if (!enabled() || !mark_cycles_) return;
+  int pid = Pid("CYCLE");
+  char buf[256];
+  snprintf(buf, sizeof(buf),
+           "{\"name\": \"CYCLE\", \"ph\": \"i\", \"ts\": %" PRId64
+           ", \"pid\": %d, \"tid\": 0, \"s\": \"g\"}",
+           NowUs(), pid);
+  Enqueue(buf);
+}
+
+void Timeline::Enqueue(std::string record) {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    queue_.push_back(std::move(record));
+  }
+  cv_.notify_one();
+}
+
+void Timeline::WriterLoop() {
+  std::unique_lock<std::mutex> lock(mu_);
+  while (true) {
+    cv_.wait(lock, [&] { return !queue_.empty() || !running_; });
+    while (!queue_.empty()) {
+      std::string rec = std::move(queue_.front());
+      queue_.pop_front();
+      lock.unlock();
+      if (!first_record_) fputs(",\n", file_);
+      first_record_ = false;
+      fputs(rec.c_str(), file_);
+      lock.lock();
+    }
+    if (!running_ && queue_.empty()) return;
+  }
+}
+
+void Timeline::Close() {
+  if (!file_) return;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    running_ = false;
+  }
+  cv_.notify_one();
+  if (writer_.joinable()) writer_.join();
+  fputs("\n]\n", file_);
+  fclose(file_);
+  file_ = nullptr;
+}
+
+}  // namespace hvd

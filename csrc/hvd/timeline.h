@@ -1,0 +1,56 @@
+// Chrome-tracing timeline writer with a dedicated IO thread.
+//
+// Native analog of the reference Timeline (horovod/common/timeline.{h,cc}):
+// per-tensor trace rows (pid per tensor name), NEGOTIATE_* phases with
+// per-rank ready ticks, op phases, cycle markers; a writer thread drains a
+// queue so the coordination loop never blocks on file IO (the reference uses
+// a boost lockfree SPSC queue; a mutex+cv deque serves the same contract
+// here, with the enqueue path O(1) and non-blocking in the common case).
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+namespace hvd {
+
+class Timeline {
+ public:
+  Timeline() = default;
+  ~Timeline() { Close(); }
+
+  void Open(const std::string& path, bool mark_cycles);
+  bool enabled() const { return file_ != nullptr; }
+
+  void Begin(const std::string& tensor, const std::string& phase);
+  void End(const std::string& tensor);
+  void Instant(const std::string& tensor, const std::string& name);
+  void MarkCycle();
+  void Close();
+
+ private:
+  int64_t NowUs() const;
+  int Pid(const std::string& tensor);  // registers metadata on first use
+  void Enqueue(std::string record);
+  void WriterLoop();
+
+  FILE* file_ = nullptr;
+  bool mark_cycles_ = false;
+  std::chrono::steady_clock::time_point start_;
+  std::unordered_map<std::string, int> pids_;
+  int next_pid_ = 1;
+  bool first_record_ = true;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  bool running_ = false;
+  std::thread writer_;
+};
+
+}  // namespace hvd

@@ -73,19 +73,21 @@ def init(comm=None, controller=None):
         from horovod_tpu.ops.xla_executor import XlaExecutor
         executor = XlaExecutor(devices)
 
-        timeline = Timeline(config.timeline_path,
-                            config.timeline_mark_cycles)
-
+        timeline = None
         impl = None
         if config.controller == "native":
             try:
                 from horovod_tpu.ops.native_controller import NativeController
-                impl = NativeController(topology, executor, timeline, config)
+                impl = NativeController(topology, executor, None, config)
+                # the native core writes the timeline itself
+                timeline = Timeline(None)
             except (ImportError, OSError) as exc:
                 get_logger().debug(
                     "native core unavailable (%s); falling back to the "
                     "python controller", exc)
         if impl is None:
+            timeline = Timeline(config.timeline_path,
+                                config.timeline_mark_cycles)
             if topology.size > len(devices):
                 raise RuntimeError(
                     f"topology spans {topology.size} ranks but only "

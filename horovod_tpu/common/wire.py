@@ -1,0 +1,102 @@
+"""Binary wire codec, the Python half of ``csrc/hvd/message.{h,cc}``.
+
+Little-endian, length-prefixed strings; layout must match the C++
+Writer/Reader exactly (the reference uses FlatBuffers for the same role:
+``horovod/common/wire/message.fbs``).
+"""
+
+import struct
+
+import numpy as np
+
+# numpy dtype name -> hvd::DataType code
+DTYPE_CODES = {
+    "float32": 0,
+    "float64": 1,
+    "bfloat16": 2,
+    "float16": 3,
+    "int8": 4,
+    "int16": 5,
+    "int32": 6,
+    "int64": 7,
+    "uint8": 8,
+    "bool": 9,
+}
+
+
+def dtype_code(dtype) -> int:
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = str(dtype)
+    return DTYPE_CODES[name]
+
+
+def encode_request(req_id, rank, req_type, op, dtype, root_rank, prescale,
+                   postscale, name, shape, splits):
+    name_bytes = name.encode()
+    parts = [
+        struct.pack("<QiBBBidd", req_id, rank, int(req_type), int(op),
+                    dtype_code(dtype) if dtype is not None else 0,
+                    root_rank, prescale, postscale),
+        struct.pack("<I", len(name_bytes)),
+        name_bytes,
+        struct.pack("<I", len(shape)),
+        struct.pack(f"<{len(shape)}q", *shape) if shape else b"",
+        struct.pack("<I", len(splits or [])),
+        struct.pack(f"<{len(splits)}q", *splits) if splits else b"",
+    ]
+    return b"".join(parts)
+
+
+class _Reader:
+    def __init__(self, buf):
+        self.buf = buf
+        self.off = 0
+
+    def take(self, fmt):
+        vals = struct.unpack_from("<" + fmt, self.buf, self.off)
+        self.off += struct.calcsize("<" + fmt)
+        return vals if len(vals) > 1 else vals[0]
+
+    def string(self):
+        n = self.take("I")
+        s = self.buf[self.off:self.off + n].decode()
+        self.off += n
+        return s
+
+
+def decode_batch(buf):
+    """Decode a ResponseBatch -> (batch_id, shutdown, responses).
+
+    Each response is a dict with type/op/dtype/prescale/postscale/error and
+    entries of (name, [(rank, req_id)...], joined_ranks, root_rank).
+    """
+    r = _Reader(buf)
+    batch_id = r.take("Q")
+    shutdown = bool(r.take("B"))
+    responses = []
+    for _ in range(r.take("I")):
+        resp_type = r.take("B")
+        op = r.take("B")
+        dtype = r.take("B")
+        prescale = r.take("d")
+        postscale = r.take("d")
+        error = r.string()
+        entries = []
+        for _ in range(r.take("I")):
+            name = r.string()
+            parts = []
+            for _ in range(r.take("I")):
+                rank = r.take("i")
+                req_id = r.take("Q")
+                parts.append((rank, req_id))
+            joined = [r.take("i") for _ in range(r.take("I"))]
+            root_rank = r.take("i")
+            entries.append((name, parts, joined, root_rank))
+        responses.append({
+            "type": resp_type, "op": op, "dtype": dtype,
+            "prescale": prescale, "postscale": postscale,
+            "error": error, "entries": entries,
+        })
+    return batch_id, shutdown, responses

@@ -1,0 +1,241 @@
+"""Native controller: ctypes binding over the C++ coordination core.
+
+The background cycle loop, tensor queue, negotiation, response cache, fusion
+planning, stall inspection and timeline live in ``csrc/hvd`` (the reference
+keeps the same responsibilities in C++: ``horovod/common/operations.cc``,
+``controller.cc``).  This module is the thin producer/dispatcher glue:
+
+- rank threads encode metadata requests and hand them to the core
+  (``hvd_core_enqueue``); tensors and completion handles stay Python-side,
+  keyed by request id;
+- one dispatcher thread blocks in ``hvd_core_next_batch`` (GIL released by
+  ctypes) and executes each fused ResponseBatch as compiled XLA programs via
+  the shared :class:`XlaExecutor`, then reports ``hvd_core_mark_done`` so the
+  core can close timeline spans and maintain its cache.
+"""
+
+import ctypes
+import itertools
+import os
+import threading
+
+from horovod_tpu.common import wire
+from horovod_tpu.common.ops_enum import ReduceOp, ResponseType
+from horovod_tpu.ops.python_controller import GroupEntry
+from horovod_tpu.utils.logging import get_logger
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                         "lib", "libhvdcore.so")
+
+
+def _load_lib():
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.hvd_core_create.restype = ctypes.c_void_p
+    lib.hvd_core_create.argtypes = [ctypes.c_int]
+    lib.hvd_core_start.argtypes = [ctypes.c_void_p]
+    lib.hvd_core_shutdown.argtypes = [ctypes.c_void_p]
+    lib.hvd_core_destroy.argtypes = [ctypes.c_void_p]
+    lib.hvd_core_enqueue.restype = ctypes.c_int
+    lib.hvd_core_enqueue.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ctypes.c_size_t]
+    lib.hvd_core_join.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_uint64]
+    lib.hvd_core_next_batch.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.hvd_core_next_batch.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_size_t)]
+    lib.hvd_core_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.hvd_core_mark_done.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                       ctypes.c_char_p]
+    for fn in ("hvd_core_cache_hits", "hvd_core_cache_misses",
+               "hvd_core_cache_size"):
+        getattr(lib, fn).restype = ctypes.c_uint64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class NativeController:
+    def __init__(self, topology, executor, timeline, config):
+        del timeline  # the core writes the timeline itself
+        self._topo = topology
+        self._executor = executor
+        self._config = config
+        self._lib = _load_lib()
+        self._core = self._lib.hvd_core_create(topology.size)
+        self._pending = {}   # req_id -> (EagerRequest-ish record)
+        self._joins = {}     # req_id -> handle
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._thread = None
+        self._running = False
+        self._log = get_logger()
+
+    # ----------------------------------------------------------- producer API
+    def start(self):
+        self._running = True
+        self._lib.hvd_core_start(self._core)
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        daemon=True, name="hvd-dispatcher")
+        self._thread.start()
+
+    def enqueue(self, request):
+        req_id = next(self._ids)
+        tensor = request.tensor
+        shape = [] if tensor is None else [int(d) for d in tensor.shape]
+        payload = wire.encode_request(
+            req_id=req_id, rank=request.rank, req_type=int(request.req_type),
+            op=int(request.op),
+            dtype=None if tensor is None else tensor.dtype,
+            root_rank=request.root_rank, prescale=request.prescale_factor,
+            postscale=request.postscale_factor, name=request.name,
+            shape=shape, splits=request.splits or [])
+        with self._lock:
+            self._pending[req_id] = request
+        err = ctypes.create_string_buffer(1024)
+        rc = self._lib.hvd_core_enqueue(self._core, payload, len(payload),
+                                        err, len(err))
+        if rc != 0:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            request.handle.set_error(err.value.decode() or "enqueue failed")
+
+    def join(self, rank, handle):
+        req_id = next(self._ids)
+        with self._lock:
+            self._joins[req_id] = handle
+        self._lib.hvd_core_join(self._core, rank, req_id)
+
+    def shutdown(self):
+        if not self._running:
+            return
+        self._running = False
+        self._lib.hvd_core_shutdown(self._core)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+            joins = list(self._joins.values())
+            self._joins.clear()
+        for request in pending:
+            request.handle.set_error("horovod_tpu has been shut down")
+        for handle in joins:
+            handle.set_error("horovod_tpu has been shut down")
+        self._lib.hvd_core_destroy(self._core)
+        self._core = None
+
+    # ------------------------------------------------------------- statistics
+    def cache_stats(self):
+        return {
+            "hits": int(self._lib.hvd_core_cache_hits(self._core)),
+            "misses": int(self._lib.hvd_core_cache_misses(self._core)),
+            "size": int(self._lib.hvd_core_cache_size(self._core)),
+        }
+
+    # ------------------------------------------------------------- dispatcher
+    def _next_batch(self):
+        length = ctypes.c_size_t(0)
+        ptr = self._lib.hvd_core_next_batch(self._core, ctypes.byref(length))
+        try:
+            return bytes(ctypes.cast(
+                ptr, ctypes.POINTER(ctypes.c_uint8 * length.value)).contents)
+        finally:
+            self._lib.hvd_core_free(ptr)
+
+    def _dispatch_loop(self):
+        while True:
+            batch_id, is_shutdown, responses = wire.decode_batch(
+                self._next_batch())
+            if is_shutdown:
+                return
+            error = None
+            for resp in responses:
+                try:
+                    self._execute_response(resp)
+                except Exception as exc:  # noqa: BLE001 — surface on handles
+                    self._log.error("collective execution failed: %s", exc)
+                    error = str(exc)
+                    self._fail_response(resp,
+                                        f"collective execution failed: {exc}")
+            self._lib.hvd_core_mark_done(
+                self._core, batch_id,
+                error.encode() if error is not None else None)
+
+    def _take(self, req_id):
+        with self._lock:
+            return self._pending.pop(req_id, None)
+
+    def _fail_response(self, resp, message):
+        for _, parts, _, _ in resp["entries"]:
+            for _, req_id in parts:
+                request = self._take(req_id)
+                if request is not None:
+                    request.handle.set_error(message)
+
+    def _execute_response(self, resp):
+        rtype = ResponseType(resp["type"])
+
+        if rtype == ResponseType.ERROR:
+            self._fail_response(resp, resp["error"])
+            return
+
+        if rtype == ResponseType.JOIN:
+            _, parts, _, last_rank = resp["entries"][0]
+            with self._lock:
+                handles = [self._joins.pop(req_id, None)
+                           for _, req_id in parts]
+            for handle in handles:
+                if handle is not None:
+                    handle.set_result(last_rank)
+            return
+
+        groups = []
+        for name, parts, joined, root_rank in resp["entries"]:
+            requests = {}
+            for rank, req_id in parts:
+                request = self._take(req_id)
+                if request is None:
+                    raise RuntimeError(
+                        f"lost request {req_id} for tensor '{name}'")
+                requests[rank] = request
+            any_req = next(iter(requests.values()))
+            tensors = {self._local(rank): r.tensor
+                       for rank, r in requests.items()}
+            for rank in joined:
+                tensors[self._local(rank)] = None
+            groups.append(GroupEntry(
+                name=name, shape=tuple(any_req.tensor.shape),
+                dtype=any_req.tensor.dtype, tensors=tensors,
+                handles={self._local(rank): r.handle
+                         for rank, r in requests.items()},
+                root_rank=self._local(root_rank) if root_rank >= 0 else -1,
+                splits={self._local(rank): r.splits
+                        for rank, r in requests.items()},
+                op=ReduceOp(resp["op"]),
+                prescale_factor=resp["prescale"],
+                postscale_factor=resp["postscale"]))
+
+        if rtype in (ResponseType.ALLREDUCE,):
+            self._executor.allreduce_fused(
+                groups, op=ReduceOp(resp["op"]),
+                prescale_factor=resp["prescale"],
+                postscale_factor=resp["postscale"])
+        elif rtype == ResponseType.ADASUM:
+            for g in groups:
+                self._executor.adasum(g)
+        elif rtype == ResponseType.ALLGATHER:
+            for g in groups:
+                self._executor.allgather(g)
+        elif rtype == ResponseType.BROADCAST:
+            for g in groups:
+                self._executor.broadcast(g)
+        elif rtype == ResponseType.ALLTOALL:
+            for g in groups:
+                self._executor.alltoall(g)
+        else:
+            raise RuntimeError(f"unknown response type {rtype}")
+
+    def _local(self, global_rank):
+        """Global rank -> executor device index (identical in single-process
+        device mode; process mode uses the TCP data plane instead)."""
+        return global_rank % self._topo.local_size
