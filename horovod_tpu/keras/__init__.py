@@ -1,0 +1,14 @@
+"""Keras binding gate (reference: ``horovod/keras/__init__.py``).
+
+Requires TensorFlow/Keras, not present in this image; see
+``horovod_tpu.tensorflow``.
+"""
+
+try:
+    import tensorflow  # noqa: F401
+except ImportError as exc:  # pragma: no cover
+    raise ImportError(
+        "horovod_tpu.keras requires TensorFlow/Keras, which is not "
+        "installed in this environment. Use the JAX-native API "
+        "(horovod_tpu + flax) or horovod_tpu.torch instead."
+    ) from exc

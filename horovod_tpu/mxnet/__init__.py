@@ -1,0 +1,14 @@
+"""MXNet binding gate (reference: ``horovod/mxnet/__init__.py``).
+
+MXNet is not present in this image (and is EOL upstream); the binding
+surface (DistributedOptimizer update-hook, DistributedTrainer,
+broadcast_parameters) is covered by the torch and JAX bindings.
+"""
+
+try:
+    import mxnet  # noqa: F401
+except ImportError as exc:  # pragma: no cover
+    raise ImportError(
+        "horovod_tpu.mxnet requires MXNet, which is not installed in this "
+        "environment. Use horovod_tpu.torch or the JAX-native API instead."
+    ) from exc

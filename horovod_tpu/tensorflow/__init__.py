@@ -1,0 +1,16 @@
+"""TensorFlow binding (reference: ``horovod/tensorflow/__init__.py``).
+
+TensorFlow is not part of this image's environment; the binding is gated and
+raises a clear error on import.  The TF2 surface (DistributedGradientTape,
+DistributedOptimizer, broadcast_variables) maps onto the same core
+collectives the torch binding uses.
+"""
+
+try:
+    import tensorflow  # noqa: F401
+except ImportError as exc:  # pragma: no cover
+    raise ImportError(
+        "horovod_tpu.tensorflow requires TensorFlow, which is not installed "
+        "in this environment. The JAX-native API (horovod_tpu) and the "
+        "torch binding (horovod_tpu.torch) provide the same capabilities."
+    ) from exc

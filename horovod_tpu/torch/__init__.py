@@ -1,0 +1,54 @@
+"""PyTorch binding: drop-in ``hvd.*`` surface for torch users.
+
+Mirrors the reference torch API (``horovod/torch/__init__.py``,
+``horovod/torch/mpi_ops.py``): sync/async/in-place collective variants with
+integer handles, ``DistributedOptimizer`` with per-parameter gradient hooks,
+``broadcast_parameters`` / ``broadcast_optimizer_state``, ``join``,
+compression and ``SyncBatchNorm``.  Tensors bridge torch<->JAX via numpy
+(zero-copy on the torch CPU side); the collectives execute on the XLA data
+plane like every other binding.
+"""
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mpi_built,
+    gloo_built,
+    nccl_built,
+    xla_built,
+    mpi_enabled,
+    gloo_enabled,
+    xla_enabled,
+)
+from horovod_tpu.common.ops_enum import Average, Sum, Adasum  # noqa: F401
+from horovod_tpu.torch.compression import Compression  # noqa: F401
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    allreduce,
+    allreduce_async,
+    allreduce_,
+    allreduce_async_,
+    allgather,
+    allgather_async,
+    broadcast,
+    broadcast_async,
+    broadcast_,
+    broadcast_async_,
+    alltoall,
+    alltoall_async,
+    synchronize,
+    poll,
+    join,
+)
+from horovod_tpu.torch.optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    broadcast_parameters,
+    broadcast_optimizer_state,
+)
+from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401

@@ -1,0 +1,190 @@
+"""Torch collective ops with integer handles.
+
+Mirrors ``horovod/torch/mpi_ops.py``: every op has sync / async / in-place
+variants; async ops return integer handles resolved by ``synchronize`` /
+``poll`` through a HandleManager (reference: torch/handle_manager.cc).
+torch<->XLA staging goes through numpy; torch CPU tensors share memory with
+their numpy views, so the copies are torch-side only where semantically
+required (in-place variants).
+"""
+
+import numpy as np
+import torch
+
+import jax.numpy as jnp
+
+from horovod_tpu.common.handles import HandleManager
+from horovod_tpu.common.ops_enum import Adasum, Average, ReduceOp, Sum  # noqa: F401 — re-exported
+from horovod_tpu.ops import eager
+
+_handle_manager = HandleManager()
+
+# torch bool/bfloat16 need explicit numpy bridging
+_TORCH_NUMPY_FIXUPS = {
+    torch.bfloat16: torch.float32,
+}
+
+
+def _to_jax(tensor: torch.Tensor):
+    src = tensor.detach()
+    fixup = _TORCH_NUMPY_FIXUPS.get(src.dtype)
+    if fixup is not None:
+        arr = jnp.asarray(src.to(fixup).numpy()).astype(
+            str(src.dtype).replace("torch.", ""))
+    else:
+        arr = jnp.asarray(src.contiguous().numpy())
+    return arr
+
+
+def _to_torch(arr, like: torch.Tensor = None):
+    np_arr = np.asarray(arr)
+    if np_arr.dtype.name == "bfloat16":
+        out = torch.from_numpy(
+            np.array(arr.astype(jnp.float32), copy=True)).to(torch.bfloat16)
+    else:
+        # copy: jax exposes read-only buffers; torch tensors must be writable
+        out = torch.from_numpy(np.array(np_arr, copy=True))
+    if like is not None and out.dtype != like.dtype:
+        out = out.to(like.dtype)
+    return out
+
+
+class _TorchHandle:
+    __slots__ = ("core_handle", "finalize")
+
+    def __init__(self, core_handle, finalize):
+        self.core_handle = core_handle
+        self.finalize = finalize
+
+    def poll(self):
+        return self.core_handle.poll()
+
+    def wait(self, timeout=None):
+        result = self.core_handle.wait(timeout)
+        return self.finalize(result)
+
+
+def _register(core_handle, finalize) -> int:
+    return _handle_manager.allocate(_TorchHandle(core_handle, finalize))
+
+
+def synchronize(handle: int):
+    """Block until the async op completes and return the torch result
+    (reference: mpi_ops.synchronize)."""
+    return _handle_manager.wait(handle)
+
+
+def poll(handle: int) -> bool:
+    return _handle_manager.poll(handle)
+
+
+def join() -> int:
+    return eager.join()
+
+
+# -------------------------------------------------------------- allreduce ---
+def _allreduce_async_impl(tensor, name, op, prescale_factor,
+                          postscale_factor, compression, output_tensor):
+    from horovod_tpu.torch.compression import Compression
+
+    compression = compression or Compression.none
+    compressed, ctx = compression.compress(tensor)
+    core_handle = eager.allreduce_async(
+        _to_jax(compressed), name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+
+    def finalize(result):
+        out = compression.decompress(_to_torch(result, like=tensor), ctx)
+        if output_tensor is not None:
+            output_tensor.copy_(out.reshape(output_tensor.shape))
+            return output_tensor
+        return out
+
+    return _register(core_handle, finalize)
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    compression=None) -> int:
+    op = eager._resolve_op(op, average)
+    return _allreduce_async_impl(tensor, name, op, prescale_factor,
+                                 postscale_factor, compression, None)
+
+
+def allreduce(tensor, average=None, name=None, compression=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0):
+    return synchronize(allreduce_async(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        compression=compression))
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0) -> int:
+    """In-place variant: the result is copied back into ``tensor``."""
+    op = eager._resolve_op(op, average)
+    return _allreduce_async_impl(tensor, name, op, prescale_factor,
+                                 postscale_factor, None, tensor)
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0):
+    return synchronize(allreduce_async_(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor))
+
+
+# -------------------------------------------------------------- allgather ---
+def allgather_async(tensor, name=None) -> int:
+    core_handle = eager.allgather_async(_to_jax(tensor), name=name)
+    return _register(core_handle,
+                     lambda result: _to_torch(result, like=tensor))
+
+
+def allgather(tensor, name=None):
+    return synchronize(allgather_async(tensor, name=name))
+
+
+# -------------------------------------------------------------- broadcast ---
+def broadcast_async(tensor, root_rank, name=None) -> int:
+    core_handle = eager.broadcast_async(_to_jax(tensor), root_rank,
+                                        name=name)
+    return _register(core_handle,
+                     lambda result: _to_torch(result, like=tensor))
+
+
+def broadcast(tensor, root_rank, name=None):
+    return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+
+def broadcast_async_(tensor, root_rank, name=None) -> int:
+    core_handle = eager.broadcast_async(_to_jax(tensor), root_rank,
+                                        name=name)
+
+    def finalize(result):
+        tensor.copy_(_to_torch(result, like=tensor).reshape(tensor.shape))
+        return tensor
+
+    return _register(core_handle, finalize)
+
+
+def broadcast_(tensor, root_rank, name=None):
+    return synchronize(broadcast_async_(tensor, root_rank, name=name))
+
+
+# --------------------------------------------------------------- alltoall ---
+def alltoall_async(tensor, splits=None, name=None) -> int:
+    if splits is not None and torch.is_tensor(splits):
+        splits = splits.tolist()
+    core_handle = eager.alltoall_async(_to_jax(tensor), splits=splits,
+                                       name=name)
+
+    def finalize(result):
+        out, _recv_splits = result
+        return _to_torch(out, like=tensor)
+
+    return _register(core_handle, finalize)
+
+
+def alltoall(tensor, splits=None, name=None):
+    return synchronize(alltoall_async(tensor, splits=splits, name=name))

@@ -1,0 +1,196 @@
+"""DistributedOptimizer and parameter/state broadcast for torch.
+
+Mirrors ``horovod/torch/__init__.py``: the wrapper dynamically subclasses the
+user's optimizer class, registers per-parameter gradient hooks that launch
+asynchronous allreduces as gradients become ready (overlapping communication
+with the rest of backward), and ``step`` synchronizes before applying
+updates.  ``backward_passes_per_step`` delays the allreduce for local
+gradient accumulation.  The Adasum variant reduces post-step parameter
+deltas instead of gradients (reference: ``_DistributedAdasumOptimizer``,
+torch/__init__.py:225).
+"""
+
+import torch
+
+from horovod_tpu.common.ops_enum import Adasum, Average, ReduceOp
+from horovod_tpu.torch import mpi_ops
+from horovod_tpu.torch.compression import Compression
+
+
+class _DistributedOptimizerMixin:
+    def _hvd_init(self, named_parameters, compression,
+                  backward_passes_per_step, op, prescale_factor,
+                  postscale_factor):
+        self._compression = compression
+        self._op = op
+        self._backward_passes_per_step = backward_passes_per_step
+        self._prescale_factor = prescale_factor
+        self._postscale_factor = postscale_factor
+        self._handles = {}
+        self._grad_accs = []
+        self._requires_update = []
+        self._should_synchronize = True
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+            self._parameter_names = {v: k for k, v in named_parameters}
+        else:
+            self._parameter_names = {
+                v: f"allreduce.noname.{i}"
+                for param_group in self.param_groups
+                for i, v in enumerate(param_group["params"])
+            }
+        self._allreduce_delay = {}
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._requires_update.append(p)
+                    self._allreduce_delay[p] = backward_passes_per_step
+                    p.register_post_accumulate_grad_hook(self._make_hook())
+
+    def _make_hook(self):
+        def hook(p):
+            if p not in self._allreduce_delay:
+                return
+            self._allreduce_delay[p] -= 1
+            if self._allreduce_delay[p] == 0:
+                self._handles[p] = self._allreduce_grad_async(p)
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names.get(p, "allreduce.unnamed")
+        return mpi_ops._allreduce_async_impl(
+            p.grad, f"allreduce.{name}", self._op, self._prescale_factor,
+            self._postscale_factor, self._compression, p.grad)
+
+    def synchronize(self):
+        """Wait for all outstanding gradient allreduces (reference:
+        torch/__init__.py:165)."""
+        for p, handle in self._handles.items():
+            mpi_ops.synchronize(handle)
+            self._allreduce_delay[p] = self._backward_passes_per_step
+        self._handles.clear()
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            self.synchronize()
+        # skip past the mixin in the MRO to the wrapped optimizer's step
+        return super(_DistributedOptimizerMixin, self).step(closure)
+
+
+class _DistributedAdasumOptimizerMixin(_DistributedOptimizerMixin):
+    """Adasum optimizer: apply the local update, then Adasum-reduce the
+    parameter DELTAS so the combined step is scale-invariant."""
+
+    def _hvd_init(self, *args, **kwargs):
+        super()._hvd_init(*args, **kwargs)
+        # gradients are NOT reduced; deltas are
+        self._allreduce_delay = {}
+
+    def _make_hook(self):
+        def hook(p):
+            pass
+        return hook
+
+    def step(self, closure=None):
+        starting = {
+            p: p.detach().clone()
+            for group in self.param_groups for p in group["params"]
+            if p.grad is not None
+        }
+        loss = super(_DistributedOptimizerMixin, self).step(closure)
+        handles = []
+        for i, (p, start) in enumerate(starting.items()):
+            delta = p.detach() - start
+            name = self._parameter_names.get(p, f"delta.{i}")
+            handles.append((p, start,
+                            mpi_ops.allreduce_async(
+                                delta, name=f"adasum.{name}", op=Adasum)))
+        for p, start, handle in handles:
+            reduced = mpi_ops.synchronize(handle)
+            with torch.no_grad():
+                p.copy_(start + reduced.reshape(p.shape))
+        return loss
+
+    def synchronize(self):
+        pass
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1, op=Average,
+                         prescale_factor=1.0, postscale_factor=1.0):
+    """Wrap a torch optimizer so gradient exchange is transparent
+    (reference: horovod/torch/__init__.py:433 DistributedOptimizer)."""
+    op = ReduceOp(op)
+    mixin = (_DistributedAdasumOptimizerMixin if op == Adasum
+             else _DistributedOptimizerMixin)
+    cls = type(optimizer.__class__.__name__, (mixin, optimizer.__class__),
+               {})
+    optimizer.__class__ = cls
+    optimizer._hvd_init(named_parameters, compression,
+                        backward_passes_per_step, op, prescale_factor,
+                        postscale_factor)
+    return optimizer
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast parameters from root to all ranks, in place (reference:
+    torch/__init__.py:452).  Accepts a ``state_dict()`` or an iterable of
+    ``(name, tensor)``."""
+    if isinstance(params, dict):
+        params = sorted(params.items())
+    handles = []
+    for name, p in params:
+        if p is None or not torch.is_tensor(p):
+            continue
+        handles.append(mpi_ops.broadcast_async_(p, root_rank,
+                                                name=f"broadcast.{name}"))
+    for handle in handles:
+        mpi_ops.synchronize(handle)
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0):
+    """Broadcast optimizer state from root (reference:
+    torch/__init__.py:484).  Tensor state entries broadcast directly;
+    scalar entries (step counters, lr, ...) ride through 0-d tensors."""
+    state_dict = optimizer.state_dict()
+
+    scalars = {}
+    handles = []
+    for pid, state in state_dict.get("state", {}).items():
+        for key, value in state.items():
+            name = f"opt_state.{pid}.{key}"
+            if torch.is_tensor(value) and value.ndim > 0:
+                handles.append(
+                    mpi_ops.broadcast_async_(value, root_rank, name=name))
+            else:
+                scalar = value.item() if torch.is_tensor(value) else value
+                wrapped = torch.tensor([float(scalar)],
+                                       dtype=torch.float64)
+                out = mpi_ops.broadcast(wrapped, root_rank, name=name)
+                restored = out.item()
+                if isinstance(scalar, int):
+                    restored = int(restored)
+                scalars[(pid, key)] = (value, restored)
+
+    for handle in handles:
+        mpi_ops.synchronize(handle)
+
+    for (pid, key), (orig, restored) in scalars.items():
+        if torch.is_tensor(orig):
+            state_dict["state"][pid][key] = torch.tensor(
+                restored, dtype=orig.dtype)
+        else:
+            state_dict["state"][pid][key] = restored
+
+    for gi, group in enumerate(state_dict.get("param_groups", [])):
+        for key, value in group.items():
+            if isinstance(value, (int, float)) and not isinstance(value,
+                                                                  bool):
+                wrapped = torch.tensor([float(value)], dtype=torch.float64)
+                out = mpi_ops.broadcast(wrapped, root_rank,
+                                        name=f"opt_group.{gi}.{key}")
+                group[key] = type(value)(out.item())
+
+    optimizer.load_state_dict(state_dict)

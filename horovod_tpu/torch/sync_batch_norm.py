@@ -1,0 +1,101 @@
+"""Synchronized BatchNorm over all ranks (reference:
+``horovod/torch/sync_batch_norm.py:35`` — allgather of per-rank
+mean/var/count in forward, allreduced gradient statistics in backward).
+"""
+
+import torch
+import torch.nn.functional as F
+from torch.autograd.function import Function
+
+from horovod_tpu.common import basics
+from horovod_tpu.torch import mpi_ops
+
+
+class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
+    """Applies synchronized batch normalization: statistics are computed over
+    the global batch across every rank."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D input)")
+
+    def forward(self, input):
+        self._check_input_dim(input)
+        if not self.training or basics.size() == 1:
+            return F.batch_norm(
+                input, self.running_mean, self.running_var, self.weight,
+                self.bias, self.training, self.momentum, self.eps)
+        return _SyncBatchNormFn.apply(
+            input, self.weight, self.bias, self.running_mean,
+            self.running_var, self.eps, self.momentum)
+
+
+class _SyncBatchNormFn(Function):
+    @staticmethod
+    def forward(ctx, input, weight, bias, running_mean, running_var, eps,
+                momentum):
+        reduce_dims = [0] + list(range(2, input.dim()))
+        count = torch.tensor(
+            [input.numel() // input.size(1)], dtype=torch.float32)
+        mean = input.mean(dim=reduce_dims)
+        # biased variance for normalization
+        var = input.var(dim=reduce_dims, unbiased=False)
+
+        # gather [count, mean..., var...] from every rank in one op
+        packed = torch.cat([count, mean, var]).unsqueeze(0)
+        gathered = mpi_ops.allgather(packed, name="sync_batch_norm.stats")
+        counts = gathered[:, 0]
+        means = gathered[:, 1:1 + mean.numel()]
+        vars_ = gathered[:, 1 + mean.numel():]
+
+        total = counts.sum()
+        global_mean = (means * counts.unsqueeze(1)).sum(0) / total
+        # law of total variance
+        global_var = ((vars_ + (means - global_mean) ** 2)
+                      * counts.unsqueeze(1)).sum(0) / total
+        invstd = torch.rsqrt(global_var + eps)
+
+        if running_mean is not None:
+            unbiased = global_var * (total / (total - 1))
+            running_mean.mul_(1 - momentum).add_(global_mean * momentum)
+            running_var.mul_(1 - momentum).add_(unbiased * momentum)
+
+        shape = [1, -1] + [1] * (input.dim() - 2)
+        xhat = (input - global_mean.reshape(shape)) * invstd.reshape(shape)
+        out = xhat * weight.reshape(shape) + bias.reshape(shape)
+
+        ctx.save_for_backward(input, weight, global_mean, invstd, total)
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        input, weight, global_mean, invstd, total = ctx.saved_tensors
+        reduce_dims = [0] + list(range(2, input.dim()))
+        shape = [1, -1] + [1] * (input.dim() - 2)
+
+        xmu = input - global_mean.reshape(shape)
+        sum_dy = grad_output.sum(dim=reduce_dims)
+        sum_dy_xmu = (grad_output * xmu).sum(dim=reduce_dims)
+
+        # per-channel global sums across ranks
+        packed = torch.cat([sum_dy, sum_dy_xmu]).unsqueeze(0)
+        reduced = mpi_ops.allreduce(packed, op=mpi_ops.Sum,
+                                    name="sync_batch_norm.grad_stats")[0]
+        g_sum_dy = reduced[:sum_dy.numel()]
+        g_sum_dy_xmu = reduced[sum_dy.numel():]
+
+        w_invstd = (weight * invstd).reshape(shape)
+        grad_input = w_invstd * (
+            grad_output - (g_sum_dy.reshape(shape)
+                           + xmu * (invstd ** 2).reshape(shape)
+                           * g_sum_dy_xmu.reshape(shape)) / total)
+
+        grad_weight = sum_dy_xmu * invstd
+        grad_bias = sum_dy
+        return grad_input, grad_weight, grad_bias, None, None, None, None

@@ -1,0 +1,266 @@
+"""Torch binding tests (reference: test/test_torch.py — rank-parameterized
+collectives vs expectations, DistributedOptimizer training, broadcast of
+parameters/optimizer state, SyncBatchNorm vs full-batch BatchNorm)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu.torch as hvd  # noqa: E402
+from horovod_tpu.common import basics  # noqa: E402
+
+N = 8
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init(hvd_init):
+    # torch binding shares global state with the jax binding
+    hvd.init()
+
+
+def _per_rank(fn):
+    return basics.run_parallel(fn)
+
+
+def test_torch_allreduce_average():
+    data = [torch.full((3, 4), float(r)) for r in range(N)]
+    expected = torch.full((3, 4), float(sum(range(N))) / N)
+
+    def fn(r):
+        return hvd.allreduce(data[r], name="t.avg")
+
+    for out in _per_rank(fn):
+        assert torch.allclose(out, expected)
+        assert out.dtype == torch.float32
+
+
+def test_torch_allreduce_inplace_sum():
+    def fn(r):
+        t = torch.full((5,), float(r + 1))
+        hvd.allreduce_(t, op=hvd.Sum, name="t.sum")
+        return t
+
+    expected = torch.full((5,), float(sum(range(1, N + 1))))
+    for out in _per_rank(fn):
+        assert torch.allclose(out, expected)
+
+
+@pytest.mark.parametrize("dtype", [torch.float64, torch.int32,
+                                   torch.bfloat16])
+def test_torch_allreduce_dtypes(dtype):
+    def fn(r):
+        t = torch.ones((4,), dtype=dtype) * (r + 1)
+        return hvd.allreduce(t, op=hvd.Sum, name=f"t.{dtype}")
+
+    expected = float(sum(range(1, N + 1)))
+    for out in _per_rank(fn):
+        assert out.dtype == dtype
+        assert torch.allclose(out.float(), torch.full((4,), expected))
+
+
+def test_torch_allreduce_compression():
+    def fn(r):
+        t = torch.full((8,), float(r))
+        return hvd.allreduce(t, op=hvd.Sum, name="t.comp",
+                             compression=hvd.Compression.bf16)
+
+    expected = torch.full((8,), float(sum(range(N))))
+    for out in _per_rank(fn):
+        assert out.dtype == torch.float32
+        assert torch.allclose(out, expected)
+
+
+def test_torch_allgather_variable():
+    def fn(r):
+        return hvd.allgather(torch.full((r + 1, 2), float(r)), name="t.ag")
+
+    expected = torch.cat([torch.full((r + 1, 2), float(r))
+                          for r in range(N)])
+    for out in _per_rank(fn):
+        assert torch.allclose(out, expected)
+
+
+def test_torch_broadcast_inplace():
+    def fn(r):
+        t = torch.full((4,), float(r))
+        hvd.broadcast_(t, root_rank=6, name="t.bc")
+        return t
+
+    for out in _per_rank(fn):
+        assert torch.allclose(out, torch.full((4,), 6.0))
+
+
+def test_torch_alltoall():
+    def fn(r):
+        t = torch.arange(N, dtype=torch.float32).reshape(N, 1) + 10 * r
+        return hvd.alltoall(t, name="t.a2a")
+
+    results = _per_rank(fn)
+    for dst in range(N):
+        expected = torch.tensor(
+            [[dst + 10.0 * src] for src in range(N)])
+        assert torch.allclose(results[dst], expected)
+
+
+def test_torch_async_poll_synchronize():
+    def fn(r):
+        handle = hvd.allreduce_async(torch.ones(3) * r, op=hvd.Sum,
+                                     name="t.async")
+        out = hvd.synchronize(handle)
+        return out
+
+    expected = torch.full((3,), float(sum(range(N))))
+    for out in _per_rank(fn):
+        assert torch.allclose(out, expected)
+
+
+def _make_model(seed):
+    torch.manual_seed(seed)
+    return torch.nn.Sequential(
+        torch.nn.Linear(6, 16), torch.nn.ReLU(), torch.nn.Linear(16, 2))
+
+
+def test_distributed_optimizer_syncs_replicas():
+    """Each rank starts from the same weights, sees different data; after
+    steps with the wrapped optimizer, replicas must stay identical and the
+    loss must fall (reference: test_torch.py optimizer tests)."""
+    datas = [torch.randn(16, 6, generator=torch.Generator().manual_seed(r))
+             for r in range(N)]
+    targets = [torch.randn(16, 2,
+                           generator=torch.Generator().manual_seed(100 + r))
+               for r in range(N)]
+    # torch.manual_seed is process-global: build the common init here, not
+    # concurrently inside rank threads
+    init_state = _make_model(0).state_dict()
+
+    def fn(r):
+        model = _make_model(0)  # same arch; weights loaded below
+        model.load_state_dict(init_state)
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters())
+        losses = []
+        for step in range(6):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(datas[r]), targets[r])
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        return losses, [p.detach().clone() for p in model.parameters()]
+
+    results = _per_rank(fn)
+    losses0, params0 = results[0]
+    # loss falls on the average objective
+    assert losses0[-1] < losses0[0]
+    for losses_r, params_r in results[1:]:
+        for p0, pr in zip(params0, params_r):
+            assert torch.allclose(p0, pr, atol=1e-6), \
+                "replicas diverged"
+
+
+def test_distributed_optimizer_backward_passes_per_step():
+    """With k=2, gradients accumulate locally and one reduction happens per
+    two backwards."""
+    init_state = _make_model(0).state_dict()
+
+    def fn(r):
+        model = _make_model(0)
+        model.load_state_dict(init_state)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=2)
+        x = torch.randn(8, 6, generator=torch.Generator().manual_seed(r))
+        y = torch.zeros(8, 2)
+        for micro in range(2):
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+        opt.step()
+        opt.zero_grad()
+        return [p.detach().clone() for p in model.parameters()]
+
+    results = _per_rank(fn)
+    for params_r in results[1:]:
+        for p0, pr in zip(results[0], params_r):
+            assert torch.allclose(p0, pr, atol=1e-6)
+
+
+def test_adasum_optimizer_runs():
+    init_state = _make_model(0).state_dict()
+
+    def fn(r):
+        model = _make_model(0)
+        model.load_state_dict(init_state)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters(), op=hvd.Adasum)
+        x = torch.randn(8, 6, generator=torch.Generator().manual_seed(r))
+        loss = model(x).pow(2).mean()
+        loss.backward()
+        opt.step()
+        return [p.detach().clone() for p in model.parameters()]
+
+    results = _per_rank(fn)
+    for params_r in results[1:]:
+        for p0, pr in zip(results[0], params_r):
+            assert torch.allclose(p0, pr, atol=1e-5)
+
+
+def test_broadcast_parameters_and_optimizer_state():
+    def fn(r):
+        model = _make_model(r)  # DIFFERENT init per rank
+        opt = torch.optim.SGD(model.parameters(), lr=0.1 * (r + 1),
+                              momentum=0.9)
+        # create momentum state
+        loss = model(torch.ones(4, 6)).sum()
+        loss.backward()
+        opt.step()
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+        return ([p.detach().clone() for p in model.parameters()],
+                opt.param_groups[0]["lr"])
+
+    results = _per_rank(fn)
+    params0, lr0 = results[0]
+    assert lr0 == pytest.approx(0.1)
+    for params_r, lr_r in results[1:]:
+        assert lr_r == pytest.approx(0.1)
+        for p0, pr in zip(params0, params_r):
+            assert torch.allclose(p0, pr)
+
+
+def test_sync_batch_norm_matches_full_batch():
+    """SyncBatchNorm over 8 rank-shards must equal plain BatchNorm on the
+    concatenated batch, for outputs AND gradients."""
+    full = torch.randn(16, 4, generator=torch.Generator().manual_seed(7))
+    shards = full.chunk(N)
+
+    # reference: plain BN over the full batch
+    bn = torch.nn.BatchNorm1d(4)
+    bn.train()
+    full_in = full.clone().requires_grad_(True)
+    ref_out = bn(full_in)
+    ref_out.pow(2).sum().backward()
+
+    def fn(r):
+        sbn = hvd.SyncBatchNorm(4)
+        sbn.train()
+        x = shards[r].clone().requires_grad_(True)
+        out = sbn(x)
+        out.pow(2).sum().backward()
+        return (out.detach(), x.grad.detach(), sbn.weight.grad.detach(),
+                sbn.running_mean.detach(), sbn.running_var.detach())
+
+    results = _per_rank(fn)
+    for r in range(N):
+        out_r, xgrad_r, wgrad_r, rmean, rvar = results[r]
+        lo = r * 2
+        assert torch.allclose(out_r, ref_out[lo:lo + 2].detach(),
+                              atol=1e-5), f"rank {r} output mismatch"
+        assert torch.allclose(xgrad_r, full_in.grad[lo:lo + 2], atol=1e-4)
+        assert torch.allclose(rmean, bn.running_mean, atol=1e-5)
+        assert torch.allclose(rvar, bn.running_var, atol=1e-4)
+    # weight grad: sum of local grads == full-batch grad
+    total_wgrad = sum(results[r][2] for r in range(N))
+    assert torch.allclose(total_wgrad, bn.weight.grad, atol=1e-4)
