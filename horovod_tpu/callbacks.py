@@ -1,0 +1,75 @@
+"""Training-loop utilities: the Keras-callback surface, JAX-style.
+
+The reference ships Keras callbacks (``horovod/_keras/callbacks.py``:
+BroadcastGlobalVariablesCallback :22, MetricAverageCallback :48,
+LearningRateScheduleCallback :89, LearningRateWarmupCallback :172).  In a
+functional JAX training loop these become helpers and optax schedules rather
+than callback objects; the torch binding can use them directly too.
+"""
+
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.ops_enum import Average
+from horovod_tpu.ops import eager
+
+
+def broadcast_global_variables(variables, root_rank=0):
+    """Start-of-training state sync (reference:
+    BroadcastGlobalVariablesCallback / BroadcastGlobalVariablesHook)."""
+    from horovod_tpu.jax_api import broadcast_parameters
+
+    return broadcast_parameters(variables, root_rank=root_rank)
+
+
+def metric_average(value, name):
+    """Average a scalar metric across ranks at epoch end (reference:
+    MetricAverageCallback averages logged metrics via allreduce)."""
+    tensor = jnp.asarray(value, dtype=jnp.float32)
+    return float(eager.allreduce(tensor, op=Average,
+                                 name=f"metric.{name}"))
+
+
+def scaled_lr(base_lr, scale=None):
+    """Linear LR scaling rule: lr * size (reference docs recommend scaling
+    the learning rate by the number of workers)."""
+    return base_lr * (scale if scale is not None else basics.size())
+
+
+def warmup_schedule(base_lr, warmup_steps, scale=None, initial_factor=None):
+    """LR warmup from ``base_lr`` (single-worker rate) up to
+    ``base_lr * size`` over ``warmup_steps`` (reference:
+    LearningRateWarmupCallback — 'gradually increases from the initial small
+    rate to the scaled target over the warmup period').
+
+    Returns an optax schedule (step -> lr).
+    """
+    target = scaled_lr(base_lr, scale)
+    start = base_lr * (initial_factor if initial_factor is not None else 1.0)
+    if warmup_steps <= 0:
+        return optax.constant_schedule(target)
+    return optax.linear_schedule(init_value=start, end_value=target,
+                                 transition_steps=warmup_steps)
+
+
+def piecewise_schedule(base_lr, boundaries_and_scales, scale=None):
+    """Epoch/step-boundary LR schedule (reference:
+    LearningRateScheduleCallback with staircase multipliers).
+
+    ``boundaries_and_scales``: {step: multiplier} applied multiplicatively,
+    e.g. ``{30_000: 0.1, 60_000: 0.1}`` for the classic /10 staircase.
+    """
+    target = scaled_lr(base_lr, scale)
+    return optax.piecewise_constant_schedule(
+        init_value=target, boundaries_and_scales=boundaries_and_scales)
+
+
+def warmup_then_piecewise(base_lr, warmup_steps, boundaries_and_scales,
+                          scale=None):
+    """The classic ImageNet recipe: warmup to size-scaled LR, then
+    staircase decay (reference: examples/keras_imagenet_resnet50.py)."""
+    return optax.join_schedules(
+        [warmup_schedule(base_lr, warmup_steps, scale),
+         piecewise_schedule(base_lr, boundaries_and_scales, scale)],
+        boundaries=[warmup_steps])

@@ -1,0 +1,48 @@
+"""Callback-surface tests (reference: keras callback behaviors)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import basics
+
+
+def test_metric_average(hvd):
+    from horovod_tpu import callbacks
+
+    def fn(r):
+        return callbacks.metric_average(float(r), "loss")
+
+    for out in basics.run_parallel(fn):
+        assert out == pytest.approx(np.mean(range(8)))
+
+
+def test_warmup_schedule(hvd):
+    from horovod_tpu import callbacks
+
+    sched = callbacks.warmup_schedule(0.1, warmup_steps=10)
+    assert float(sched(0)) == pytest.approx(0.1)
+    assert float(sched(10)) == pytest.approx(0.8)  # 0.1 * size(8)
+    assert float(sched(5)) == pytest.approx((0.1 + 0.8) / 2)
+
+
+def test_warmup_then_piecewise(hvd):
+    from horovod_tpu import callbacks
+
+    sched = callbacks.warmup_then_piecewise(
+        0.1, warmup_steps=4, boundaries_and_scales={100: 0.1})
+    assert float(sched(4)) == pytest.approx(0.8)
+    assert float(sched(50)) == pytest.approx(0.8)
+    assert float(sched(150)) == pytest.approx(0.08)
+
+
+def test_broadcast_global_variables(hvd):
+    import jax.numpy as jnp
+    from horovod_tpu import callbacks
+
+    def fn(r):
+        out = callbacks.broadcast_global_variables(
+            {"w": jnp.full((3,), float(r))}, root_rank=4)
+        return np.asarray(out["w"])
+
+    for out in basics.run_parallel(fn):
+        np.testing.assert_allclose(out, np.full((3,), 4.0))
