@@ -1,0 +1,1 @@
+from horovod_tpu.run.api import run  # noqa: F401

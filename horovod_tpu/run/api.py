@@ -1,0 +1,71 @@
+"""Programmatic ``run(fn)`` API.
+
+Reference: ``horovod/run/runner.py:648-669,742`` — ship a pickled function
+to every rank through the rendezvous KV store, execute it under the full
+env contract, and collect per-rank return values.
+"""
+
+import base64
+import os
+import pickle
+import sys
+
+from horovod_tpu.run import allocate as allocate_mod
+from horovod_tpu.run.http_server import RendezvousServer
+from horovod_tpu.run.launch import launch_job
+from horovod_tpu.utils import env as env_util
+
+try:
+    import cloudpickle as _pickler
+except ImportError:  # cloudpickle not in the image; plain pickle handles
+    _pickler = pickle  # module-level functions, which covers the API's use
+
+
+FN_SCOPE = "runfunc"
+RESULT_SCOPE = "results"
+
+
+def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
+        verbose=False, use_tpu=False):
+    """Run ``fn(*args, **kwargs)`` on ``np`` ranks; returns the list of
+    per-rank return values (rank order)."""
+    kwargs = kwargs or {}
+
+    if hosts:
+        host_list = allocate_mod.parse_hosts(hosts)
+    else:
+        host_list = [allocate_mod.HostInfo("localhost", np)]
+    slots = allocate_mod.allocate(host_list, np)
+
+    rendezvous = RendezvousServer()
+    port = rendezvous.start()
+
+    payload = _pickler.dumps((fn, args, kwargs))
+    with rendezvous._server.kv_lock:
+        rendezvous._server.kv.setdefault(FN_SCOPE, {})["fn"] = payload
+
+    env = dict(extra_env or {})
+    env.setdefault("HVD_RUN_FUNC", "1")
+    if np > 1:
+        env.setdefault(env_util.HVD_CONTROLLER, "tcp")
+    if use_tpu:
+        env.setdefault("HVD_TPU", "1")
+
+    command = f"{sys.executable} -m horovod_tpu.run.task_runner"
+    code = launch_job(slots, command, "127.0.0.1", port, extra_env=env,
+                      verbose=verbose)
+    try:
+        if code != 0:
+            raise RuntimeError(f"hvdrun job failed with exit code {code}")
+        results = []
+        for rank in range(np):
+            blob = rendezvous.get(RESULT_SCOPE, str(rank))
+            if blob is None:
+                raise RuntimeError(f"rank {rank} produced no result")
+            status, value = pickle.loads(blob)
+            if status == "error":
+                raise RuntimeError(f"rank {rank} failed: {value}")
+            results.append(value)
+        return results
+    finally:
+        rendezvous.stop()

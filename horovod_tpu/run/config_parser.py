@@ -1,0 +1,122 @@
+"""Tri-surface configuration: YAML config file <-> CLI args <-> env vars.
+
+Reference: ``horovod/run/common/util/config_parser.py`` — a YAML file sets
+the same knobs as CLI flags; CLI flags override the file; everything lands
+in the worker env contract (``set_env_from_args``).
+"""
+
+from horovod_tpu.utils import env as env_util
+
+# arg name -> (env var, yaml path)
+_PARAMS = {
+    "fusion_threshold_mb": (env_util.HVD_FUSION_THRESHOLD, "params.fusion_threshold_mb"),
+    "cycle_time_ms": (env_util.HVD_CYCLE_TIME, "params.cycle_time_ms"),
+    "cache_capacity": (env_util.HVD_CACHE_CAPACITY, "params.cache_capacity"),
+    "hierarchical_allreduce": (env_util.HVD_HIERARCHICAL_ALLREDUCE, "params.hierarchical_allreduce"),
+    "hierarchical_allgather": (env_util.HVD_HIERARCHICAL_ALLGATHER, "params.hierarchical_allgather"),
+    "autotune": (env_util.HVD_AUTOTUNE, "autotune.enabled"),
+    "autotune_log_file": (env_util.HVD_AUTOTUNE_LOG, "autotune.log_file"),
+    "autotune_warmup_samples": (env_util.HVD_AUTOTUNE_WARMUP_SAMPLES, "autotune.warmup_samples"),
+    "autotune_steady_state_samples": (env_util.HVD_AUTOTUNE_STEADY_STATE_SAMPLES, "autotune.steady_state_samples"),
+    "timeline_filename": (env_util.HVD_TIMELINE, "timeline.filename"),
+    "timeline_mark_cycles": (env_util.HVD_TIMELINE_MARK_CYCLES, "timeline.mark_cycles"),
+    "no_stall_check": (env_util.HVD_STALL_CHECK_DISABLE, "stall_check.disabled"),
+    "stall_check_warning_time_seconds": (env_util.HVD_STALL_CHECK_TIME_SECONDS, "stall_check.warning_time_seconds"),
+    "stall_check_shutdown_time_seconds": (env_util.HVD_STALL_SHUTDOWN_TIME_SECONDS, "stall_check.shutdown_time_seconds"),
+    "log_level": (env_util.HVD_LOG_LEVEL, "logging.level"),
+    "log_hide_timestamp": (env_util.HVD_LOG_HIDE_TIME, "logging.hide_timestamp"),
+    "controller": (env_util.HVD_CONTROLLER, "params.controller"),
+}
+
+
+def _dig(tree, dotted):
+    node = tree
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def load_config_file(path):
+    """Parse the YAML config file into a flat {arg_name: value} dict.
+
+    Uses a minimal built-in YAML-subset parser (two-level ``key: value``
+    maps) when PyYAML is unavailable, matching the reference's file schema.
+    """
+    try:
+        import yaml
+        with open(path) as f:
+            tree = yaml.safe_load(f) or {}
+    except ImportError:
+        tree = _parse_simple_yaml(path)
+
+    out = {}
+    for arg, (_env, dotted) in _PARAMS.items():
+        value = _dig(tree, dotted)
+        if value is not None:
+            out[arg] = value
+    return out
+
+
+def _parse_simple_yaml(path):
+    """Two-level ``section:\\n  key: value`` parser for the config schema."""
+    tree = {}
+    section = None
+    with open(path) as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].rstrip()
+            if not line.strip():
+                continue
+            indented = line.startswith((" ", "\t"))
+            key, _, value = line.strip().partition(":")
+            value = value.strip()
+            if not indented:
+                section = key
+                tree[section] = {}
+            elif section is not None:
+                tree[section][key] = _coerce(value)
+    return tree
+
+
+def _coerce(value: str):
+    low = value.lower()
+    if low in ("true", "yes", "on"):
+        return True
+    if low in ("false", "no", "off"):
+        return False
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except ValueError:
+            pass
+    return value
+
+
+def apply_config_to_args(args, config: dict):
+    """File values fill in args the CLI left at default (None)."""
+    for key, value in config.items():
+        if getattr(args, key, None) in (None, False):
+            setattr(args, key, value)
+
+
+def env_from_args(args) -> dict:
+    """Build the worker env contract from parsed args (reference:
+    config_parser.set_env_from_args)."""
+    env = {}
+
+    def setenv(var, value):
+        if value is None:
+            return
+        if isinstance(value, bool):
+            if value:
+                env[var] = "1"
+        else:
+            env[var] = str(value)
+
+    for arg, (var, _path) in _PARAMS.items():
+        value = getattr(args, arg, None)
+        if arg == "fusion_threshold_mb" and value is not None:
+            value = int(float(value) * 1024 * 1024)
+        setenv(var, value)
+    return env

@@ -1,0 +1,29 @@
+"""Rendezvous KV client (reference: ``horovod/run/http/http_client.py``)."""
+
+import time
+import urllib.error
+import urllib.request
+
+
+def put(addr, port, scope, key, value: bytes):
+    req = urllib.request.Request(
+        f"http://{addr}:{port}/{scope}/{key}", data=value, method="PUT")
+    with urllib.request.urlopen(req, timeout=30):
+        pass
+
+
+def get(addr, port, scope, key, timeout=None):
+    """GET; if ``timeout`` is set, poll until the key appears."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{addr}:{port}/{scope}/{key}",
+                    timeout=30) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as exc:
+            if exc.code != 404:
+                raise
+            if deadline is None or time.monotonic() > deadline:
+                raise KeyError(f"{scope}/{key} not found in rendezvous")
+            time.sleep(0.05)

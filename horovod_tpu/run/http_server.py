@@ -1,0 +1,98 @@
+"""Rendezvous key-value HTTP server.
+
+Reference: ``horovod/run/http/http_server.py`` (``KVStoreHandler`` :36,
+``RendezvousServer`` :179) — a threaded HTTP server storing values under
+``/scope/key``, used by workers for address exchange (the Gloo HTTPStore
+role) and by the programmatic ``run()`` API for result collection.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from horovod_tpu.utils.logging import get_logger
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def _split(self):
+        parts = self.path.strip("/").split("/", 1)
+        if len(parts) != 2:
+            return None, None
+        return parts[0], parts[1]
+
+    def do_PUT(self):
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        if scope is None:
+            self.send_response(400)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        with self.server.kv_lock:
+            self.server.kv.setdefault(scope, {})[key] = value
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        scope, key = self._split()
+        with self.server.kv_lock:
+            value = self.server.kv.get(scope, {}).get(key)
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_DELETE(self):
+        scope, key = self._split()
+        with self.server.kv_lock:
+            self.server.kv.get(scope, {}).pop(key, None)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, fmt, *args):  # quiet by default
+        get_logger().debug("rendezvous: " + fmt, *args)
+
+
+class RendezvousServer:
+    """Threaded KV server; bind to an ephemeral port and share the address
+    with workers through the env contract."""
+
+    def __init__(self, host="0.0.0.0"):
+        self._server = ThreadingHTTPServer((host, 0), _KVHandler)
+        self._server.kv = {}
+        self._server.kv_lock = threading.Lock()
+        self._thread = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name="hvd-rendezvous")
+        self._thread.start()
+        return self.port
+
+    def get(self, scope, key):
+        with self._server.kv_lock:
+            return self._server.kv.get(scope, {}).get(key)
+
+    def scope_size(self, scope) -> int:
+        with self._server.kv_lock:
+            return len(self._server.kv.get(scope, {}))
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

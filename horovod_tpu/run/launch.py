@@ -1,0 +1,82 @@
+"""Per-rank process launch: local subprocesses or ssh fan-out.
+
+Reference: ``horovod/run/gloo_run.py:237`` ``launch_gloo`` — one thread per
+rank runs the (possibly ssh-prefixed) command with the env contract
+(``gloo_run.py:152-157,261-273``); the first nonzero exit terminates every
+other rank.
+"""
+
+import os
+import shlex
+import sys
+import threading
+
+from horovod_tpu.run import safe_shell_exec
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils.logging import get_logger
+
+LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+
+def slot_env(slot, rendezvous_addr, rendezvous_port, extra_env=None):
+    """The worker env contract for one rank."""
+    env = {
+        env_util.HVD_RANK: str(slot.rank),
+        env_util.HVD_SIZE: str(slot.size),
+        env_util.HVD_LOCAL_RANK: str(slot.local_rank),
+        env_util.HVD_LOCAL_SIZE: str(slot.local_size),
+        env_util.HVD_CROSS_RANK: str(slot.cross_rank),
+        env_util.HVD_CROSS_SIZE: str(slot.cross_size),
+        env_util.HVD_RENDEZVOUS_ADDR: rendezvous_addr,
+        env_util.HVD_RENDEZVOUS_PORT: str(rendezvous_port),
+    }
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
+def _ssh_command(slot, command, env, ssh_port=None):
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    port = f"-p {ssh_port} " if ssh_port else ""
+    inner = f"cd {shlex.quote(os.getcwd())} && {exports} {command}"
+    return (f"ssh -o StrictHostKeyChecking=no {port}"
+            f"{slot.hostname} {shlex.quote(inner)}")
+
+
+def launch_job(slots, command, rendezvous_addr, rendezvous_port,
+               extra_env=None, ssh_port=None, verbose=False) -> int:
+    """Launch one process per slot; kill everything on first failure.
+    Returns the first nonzero exit code (or 0)."""
+    log = get_logger()
+    failure = threading.Event()
+    exit_codes = [0] * len(slots)
+
+    def run_rank(i, slot):
+        env = slot_env(slot, rendezvous_addr, rendezvous_port, extra_env)
+        if slot.hostname in LOCAL_HOSTS:
+            full_env = dict(os.environ)
+            full_env.update(env)
+            cmd = command
+        else:
+            full_env = dict(os.environ)
+            cmd = _ssh_command(slot, command, env, ssh_port)
+        if verbose:
+            log.warning("launching rank %d on %s: %s", slot.rank,
+                        slot.hostname, cmd)
+        code = safe_shell_exec.execute(
+            cmd, env=full_env, stdout=sys.stdout, stderr=sys.stderr,
+            events=[failure])
+        exit_codes[i] = code
+        if code != 0:
+            failure.set()
+
+    threads = [threading.Thread(target=run_rank, args=(i, s), daemon=True)
+               for i, s in enumerate(slots)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for code in exit_codes:
+        if code != 0:
+            return code
+    return 0

@@ -1,0 +1,148 @@
+"""``hvdrun`` — the launcher CLI.
+
+Reference: ``horovod/run/runner.py`` — every core tunable is exposed as a
+CLI flag mapped onto the worker env contract; hosts come from ``-H`` or a
+hostfile; the config file fills in whatever the CLI left unset.  Usage:
+
+    hvdrun -np 4 python train.py
+    hvdrun -np 8 -H host1:4,host2:4 python train.py
+    hvdrun -np 4 --tpu python train.py      # one process per TPU host
+"""
+
+import argparse
+import os
+import sys
+
+from horovod_tpu.run import allocate as allocate_mod
+from horovod_tpu.run import config_parser
+from horovod_tpu.run.http_server import RendezvousServer
+from horovod_tpu.run.launch import launch_job
+from horovod_tpu.utils import env as env_util
+
+
+def make_parser():
+    parser = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu distributed job.")
+    parser.add_argument("-np", "--num-proc", type=int, default=None,
+                        help="Total number of training processes.")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help="host:slots[,host:slots,...]; default "
+                             "localhost with np slots.")
+    parser.add_argument("--hostfile", default=None,
+                        help="File with one 'hostname slots=N' per line.")
+    parser.add_argument("--ssh-port", type=int, default=None)
+    parser.add_argument("--tpu", action="store_true",
+                        help="TPU pod mode: one process per host; ranks map "
+                             "onto pod-slice coordinates and in-process "
+                             "chips become the local axis.")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--config-file", default=None,
+                        help="YAML config file (CLI flags take precedence).")
+
+    group = parser.add_argument_group("tunable parameters")
+    group.add_argument("--fusion-threshold-mb", type=float, default=None)
+    group.add_argument("--cycle-time-ms", type=float, default=None)
+    group.add_argument("--cache-capacity", type=int, default=None)
+    group.add_argument("--hierarchical-allreduce", action="store_true",
+                       default=None)
+    group.add_argument("--hierarchical-allgather", action="store_true",
+                       default=None)
+    group.add_argument("--controller", choices=["native", "python", "tcp"],
+                       default=None)
+
+    auto = parser.add_argument_group("autotune")
+    auto.add_argument("--autotune", action="store_true", default=None)
+    auto.add_argument("--autotune-log-file", default=None)
+    auto.add_argument("--autotune-warmup-samples", type=int, default=None)
+    auto.add_argument("--autotune-steady-state-samples", type=int,
+                      default=None)
+
+    timeline = parser.add_argument_group("timeline")
+    timeline.add_argument("--timeline-filename", default=None)
+    timeline.add_argument("--timeline-mark-cycles", action="store_true",
+                          default=None)
+
+    stall = parser.add_argument_group("stall check")
+    stall.add_argument("--no-stall-check", action="store_true", default=None)
+    stall.add_argument("--stall-check-warning-time-seconds", type=float,
+                       default=None)
+    stall.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                       default=None)
+
+    logg = parser.add_argument_group("logging")
+    logg.add_argument("--log-level", default=None,
+                      choices=["trace", "debug", "info", "warning", "error",
+                               "fatal"])
+    logg.add_argument("--log-hide-timestamp", action="store_true",
+                      default=None)
+
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="Training command to run on each rank.")
+    return parser
+
+
+def build_slots(args):
+    if args.hostfile:
+        hosts = allocate_mod.parse_hostfile(args.hostfile)
+    elif args.hosts:
+        hosts = allocate_mod.parse_hosts(args.hosts)
+    else:
+        hosts = [allocate_mod.HostInfo("localhost", args.num_proc)]
+    if args.tpu:
+        # one process per host; each process drives that host's chips as its
+        # local ranks (device-rank mode under the hood)
+        hosts = [allocate_mod.HostInfo(h.hostname, 1) for h in hosts]
+        np_total = len(hosts)
+    else:
+        np_total = args.num_proc
+    return allocate_mod.allocate(hosts, np_total)
+
+
+def run_commandline(argv=None) -> int:
+    parser = make_parser()
+    args = parser.parse_args(argv)
+
+    if not args.command:
+        parser.error("no training command given")
+    if args.num_proc is None and not args.tpu:
+        parser.error("-np is required (or use --tpu)")
+
+    if args.config_file:
+        config_parser.apply_config_to_args(
+            args, config_parser.load_config_file(args.config_file))
+
+    extra_env = config_parser.env_from_args(args)
+    slots = build_slots(args)
+    if len(slots) > 1 and env_util.HVD_CONTROLLER not in extra_env:
+        extra_env[env_util.HVD_CONTROLLER] = "tcp"
+
+    rendezvous = RendezvousServer()
+    port = rendezvous.start()
+    addr = os.environ.get("HVD_RENDEZVOUS_HOST_ADDR",
+                          _routable_addr(slots))
+    command = " ".join(args.command)
+    try:
+        return launch_job(slots, command, addr, port, extra_env=extra_env,
+                          ssh_port=args.ssh_port, verbose=args.verbose)
+    finally:
+        rendezvous.stop()
+
+
+def _routable_addr(slots):
+    """Pick the address remote workers use to reach the rendezvous server
+    (reference: driver NIC discovery, simplified: hostname resolution; for
+    all-local jobs, loopback)."""
+    import socket
+
+    if all(s.hostname in ("localhost", "127.0.0.1") for s in slots):
+        return "127.0.0.1"
+    return socket.gethostbyname(socket.gethostname())
+
+
+def main():
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Process-tree-safe command execution.
+
+Reference: ``horovod/run/common/util/safe_shell_exec.py`` — spawn the child
+in its own process group, stream stdout/stderr, and on termination (parent
+death, interrupt, sibling failure) kill the WHOLE tree so no orphan workers
+linger on remote hosts.
+"""
+
+import os
+import signal
+import subprocess
+import threading
+
+GRACEFUL_TERMINATION_TIME_S = 5
+
+
+def _forward_stream(pipe, sink):
+    for line in iter(pipe.readline, b""):
+        sink.write(line.decode(errors="replace"))
+        sink.flush()
+    pipe.close()
+
+
+def terminate_process_group(proc):
+    """SIGTERM the child's process group, escalate to SIGKILL."""
+    if proc.poll() is not None:
+        return
+    try:
+        pgid = os.getpgid(proc.pid)
+    except ProcessLookupError:
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+        proc.wait(timeout=GRACEFUL_TERMINATION_TIME_S)
+    except (subprocess.TimeoutExpired, ProcessLookupError):
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+
+def execute(command, env=None, stdout=None, stderr=None,
+            events=None) -> int:
+    """Run ``command`` (shell string or argv list) in a new process group.
+
+    ``events``: optional list of ``threading.Event``; if any fires, the
+    process tree is terminated (the launcher uses this to kill all ranks
+    when one fails, reference: gloo_run.py:300-308).
+    Returns the exit code.
+    """
+    import sys
+
+    shell = isinstance(command, str)
+    proc = subprocess.Popen(
+        command, shell=shell, env=env, start_new_session=True,
+        stdout=subprocess.PIPE if stdout is not None else None,
+        stderr=subprocess.PIPE if stderr is not None else None)
+
+    forwarders = []
+    if stdout is not None:
+        t = threading.Thread(target=_forward_stream,
+                             args=(proc.stdout, stdout), daemon=True)
+        t.start()
+        forwarders.append(t)
+    if stderr is not None:
+        t = threading.Thread(target=_forward_stream,
+                             args=(proc.stderr, stderr), daemon=True)
+        t.start()
+        forwarders.append(t)
+
+    stop_watch = threading.Event()
+    watchers = []
+    for event in events or []:
+        def watch(event=event):
+            while not stop_watch.is_set():
+                if event.wait(timeout=0.1):
+                    terminate_process_group(proc)
+                    return
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        watchers.append(t)
+
+    try:
+        proc.wait()
+    except KeyboardInterrupt:
+        terminate_process_group(proc)
+        raise
+    finally:
+        stop_watch.set()
+    for t in forwarders:
+        t.join(timeout=5)
+    del sys
+    return proc.returncode

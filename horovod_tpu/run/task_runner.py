@@ -1,0 +1,34 @@
+"""Worker-side entry for the programmatic ``run(fn)`` API (reference:
+``horovod/run/run_task.py`` / task exec fns): fetch the pickled function
+from the rendezvous KV, execute it, post the result."""
+
+import os
+import pickle
+import sys
+import traceback
+
+from horovod_tpu.run import http_client
+from horovod_tpu.run.api import FN_SCOPE, RESULT_SCOPE
+from horovod_tpu.utils import env as env_util
+
+
+def main():
+    addr = os.environ[env_util.HVD_RENDEZVOUS_ADDR]
+    port = int(os.environ[env_util.HVD_RENDEZVOUS_PORT])
+    rank = int(os.environ[env_util.HVD_RANK])
+
+    try:
+        fn, args, kwargs = pickle.loads(
+            http_client.get(addr, port, FN_SCOPE, "fn", timeout=60))
+        result = ("ok", fn(*args, **kwargs))
+    except BaseException:  # noqa: BLE001 — reported to the driver
+        result = ("error", traceback.format_exc())
+    http_client.put(addr, port, RESULT_SCOPE, str(rank),
+                    pickle.dumps(result))
+    if result[0] == "error":
+        sys.stderr.write(result[1])
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

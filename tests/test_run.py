@@ -1,0 +1,196 @@
+"""Launcher unit tests — no cluster required (reference: test/test_run.py:
+arg/hostfile/config parsing, allocation tables, safe_shell_exec semantics,
+rendezvous KV roundtrip, programmatic run API)."""
+
+import io
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.run import allocate as alloc
+from horovod_tpu.run import config_parser, safe_shell_exec
+from horovod_tpu.run.http_server import RendezvousServer
+from horovod_tpu.run import http_client
+from horovod_tpu.run.runner import make_parser, build_slots
+from horovod_tpu.utils import env as env_util
+
+
+# ------------------------------------------------------------- allocation ---
+def test_parse_hosts():
+    hosts = alloc.parse_hosts("h1:4, h2:2,h3")
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("h1", 4), ("h2", 2), ("h3", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("# comment\nh1 slots=4\nh2:2\nh3\n")
+    hosts = alloc.parse_hostfile(str(hf))
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("h1", 4), ("h2", 2), ("h3", 1)]
+
+
+def test_allocate_table():
+    slots = alloc.allocate(alloc.parse_hosts("h1:2,h2:2"), 4)
+    assert [(s.rank, s.hostname, s.local_rank, s.cross_rank)
+            for s in slots] == [
+        (0, "h1", 0, 0), (1, "h1", 1, 0), (2, "h2", 0, 1), (3, "h2", 1, 1)]
+    assert all(s.size == 4 and s.local_size == 2 and s.cross_size == 2
+               for s in slots)
+
+
+def test_allocate_partial_last_host():
+    slots = alloc.allocate(alloc.parse_hosts("h1:2,h2:4"), 3)
+    assert [(s.hostname, s.local_rank, s.local_size) for s in slots] == [
+        ("h1", 0, 2), ("h1", 1, 2), ("h2", 0, 1)]
+
+
+def test_allocate_over_capacity_errors():
+    with pytest.raises(ValueError, match="slots"):
+        alloc.allocate(alloc.parse_hosts("h1:2"), 3)
+
+
+# ------------------------------------------------------------ config file ---
+CONFIG_YAML = """\
+params:
+  fusion_threshold_mb: 32
+  cycle_time_ms: 2.5
+  cache_capacity: 512
+timeline:
+  filename: /tmp/tl.json
+  mark_cycles: true
+stall_check:
+  warning_time_seconds: 30
+logging:
+  level: debug
+"""
+
+
+def test_config_file_to_env(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(CONFIG_YAML)
+
+    parser = make_parser()
+    args = parser.parse_args(
+        ["-np", "2", "--cycle-time-ms", "5", "python", "x.py"])
+    config_parser.apply_config_to_args(
+        args, config_parser.load_config_file(str(cfg)))
+
+    env = config_parser.env_from_args(args)
+    assert env[env_util.HVD_FUSION_THRESHOLD] == str(32 * 1024 * 1024)
+    # CLI wins over file
+    assert env[env_util.HVD_CYCLE_TIME] == "5.0"
+    assert env[env_util.HVD_CACHE_CAPACITY] == "512"
+    assert env[env_util.HVD_TIMELINE] == "/tmp/tl.json"
+    assert env[env_util.HVD_TIMELINE_MARK_CYCLES] == "1"
+    assert env[env_util.HVD_STALL_CHECK_TIME_SECONDS] == "30"
+    assert env[env_util.HVD_LOG_LEVEL] == "debug"
+
+
+def test_cli_command_parsing():
+    parser = make_parser()
+    args = parser.parse_args(
+        ["-np", "4", "-H", "a:2,b:2", "python", "train.py", "--lr", "0.1"])
+    assert args.num_proc == 4
+    assert args.command == ["python", "train.py", "--lr", "0.1"]
+    slots = build_slots(args)
+    assert len(slots) == 4
+    assert slots[2].hostname == "b"
+
+
+def test_tpu_mode_one_process_per_host():
+    parser = make_parser()
+    args = parser.parse_args(["--tpu", "-H", "a:4,b:4", "python", "t.py"])
+    slots = build_slots(args)
+    assert len(slots) == 2
+    assert [(s.hostname, s.local_size) for s in slots] == [("a", 1),
+                                                           ("b", 1)]
+
+
+# -------------------------------------------------------------- rendezvous --
+def test_rendezvous_kv_roundtrip():
+    server = RendezvousServer()
+    port = server.start()
+    try:
+        http_client.put("127.0.0.1", port, "scope", "k1", b"value1")
+        assert http_client.get("127.0.0.1", port, "scope", "k1") == b"value1"
+        with pytest.raises(KeyError):
+            http_client.get("127.0.0.1", port, "scope", "absent",
+                            timeout=0.2)
+
+        # delayed producer + polling consumer
+        def producer():
+            time.sleep(0.3)
+            http_client.put("127.0.0.1", port, "scope", "late", b"v")
+
+        threading.Thread(target=producer, daemon=True).start()
+        assert http_client.get("127.0.0.1", port, "scope", "late",
+                               timeout=5) == b"v"
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------- safe_shell_exec --
+def test_safe_shell_exec_captures_output():
+    out = io.StringIO()
+    code = safe_shell_exec.execute(
+        [sys.executable, "-c", "print('hello-exec')"], stdout=out)
+    assert code == 0
+    assert "hello-exec" in out.getvalue()
+
+
+def test_safe_shell_exec_exit_code():
+    code = safe_shell_exec.execute(
+        [sys.executable, "-c", "import sys; sys.exit(3)"])
+    assert code == 3
+
+
+def test_safe_shell_exec_event_terminates_tree():
+    event = threading.Event()
+    start = time.monotonic()
+    result = {}
+
+    def runner():
+        result["code"] = safe_shell_exec.execute(
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            events=[event])
+
+    t = threading.Thread(target=runner)
+    t.start()
+    time.sleep(0.5)
+    event.set()
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert result["code"] != 0
+    assert time.monotonic() - start < 30
+
+
+# ------------------------------------------------------- programmatic run ---
+def _train_fn(value):
+    import os
+    return (int(os.environ["HVD_RANK"]), value * 2)
+
+
+# plain pickle ships functions by module reference; make this test module
+# importable inside the worker processes
+_TESTS_ENV = {
+    "PYTHONPATH": os.path.dirname(__file__) + os.pathsep +
+    os.environ.get("PYTHONPATH", "")
+}
+
+
+def test_run_fn_single_process():
+    from horovod_tpu.run import run
+
+    results = run(_train_fn, args=(21,), np=1, extra_env=_TESTS_ENV)
+    assert results == [(0, 42)]
+
+
+def test_run_fn_two_processes_no_collectives():
+    from horovod_tpu.run import run
+
+    results = run(_train_fn, args=(5,), np=2, extra_env=_TESTS_ENV)
+    assert results == [(0, 10), (1, 10)]
