@@ -28,7 +28,47 @@ _LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(__file__)),
                          "lib", "libhvdcore.so")
 
 
+def _build_lib():
+    """Build libhvdcore.so in-tree when absent (fresh checkouts don't ship
+    binaries; the reference likewise compiles its core at install time,
+    reference: setup.py:47-52).
+
+    Multiple ranks on one host may race here on first launch, so the
+    existence check and the build run under an exclusive flock; everyone
+    re-checks after acquiring it.
+    """
+    import fcntl
+    import subprocess
+
+    csrc = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "csrc")
+    if not os.path.isdir(csrc):
+        raise OSError(
+            f"{_LIB_PATH} is missing and cannot be built automatically "
+            f"(no csrc/ tree next to the package); build libhvdcore.so "
+            f"with `make -C csrc` from a source checkout")
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    lock_path = os.path.join(os.path.dirname(_LIB_PATH), ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.exists(_LIB_PATH):
+            return
+        try:
+            proc = subprocess.run(["make", "-C", csrc],
+                                  capture_output=True, text=True)
+        except FileNotFoundError:
+            raise OSError(
+                f"{_LIB_PATH} is missing and `make` is not on PATH; "
+                f"build it with `make -C {csrc}`")
+        if proc.returncode != 0:
+            raise OSError(
+                f"building libhvdcore.so failed (make -C {csrc}):\n"
+                f"{proc.stdout}\n{proc.stderr}")
+
+
 def _load_lib():
+    if not os.path.exists(_LIB_PATH):
+        _build_lib()
     lib = ctypes.CDLL(_LIB_PATH)
     lib.hvd_core_create.restype = ctypes.c_void_p
     lib.hvd_core_create.argtypes = [ctypes.c_int]
