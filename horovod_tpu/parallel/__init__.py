@@ -4,3 +4,14 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     hierarchical_mesh,
     MeshAxes,
 )
+from horovod_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_self_attention,
+    reference_attention,
+)
+from horovod_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_self_attention,
+    seq_to_heads,
+    heads_to_seq,
+)

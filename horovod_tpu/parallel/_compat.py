@@ -1,0 +1,8 @@
+"""JAX version compatibility shims shared by the parallel subsystem."""
+
+try:
+    from jax import shard_map as _shard_map_mod  # jax >= 0.6
+    shard_map = _shard_map_mod.shard_map if hasattr(
+        _shard_map_mod, "shard_map") else _shard_map_mod
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # noqa: F401
