@@ -7,3 +7,8 @@ from horovod_tpu.models.resnet import (  # noqa: F401
     ResNet152,
 )
 from horovod_tpu.models.mlp import MLP  # noqa: F401
+from horovod_tpu.models.transformer import (  # noqa: F401
+    Transformer,
+    TransformerConfig,
+    apply_with_aux,
+)

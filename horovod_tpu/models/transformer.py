@@ -1,0 +1,143 @@
+"""GPT-style decoder-only transformer — the long-context flagship model.
+
+The reference ships only example CNNs (SURVEY §6); this model family is
+what exercises the framework's TPU-first parallel subsystems together:
+
+- **dp**: batch sharding + gradient psum (``DistributedOptimizer``)
+- **tp**: weight shardings from
+  :func:`horovod_tpu.parallel.tensor_parallel.transformer_sharding_rules`
+  (module/param names here are chosen to match those rules)
+- **sp**: attention is pluggable — dense, ring
+  (:func:`~horovod_tpu.parallel.ring_attention.ring_attention`) or Ulysses
+- **ep**: optional switch-MoE FFN layers
+  (:func:`~horovod_tpu.parallel.moe.switch_moe`)
+- **pp**: :class:`Block` is shape-preserving, so the block stack drops into
+  ``horovod_tpu.parallel.pipeline.pipeline_apply`` unchanged
+
+bfloat16 activations by default (MXU-native), fp32 layernorm/softmax.
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from horovod_tpu.parallel.ring_attention import reference_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    n_layers: int = 4
+    d_model: int = 512
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    # attn_fn(q, k, v, causal=..., scale=...) — swap in ring/ulysses/pallas
+    attn_fn: Optional[Callable] = None
+    # every k-th block uses a switch-MoE FFN (0 = dense only)
+    moe_every: int = 0
+    n_experts: int = 8
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h, d = cfg.n_heads, cfg.d_model // cfg.n_heads
+        qkv = nn.DenseGeneral((3, h, d), use_bias=False, dtype=cfg.dtype,
+                              name="qkv")(x)
+        q, k, v = (qkv[..., i, :, :] for i in range(3))
+        attn = cfg.attn_fn or reference_attention
+        o = attn(q, k, v, causal=True)
+        o = o.reshape(o.shape[:-2] + (h * d,))
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        name="out")(o)
+
+
+class Mlp(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                     name="up")(x)
+        x = nn.gelu(x)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        name="down")(x)
+
+
+class MoeMlp(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from horovod_tpu.parallel.moe import (
+            moe_kernel_init, moe_param_shapes, switch_moe)
+
+        cfg = self.cfg
+        shapes = moe_param_shapes(cfg.d_model, cfg.d_ff, cfg.n_experts)
+        params = {name: {"kernel": self.param(
+            f"{name}_kernel", moe_kernel_init, shape)}
+            for name, shape in shapes.items()}
+        out, aux = switch_moe(x, params)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        return out
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    use_moe: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + Attention(cfg, name="attn")(y.astype(cfg.dtype))
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        ff = MoeMlp(cfg, name="moe") if self.use_moe else \
+            Mlp(cfg, name="mlp")
+        return x + ff(y.astype(cfg.dtype))
+
+
+def apply_with_aux(model, params, tokens):
+    """Forward pass returning ``(logits, moe_aux_loss)``.
+
+    MoE blocks ``sow`` their load-balancing losses into the
+    ``intermediates`` collection, which plain ``model.apply`` drops;
+    training code for MoE configs must use this helper (or pass
+    ``mutable=["intermediates"]`` itself) and add the aux term to the
+    loss, or the router receives no balancing gradient.
+    """
+    import jax as _jax
+
+    logits, state = model.apply({"params": params}, tokens,
+                                mutable=["intermediates"])
+    leaves = _jax.tree_util.tree_leaves(state.get("intermediates", {}))
+    aux = sum(leaves) if leaves else jnp.zeros((), jnp.float32)
+    return logits, aux
+
+
+class Transformer(nn.Module):
+    """Token ids ``[B, T]`` -> logits ``[B, T, vocab]`` (causal LM)."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     name="embed")(tokens)
+        pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype,
+                       name="pos_embed")(jnp.arange(tokens.shape[-1]))
+        x = x + pos
+        for i in range(cfg.n_layers):
+            use_moe = cfg.moe_every and (i + 1) % cfg.moe_every == 0
+            x = Block(cfg, use_moe=bool(use_moe), name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                        name="lm_head")(x.astype(cfg.dtype))

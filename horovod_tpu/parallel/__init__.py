@@ -15,3 +15,18 @@ from horovod_tpu.parallel.ulysses import (  # noqa: F401
     seq_to_heads,
     heads_to_seq,
 )
+from horovod_tpu.parallel.tensor_parallel import (  # noqa: F401
+    transformer_sharding_rules,
+    params_shardings,
+    shard_params,
+    constrain,
+)
+from horovod_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipelined,
+)
+from horovod_tpu.parallel.moe import (  # noqa: F401
+    switch_moe,
+    switch_route,
+    init_moe_params,
+)

@@ -1,8 +1,25 @@
 """JAX version compatibility shims shared by the parallel subsystem."""
 
+import inspect
+
 try:
     from jax import shard_map as _shard_map_mod  # jax >= 0.6
     shard_map = _shard_map_mod.shard_map if hasattr(
         _shard_map_mod, "shard_map") else _shard_map_mod
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # noqa: F401
+
+try:
+    _check_kw = next(
+        (kw for kw in ("check_vma", "check_rep")
+         if kw in inspect.signature(shard_map).parameters), None)
+except (TypeError, ValueError):  # pragma: no cover
+    _check_kw = None
+
+
+def shard_map_unchecked(*args, **kwargs):
+    """shard_map with replication/varying-axes checking disabled — the
+    keyword is ``check_vma`` on current jax, ``check_rep`` on older."""
+    if _check_kw:
+        kwargs.setdefault(_check_kw, False)
+    return shard_map(*args, **kwargs)

@@ -1,0 +1,108 @@
+"""Expert parallelism: GShard-style switch Mixture-of-Experts.
+
+Absent from the reference (SURVEY §2.7).  TPU-native design follows the
+original GShard/Switch recipe, which was *built* for XLA SPMD: routing is
+expressed as dense one-hot einsums with static capacity (no gather/scatter,
+no dynamic shapes — everything tiles onto the MXU), the expert dimension of
+the dispatched activations and of the expert weights is sharded over the
+``ep`` mesh axis with sharding constraints, and XLA lowers the dispatch /
+combine einsums into ``all_to_all`` collectives over ICI.
+
+Top-1 (switch) routing with capacity factor + auxiliary load-balancing
+loss, per Switch Transformer; tokens overflowing an expert's capacity are
+passed through the residual (combine weight 0).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def switch_route(router_logits, n_experts, capacity):
+    """Top-1 routing tensors from ``[T, E]`` logits.
+
+    Returns (dispatch ``[T, E, C]`` float, combine ``[T, E, C]`` float,
+    aux_loss scalar).
+    """
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                 # [T]
+    expert_gate = jnp.max(probs, axis=-1)                   # [T]
+    routed_1h = jax.nn.one_hot(expert_idx, n_experts)       # [T, E] pre-drop
+
+    # position of each token within its expert's queue
+    pos_in_expert = (jnp.cumsum(routed_1h, axis=0) - 1.0) * routed_1h  # [T,E]
+    keep = pos_in_expert < capacity
+    kept_1h = routed_1h * keep                              # drop overflow
+    pos = jnp.sum(pos_in_expert * kept_1h, axis=-1)         # [T]
+
+    pos_1h = jax.nn.one_hot(pos.astype(jnp.int32), capacity)            # [T,C]
+    dispatch = kept_1h[:, :, None] * pos_1h[:, None, :]     # [T, E, C]
+    combine = dispatch * expert_gate[:, None, None]
+
+    # Switch-Transformer load-balance loss: E * sum_e f_e * p_e, with f
+    # from the PRE-drop routing decisions — capacity clamping must not
+    # hide imbalance from the balancing gradient.
+    f = jnp.mean(routed_1h, axis=0)        # fraction argmax-routed to e
+    p = jnp.mean(probs, axis=0)            # mean router prob for e
+    aux_loss = n_experts * jnp.sum(f * p)
+    return dispatch, combine, aux_loss
+
+
+def switch_moe(x, params, *, capacity_factor=1.25, mesh=None):
+    """Apply a switch-MoE FFN to ``x [..., T, D]`` (leading dims folded).
+
+    params: dict with ``router/kernel [D, E]``, ``wi/kernel [E, D, F]``,
+    ``wo/kernel [E, F, D]`` (create with :func:`init_moe_params`).
+    When ``mesh`` is given, expert-dim sharding constraints are applied so
+    XLA partitions experts over ``ep`` and inserts the all_to_alls.
+    """
+    from horovod_tpu.parallel.tensor_parallel import constrain
+
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    xt = x.reshape(-1, d)                                   # [T, D]
+    t = xt.shape[0]
+    wi = params["wi"]["kernel"]
+    wo = params["wo"]["kernel"]
+    e = wi.shape[0]
+    capacity = int(math.ceil(capacity_factor * t / e))
+
+    logits = xt @ params["router"]["kernel"]                # [T, E]
+    dispatch, combine, aux = switch_route(logits, e, capacity)
+
+    expert_in = jnp.einsum("td,tec->ecd", xt.astype(jnp.float32),
+                           dispatch)                        # [E, C, D]
+    if mesh is not None:
+        expert_in = constrain(expert_in, mesh, "ep", None, None)
+    h = jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(jnp.float32))
+    h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, wo.astype(jnp.float32))
+    if mesh is not None:
+        expert_out = constrain(expert_out, mesh, "ep", None, None)
+    out = jnp.einsum("ecd,tec->td", expert_out, combine)    # [T, D]
+    return out.astype(x.dtype).reshape(orig_shape), aux
+
+
+def moe_param_shapes(d_model, d_ff, n_experts):
+    """The switch_moe parameter contract — single source of truth shared by
+    :func:`init_moe_params` and the flax ``MoeMlp`` module."""
+    return {
+        "router": (d_model, n_experts),
+        "wi": (n_experts, d_model, d_ff),
+        "wo": (n_experts, d_ff, d_model),
+    }
+
+
+def moe_kernel_init(rng, shape, dtype=jnp.float32):
+    """Normal(0, 1/fan_in) where fan_in is the contracted (second-to-last)
+    dimension."""
+    scale = 1.0 / math.sqrt(shape[-2])
+    return (jax.random.normal(rng, shape) * scale).astype(dtype)
+
+
+def init_moe_params(rng, d_model, d_ff, n_experts, dtype=jnp.float32):
+    shapes = moe_param_shapes(d_model, d_ff, n_experts)
+    keys = jax.random.split(rng, len(shapes))
+    return {name: {"kernel": moe_kernel_init(k, shape, dtype)}
+            for k, (name, shape) in zip(keys, shapes.items())}
