@@ -118,7 +118,11 @@ def apply_with_aux(model, params, tokens):
 
     logits, state = model.apply({"params": params}, tokens,
                                 mutable=["intermediates"])
-    leaves = _jax.tree_util.tree_leaves(state.get("intermediates", {}))
+    leaves = [
+        leaf for path, leaf in _jax.tree_util.tree_flatten_with_path(
+            state.get("intermediates", {}))[0]
+        if any("moe_aux_loss" in str(getattr(k, "key", k)) for k in path)
+    ]
     aux = sum(leaves) if leaves else jnp.zeros((), jnp.float32)
     return logits, aux
 

@@ -49,16 +49,41 @@ def switch_route(router_logits, n_experts, capacity):
     return dispatch, combine, aux_loss
 
 
-def switch_moe(x, params, *, capacity_factor=1.25, mesh=None):
+def _constrain_ep(y, mesh):
+    """Shard the expert dim (axis 1 of [G, E, C, D]) over ``ep``.
+
+    With an explicit mesh, uses it; otherwise tries a bare-axis-name
+    constraint against whatever mesh is ambient at trace time (jit with
+    sharded inputs), and degrades to a no-op when there is none or it has
+    no ``ep`` axis.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is not None:
+        from horovod_tpu.parallel.tensor_parallel import constrain
+        return constrain(y, mesh, None, "ep", None, None)
+    try:
+        return jax.lax.with_sharding_constraint(
+            y, P(None, "ep", None, None))
+    except Exception:
+        return y
+
+
+def switch_moe(x, params, *, capacity_factor=1.25, group_size=4096,
+               mesh=None):
     """Apply a switch-MoE FFN to ``x [..., T, D]`` (leading dims folded).
 
     params: dict with ``router/kernel [D, E]``, ``wi/kernel [E, D, F]``,
     ``wo/kernel [E, F, D]`` (create with :func:`init_moe_params`).
-    When ``mesh`` is given, expert-dim sharding constraints are applied so
-    XLA partitions experts over ``ep`` and inserts the all_to_alls.
-    """
-    from horovod_tpu.parallel.tensor_parallel import constrain
 
+    Tokens are routed in fixed-size **groups** (GShard recipe): the
+    dispatch/combine one-hots are ``[G, S, E, C]`` with per-group capacity
+    ``C = ceil(cf*S/E)``, so their footprint is linear in total tokens
+    (``T*cf*S``) rather than quadratic, and routing never couples tokens
+    across groups.  Expert-dim sharding constraints make XLA partition
+    experts over ``ep`` and insert the all_to_alls (explicit ``mesh``, or
+    the ambient jit mesh when ``mesh`` is None).
+    """
     orig_shape = x.shape
     d = orig_shape[-1]
     xt = x.reshape(-1, d)                                   # [T, D]
@@ -66,21 +91,28 @@ def switch_moe(x, params, *, capacity_factor=1.25, mesh=None):
     wi = params["wi"]["kernel"]
     wo = params["wo"]["kernel"]
     e = wi.shape[0]
-    capacity = int(math.ceil(capacity_factor * t / e))
 
-    logits = xt @ params["router"]["kernel"]                # [T, E]
-    dispatch, combine, aux = switch_route(logits, e, capacity)
+    s = min(group_size, t)
+    while t % s:                                            # divisor of T
+        s -= 1
+    g = t // s
+    xg = xt.reshape(g, s, d)
+    capacity = int(math.ceil(capacity_factor * s / e))
 
-    expert_in = jnp.einsum("td,tec->ecd", xt.astype(jnp.float32),
-                           dispatch)                        # [E, C, D]
-    if mesh is not None:
-        expert_in = constrain(expert_in, mesh, "ep", None, None)
-    h = jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(jnp.float32))
+    logits = jnp.einsum("gsd,de->gse", xg,
+                        params["router"]["kernel"])         # [G, S, E]
+    dispatch, combine, aux = jax.vmap(
+        lambda lg: switch_route(lg, e, capacity))(logits)
+    aux = jnp.mean(aux)
+
+    expert_in = jnp.einsum("gsd,gsec->gecd", xg.astype(jnp.float32),
+                           dispatch)                        # [G, E, C, D]
+    expert_in = _constrain_ep(expert_in, mesh)
+    h = jnp.einsum("gecd,edf->gecf", expert_in, wi.astype(jnp.float32))
     h = jax.nn.gelu(h)
-    expert_out = jnp.einsum("ecf,efd->ecd", h, wo.astype(jnp.float32))
-    if mesh is not None:
-        expert_out = constrain(expert_out, mesh, "ep", None, None)
-    out = jnp.einsum("ecd,tec->td", expert_out, combine)    # [T, D]
+    expert_out = jnp.einsum("gecf,efd->gecd", h, wo.astype(jnp.float32))
+    expert_out = _constrain_ep(expert_out, mesh)
+    out = jnp.einsum("gecd,gsec->gsd", expert_out, combine)  # [G, S, D]
     return out.astype(x.dtype).reshape(orig_shape), aux
 
 

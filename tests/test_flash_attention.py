@@ -1,0 +1,108 @@
+"""Pallas flash-attention kernel vs dense reference (interpret mode on CPU).
+
+The kernel is the TPU hot-op (SURVEY §2.2: the reference has no compute
+kernels of its own; this framework does).  Same test pattern as the rest:
+random tensors, numpy-level expectation, gradients via autograd.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.pallas import flash_attention
+from horovod_tpu.parallel import reference_attention
+
+
+def _rand(b=2, t=128, h=4, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_matches_dense(causal):
+    q, k, v = _rand()
+    expected = reference_attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_dense(causal):
+    q, k, v = _rand(b=1, t=64, h=2, d=16, seed=1)
+
+    def loss_f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bf16():
+    q, k, v = _rand(t=128)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    expected = reference_attention(q, k, v, causal=True)
+    got = flash_attention(qb, kb, vb, causal=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                               np.asarray(expected), rtol=0.1, atol=0.1)
+
+
+def test_flash_non_pow2_seq():
+    """Sequence length not divisible by 128: block picker shrinks blocks."""
+    q, k, v = _rand(t=96, seed=2)
+    expected = reference_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_cross_attention_shapes():
+    """Tkv != Tq (cross attention, non-causal)."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 64, 4, 32).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 128, 4, 32).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 128, 4, 32).astype(np.float32))
+    expected = reference_attention(q, k, v)
+    got = flash_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_in_transformer():
+    """flash_attention drops into TransformerConfig.attn_fn."""
+    from horovod_tpu.models import Transformer, TransformerConfig
+
+    base = TransformerConfig(vocab_size=64, n_layers=1, d_model=32,
+                             n_heads=2, d_ff=64, max_len=32,
+                             dtype=jnp.float32)
+    cfg = TransformerConfig(vocab_size=64, n_layers=1, d_model=32,
+                            n_heads=2, d_ff=64, max_len=32,
+                            dtype=jnp.float32, attn_fn=flash_attention)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 32)))
+    params = Transformer(base).init(jax.random.PRNGKey(0), tokens)["params"]
+    expected = Transformer(base).apply({"params": params}, tokens)
+    got = Transformer(cfg).apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flash_in_ulysses():
+    """flash_attention as the local kernel of Ulysses sequence parallelism."""
+    from horovod_tpu.parallel import make_mesh, ulysses_self_attention
+
+    mesh = make_mesh({"sp": 8})
+    q, k, v = _rand(t=64, h=8, seed=4)
+    expected = reference_attention(q, k, v, causal=True)
+    got = ulysses_self_attention(q, k, v, mesh, causal=True,
+                                 attn_fn=flash_attention)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-4, atol=1e-4)

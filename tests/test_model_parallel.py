@@ -190,11 +190,8 @@ def test_transformer_with_ring_attention(tiny_cfg):
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)
 
-    cfg_ring = TransformerConfig(
-        vocab_size=tiny_cfg.vocab_size, n_layers=tiny_cfg.n_layers,
-        d_model=tiny_cfg.d_model, n_heads=tiny_cfg.n_heads,
-        d_ff=tiny_cfg.d_ff, max_len=tiny_cfg.max_len, dtype=jnp.float32,
-        attn_fn=sp_attn)
+    import dataclasses
+    cfg_ring = dataclasses.replace(tiny_cfg, attn_fn=sp_attn)
 
     tokens = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 32)))
     dense = Transformer(tiny_cfg)
