@@ -4,8 +4,10 @@
 // the Python side automatically because ctypes drops it around foreign
 // calls.
 #include <cstring>
+#include <vector>
 
 #include "core.h"
+#include "optim/bayesian_optimization.h"
 
 using hvd::Core;
 using hvd::CoreConfig;
@@ -64,6 +66,151 @@ uint64_t hvd_core_cache_misses(void* core) {
 
 uint64_t hvd_core_cache_size(void* core) {
   return static_cast<Core*>(core)->cache_size();
+}
+
+// ---- autotuned runtime parameters (reference: ParameterManager values
+// broadcast via Controller::SynchronizeParameters; here the dispatcher
+// polls them) ----
+
+int64_t hvd_core_param_fusion_bytes(void* core) {
+  return static_cast<Core*>(core)->params().fusion_threshold_bytes();
+}
+
+double hvd_core_param_cycle_ms(void* core) {
+  return static_cast<Core*>(core)->params().cycle_time_ms();
+}
+
+int hvd_core_param_hierarchical_allreduce(void* core) {
+  return static_cast<Core*>(core)->params().hierarchical_allreduce() ? 1 : 0;
+}
+
+int hvd_core_param_hierarchical_allgather(void* core) {
+  return static_cast<Core*>(core)->params().hierarchical_allgather() ? 1 : 0;
+}
+
+int hvd_core_param_cache_enabled(void* core) {
+  return static_cast<Core*>(core)->params().cache_enabled() ? 1 : 0;
+}
+
+int hvd_core_autotune_tuning(void* core) {
+  return static_cast<Core*>(core)->params().tuning() ? 1 : 0;
+}
+
+double hvd_core_autotune_best_score(void* core) {
+  return static_cast<Core*>(core)->params().best_score();
+}
+
+// ---- standalone autotune math (unit-tested against numpy oracles) ----
+
+void* hvd_gp_create(double length_scale, double signal_variance,
+                    double noise_variance) {
+  return new hvd::optim::GaussianProcess(length_scale, signal_variance,
+                                         noise_variance);
+}
+
+void hvd_gp_destroy(void* gp) {
+  delete static_cast<hvd::optim::GaussianProcess*>(gp);
+}
+
+// x: n*d row-major.  Returns 0 on success.
+int hvd_gp_fit(void* gp, const double* x, const double* y, int n, int d) {
+  std::vector<std::vector<double>> xv(n, std::vector<double>(d));
+  for (int i = 0; i < n; ++i)
+    for (int j = 0; j < d; ++j) xv[i][j] = x[i * d + j];
+  std::vector<double> yv(y, y + n);
+  return static_cast<hvd::optim::GaussianProcess*>(gp)->Fit(xv, yv) ? 0 : -1;
+}
+
+void hvd_gp_predict(void* gp, const double* x, int d, double* mean,
+                    double* variance) {
+  std::vector<double> xv(x, x + d);
+  static_cast<hvd::optim::GaussianProcess*>(gp)->Predict(xv, mean, variance);
+}
+
+double hvd_expected_improvement(double mean, double stddev, double best,
+                                double xi) {
+  return hvd::optim::ExpectedImprovement(mean, stddev, best, xi);
+}
+
+void* hvd_bo_create(const double* low, const double* high, int d,
+                    double gp_noise, int num_candidates) {
+  return new hvd::optim::BayesianOptimizer(
+      std::vector<double>(low, low + d), std::vector<double>(high, high + d),
+      gp_noise, num_candidates);
+}
+
+void hvd_bo_destroy(void* bo) {
+  delete static_cast<hvd::optim::BayesianOptimizer*>(bo);
+}
+
+void hvd_bo_add_sample(void* bo, const double* x, int d, double y) {
+  static_cast<hvd::optim::BayesianOptimizer*>(bo)->AddSample(
+      std::vector<double>(x, x + d), y);
+}
+
+void hvd_bo_suggest(void* bo, double* out, int d) {
+  std::vector<double> x =
+      static_cast<hvd::optim::BayesianOptimizer*>(bo)->Suggest();
+  for (int i = 0; i < d && i < static_cast<int>(x.size()); ++i) out[i] = x[i];
+}
+
+double hvd_bo_best_y(void* bo) {
+  return static_cast<hvd::optim::BayesianOptimizer*>(bo)->best_y();
+}
+
+// ---- standalone ParameterManager (virtual-clock driven, for tests) ----
+
+void* hvd_pm_create(int warmup, int steady_state, int bayes_max,
+                    double gp_noise, const char* log_path,
+                    int64_t fusion_bytes, double cycle_ms) {
+  hvd::ParameterManager::Options o;
+  o.active = true;
+  o.warmup_samples = warmup;
+  o.steady_state_samples = steady_state;
+  o.bayes_opt_max_samples = bayes_max;
+  o.gaussian_process_noise = gp_noise;
+  if (log_path) o.log_path = log_path;
+  o.fusion_threshold_bytes = fusion_bytes;
+  o.cycle_time_ms = cycle_ms;
+  return new hvd::ParameterManager(o);
+}
+
+void hvd_pm_destroy(void* pm) {
+  delete static_cast<hvd::ParameterManager*>(pm);
+}
+
+void hvd_pm_record(void* pm, int64_t bytes) {
+  static_cast<hvd::ParameterManager*>(pm)->Record(bytes);
+}
+
+int hvd_pm_update(void* pm, double now_seconds) {
+  return static_cast<hvd::ParameterManager*>(pm)->Update(now_seconds) ? 1 : 0;
+}
+
+int64_t hvd_pm_fusion_bytes(void* pm) {
+  return static_cast<hvd::ParameterManager*>(pm)->fusion_threshold_bytes();
+}
+
+double hvd_pm_cycle_ms(void* pm) {
+  return static_cast<hvd::ParameterManager*>(pm)->cycle_time_ms();
+}
+
+int hvd_pm_hierarchical_allreduce(void* pm) {
+  return static_cast<hvd::ParameterManager*>(pm)->hierarchical_allreduce()
+             ? 1
+             : 0;
+}
+
+int hvd_pm_cache_enabled(void* pm) {
+  return static_cast<hvd::ParameterManager*>(pm)->cache_enabled() ? 1 : 0;
+}
+
+int hvd_pm_tuning(void* pm) {
+  return static_cast<hvd::ParameterManager*>(pm)->tuning() ? 1 : 0;
+}
+
+double hvd_pm_best_score(void* pm) {
+  return static_cast<hvd::ParameterManager*>(pm)->best_score();
 }
 
 }  // extern "C"

@@ -83,6 +83,19 @@ CoreConfig CoreConfig::FromEnv(int size) {
       EnvDouble("HVD_STALL_CHECK_TIME_SECONDS", c.stall_warning_sec);
   c.stall_shutdown_sec =
       EnvDouble("HVD_STALL_SHUTDOWN_TIME_SECONDS", c.stall_shutdown_sec);
+  c.autotune = EnvBool("HVD_AUTOTUNE", false);
+  c.autotune_log = EnvStr("HVD_AUTOTUNE_LOG", "");
+  c.autotune_warmup_samples = static_cast<int>(
+      EnvInt("HVD_AUTOTUNE_WARMUP_SAMPLES", c.autotune_warmup_samples));
+  c.autotune_steady_state_samples = static_cast<int>(EnvInt(
+      "HVD_AUTOTUNE_STEADY_STATE_SAMPLES", c.autotune_steady_state_samples));
+  c.autotune_bayes_opt_max_samples = static_cast<int>(EnvInt(
+      "HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", c.autotune_bayes_opt_max_samples));
+  c.autotune_gaussian_process_noise =
+      EnvDouble("HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE",
+                c.autotune_gaussian_process_noise);
+  c.hierarchical_allreduce = EnvBool("HVD_HIERARCHICAL_ALLREDUCE", false);
+  c.hierarchical_allgather = EnvBool("HVD_HIERARCHICAL_ALLGATHER", false);
   return c;
 }
 

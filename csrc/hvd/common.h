@@ -131,6 +131,16 @@ struct CoreConfig {
   double stall_warning_sec = 60.0;
   double stall_shutdown_sec = 0.0;
 
+  // Autotune (reference: HOROVOD_AUTOTUNE* knobs, operations.cc:404-500).
+  bool autotune = false;
+  std::string autotune_log;
+  int autotune_warmup_samples = 3;
+  int autotune_steady_state_samples = 10;
+  int autotune_bayes_opt_max_samples = 20;
+  double autotune_gaussian_process_noise = 0.8;
+  bool hierarchical_allreduce = false;
+  bool hierarchical_allgather = false;
+
   static CoreConfig FromEnv(int size);
 };
 

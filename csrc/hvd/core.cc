@@ -59,9 +59,28 @@ void ResponseCache::Invalidate(const std::string& name) {
 }
 
 // --------------------------------------------------------------------- Core
+namespace {
+ParameterManager::Options PmOptions(const CoreConfig& c) {
+  ParameterManager::Options o;
+  o.active = c.autotune;
+  o.warmup_samples = c.autotune_warmup_samples;
+  o.steady_state_samples = c.autotune_steady_state_samples;
+  o.bayes_opt_max_samples = c.autotune_bayes_opt_max_samples;
+  o.gaussian_process_noise = c.autotune_gaussian_process_noise;
+  o.log_path = c.autotune_log;
+  o.fusion_threshold_bytes = c.fusion_threshold_bytes;
+  o.cycle_time_ms = c.cycle_time_ms;
+  o.hierarchical_allreduce = c.hierarchical_allreduce;
+  o.hierarchical_allgather = c.hierarchical_allgather;
+  return o;
+}
+}  // namespace
+
 Core::Core(const CoreConfig& config)
     : config_(config),
-      cache_(static_cast<size_t>(config.cache_capacity)) {}
+      cache_(static_cast<size_t>(config.cache_capacity)),
+      params_(PmOptions(config)),
+      epoch_(Clock::now()) {}
 
 Core::~Core() { Shutdown(); }
 
@@ -153,11 +172,12 @@ void Core::MarkDone(uint64_t batch_id, const char* error_or_null) {
 }
 
 void Core::BackgroundLoop() {
-  // Reference: operations.cc:550 RunLoopOnce under a ~cycle_time wait.
-  auto cycle =
-      std::chrono::duration<double, std::milli>(config_.cycle_time_ms);
+  // Reference: operations.cc:550 RunLoopOnce under a ~cycle_time wait.  The
+  // cycle time is re-read each iteration so the autotuner can steer it.
   std::unique_lock<std::mutex> lock(state_mu_);
   while (running_) {
+    auto cycle =
+        std::chrono::duration<double, std::milli>(params_.cycle_time_ms());
     wakeup_.wait_for(lock, cycle);
     if (!running_) break;
     lock.unlock();
@@ -239,6 +259,13 @@ void Core::RunCycle() {
 
   // 4. fuse + publish.
   FuseAndPublish(std::move(ready));
+
+  // 4b. autotune window bookkeeping (reference: ParameterManager::Update
+  // called from the controller per response list).
+  if (params_.tuning()) {
+    params_.Update(std::chrono::duration<double>(Clock::now() - epoch_)
+                       .count());
+  }
 
   // 5. join barrier: all ranks joined and nothing pending -> complete joins
   // with the last rank to join (reference: controller joined handling).
@@ -387,8 +414,12 @@ Response Core::ConstructResponse(const std::string& name, NameEntry& entry) {
 
   // Cache bookkeeping: record the steady-state signature (reference puts
   // executed responses in the cache so the next cycle takes the fast path).
-  cache_.Lookup(first);
-  cache_.Put(first);
+  // The autotuner may switch the cache off (reference: CacheEnabled
+  // categorical parameter).
+  if (params_.cache_enabled()) {
+    cache_.Lookup(first);
+    cache_.Put(first);
+  }
 
   Response resp;
   switch (entry.type) {
@@ -424,15 +455,18 @@ void Core::FuseAndPublish(std::vector<Response> ready) {
   ptrdiff_t bucket = -1;  // index into out (push_back may reallocate)
   int64_t bucket_bytes = 0;
 
+  const int64_t fusion_threshold = params_.fusion_threshold_bytes();
   for (Response& resp : ready) {
+    if (resp.type != ResponseType::kError) params_.Record(resp.fused_bytes);
     if (resp.type == ResponseType::kAllreduce && resp.error.empty()) {
       bool compatible =
           bucket >= 0 && out[bucket].dtype == resp.dtype &&
           out[bucket].op == resp.op && out[bucket].prescale == resp.prescale &&
           out[bucket].postscale == resp.postscale &&
-          bucket_bytes + resp.fused_bytes <= config_.fusion_threshold_bytes;
+          bucket_bytes + resp.fused_bytes <= fusion_threshold;
       if (compatible) {
         bucket_bytes += resp.fused_bytes;
+        out[bucket].fused_bytes = bucket_bytes;
         for (auto& e : resp.entries) {
           out[bucket].entries.push_back(std::move(e));
         }

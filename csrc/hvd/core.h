@@ -32,6 +32,7 @@
 
 #include "common.h"
 #include "message.h"
+#include "parameter_manager.h"
 #include "timeline.h"
 
 namespace hvd {
@@ -133,6 +134,11 @@ class Core {
   uint64_t cache_misses() const { return cache_.misses(); }
   size_t cache_size() const { return cache_.size(); }
 
+  // Live tuned values (the Python dispatcher polls these to pick the data
+  // plane's fusion limit, cycle time and hierarchy; reference: tuned
+  // parameters broadcast via Controller::SynchronizeParameters).
+  const ParameterManager& params() const { return params_; }
+
  private:
   struct NameEntry {
     Clock::time_point first_ts;
@@ -157,6 +163,8 @@ class Core {
   Timeline timeline_;
   TensorQueue tensor_queue_;
   ResponseCache cache_;
+  ParameterManager params_;
+  Clock::time_point epoch_;
 
   std::mutex state_mu_;
   std::condition_variable wakeup_;

@@ -1,0 +1,128 @@
+#include "bayesian_optimization.h"
+
+#include <cmath>
+#include <limits>
+
+namespace hvd {
+namespace optim {
+
+namespace {
+const double kInvSqrt2 = 0.7071067811865476;
+const double kInvSqrt2Pi = 0.3989422804014327;
+
+double NormCdf(double z) { return 0.5 * (1.0 + std::erf(z * kInvSqrt2)); }
+double NormPdf(double z) { return kInvSqrt2Pi * std::exp(-0.5 * z * z); }
+}  // namespace
+
+double ExpectedImprovement(double mean, double stddev, double best,
+                           double xi) {
+  double improvement = mean - best - xi;
+  if (stddev <= 0.0) return improvement > 0.0 ? improvement : 0.0;
+  double z = improvement / stddev;
+  return improvement * NormCdf(z) + stddev * NormPdf(z);
+}
+
+double HaltonElement(int index, int base) {
+  double f = 1.0, r = 0.0;
+  int i = index;
+  while (i > 0) {
+    f /= base;
+    r += f * (i % base);
+    i /= base;
+  }
+  return r;
+}
+
+BayesianOptimizer::BayesianOptimizer(std::vector<double> low,
+                                     std::vector<double> high,
+                                     double gp_noise_variance,
+                                     int num_candidates)
+    : low_(std::move(low)),
+      high_(std::move(high)),
+      gp_noise_variance_(gp_noise_variance),
+      num_candidates_(num_candidates),
+      best_y_(-std::numeric_limits<double>::infinity()) {}
+
+void BayesianOptimizer::AddSample(const std::vector<double>& x, double y) {
+  x_.push_back(x);
+  y_.push_back(y);
+  if (y > best_y_) {
+    best_y_ = y;
+    best_x_ = x;
+  }
+}
+
+std::vector<double> BayesianOptimizer::Candidate(int index) const {
+  // Low-discrepancy point: per-dimension Halton with coprime bases.
+  static const int kBases[] = {2, 3, 5, 7, 11, 13};
+  std::vector<double> x(low_.size());
+  for (size_t d = 0; d < low_.size(); ++d) {
+    double u = HaltonElement(index + 1, kBases[d % 6]);
+    x[d] = low_[d] + u * (high_[d] - low_[d]);
+  }
+  return x;
+}
+
+std::vector<double> BayesianOptimizer::Suggest() {
+  size_t dim = low_.size();
+  // Seed phase: center first, then Halton points, until the surrogate has
+  // enough support (>= dim + 2 samples).
+  if (x_.size() < dim + 2) {
+    if (seeds_used_ == 0) {
+      ++seeds_used_;
+      std::vector<double> center(dim);
+      for (size_t d = 0; d < dim; ++d) center[d] = 0.5 * (low_[d] + high_[d]);
+      return center;
+    }
+    return Candidate(17 * seeds_used_++);  // stride the sequence for spread
+  }
+
+  // Normalize y to zero mean / unit scale for GP conditioning.
+  double mean_y = 0.0;
+  for (double y : y_) mean_y += y;
+  mean_y /= y_.size();
+  double var_y = 0.0;
+  for (double y : y_) var_y += (y - mean_y) * (y - mean_y);
+  var_y /= y_.size();
+  double scale = var_y > 1e-12 ? std::sqrt(var_y) : 1.0;
+
+  // Normalize x into the unit box so one length scale fits all dims.
+  auto norm = [&](const std::vector<double>& x) {
+    std::vector<double> u(dim);
+    for (size_t d = 0; d < dim; ++d) {
+      double span = high_[d] - low_[d];
+      u[d] = span > 0 ? (x[d] - low_[d]) / span : 0.0;
+    }
+    return u;
+  };
+  std::vector<std::vector<double>> xu(x_.size());
+  std::vector<double> yn(y_.size());
+  for (size_t i = 0; i < x_.size(); ++i) {
+    xu[i] = norm(x_[i]);
+    yn[i] = (y_[i] - mean_y) / scale;
+  }
+
+  GaussianProcess gp(/*length_scale=*/0.25, /*signal_variance=*/1.0,
+                     gp_noise_variance_);
+  if (!gp.Fit(xu, yn)) {
+    return Candidate(17 * seeds_used_++);
+  }
+
+  double best_norm = (best_y_ - mean_y) / scale;
+  double best_ei = -1.0;
+  std::vector<double> best_cand = Candidate(0);
+  for (int c = 0; c < num_candidates_; ++c) {
+    std::vector<double> cand = Candidate(c);
+    double m, v;
+    gp.Predict(norm(cand), &m, &v);
+    double ei = ExpectedImprovement(m, std::sqrt(v), best_norm);
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_cand = cand;
+    }
+  }
+  return best_cand;
+}
+
+}  // namespace optim
+}  // namespace hvd

@@ -1,0 +1,65 @@
+// Bayesian optimization for the autotuner.
+//
+// TPU-native re-design of the reference's optimizer (reference:
+// horovod/common/optim/bayesian_optimization.{h,cc} — GP surrogate +
+// expected-improvement acquisition, maximized with L-BFGS from random
+// restarts).  This implementation maximizes EI over a deterministic
+// low-discrepancy (Halton) candidate sweep instead of L-BFGS: the search
+// space is 2-dimensional and tiny, a 256-point sweep is exhaustive enough,
+// and determinism keeps every rank's tuner in lockstep without an extra
+// broadcast (the reference must SynchronizeParameters from rank 0;
+// determinism makes that a no-op here, though the PM still exposes the
+// sync'd values).
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+#include "gaussian_process.h"
+
+namespace hvd {
+namespace optim {
+
+// Expected improvement for MAXIMIZATION at a point with posterior
+// (mean, stddev), given the best observed value so far and exploration
+// margin xi.
+double ExpectedImprovement(double mean, double stddev, double best,
+                           double xi = 0.01);
+
+// Element i of the base-`base` Halton sequence (1-indexed), in (0, 1).
+double HaltonElement(int index, int base);
+
+class BayesianOptimizer {
+ public:
+  // Bounds: per-dimension [low, high]; all suggestions live inside.
+  BayesianOptimizer(std::vector<double> low, std::vector<double> high,
+                    double gp_noise_variance = 1e-4,
+                    int num_candidates = 256);
+
+  void AddSample(const std::vector<double>& x, double y);
+
+  // Next point to evaluate: the first few calls walk seed points (corners +
+  // center of the box, then Halton points) before enough samples exist for
+  // the surrogate; afterwards it is the EI argmax over the candidate sweep.
+  std::vector<double> Suggest();
+
+  size_t num_samples() const { return x_.size(); }
+  const std::vector<double>& best_x() const { return best_x_; }
+  double best_y() const { return best_y_; }
+
+ private:
+  std::vector<double> Candidate(int index) const;
+
+  std::vector<double> low_, high_;
+  double gp_noise_variance_;
+  int num_candidates_;
+  int seeds_used_ = 0;
+
+  std::vector<std::vector<double>> x_;
+  std::vector<double> y_;
+  std::vector<double> best_x_;
+  double best_y_;
+};
+
+}  // namespace optim
+}  // namespace hvd

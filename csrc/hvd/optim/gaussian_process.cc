@@ -1,0 +1,101 @@
+#include "gaussian_process.h"
+
+#include <cmath>
+
+namespace hvd {
+namespace optim {
+
+bool CholeskyFactor(std::vector<double>* a, size_t n) {
+  std::vector<double>& m = *a;
+  for (size_t j = 0; j < n; ++j) {
+    double diag = m[j * n + j];
+    for (size_t k = 0; k < j; ++k) diag -= m[j * n + k] * m[j * n + k];
+    if (diag <= 0.0) return false;
+    diag = std::sqrt(diag);
+    m[j * n + j] = diag;
+    for (size_t i = j + 1; i < n; ++i) {
+      double v = m[i * n + j];
+      for (size_t k = 0; k < j; ++k) v -= m[i * n + k] * m[j * n + k];
+      m[i * n + j] = v / diag;
+    }
+    // zero the strict upper triangle so the factor is unambiguous
+    for (size_t k = j + 1; k < n; ++k) m[j * n + k] = 0.0;
+  }
+  return true;
+}
+
+std::vector<double> CholeskySolve(const std::vector<double>& chol, size_t n,
+                                  std::vector<double> b) {
+  // forward: L z = b
+  for (size_t i = 0; i < n; ++i) {
+    double v = b[i];
+    for (size_t k = 0; k < i; ++k) v -= chol[i * n + k] * b[k];
+    b[i] = v / chol[i * n + i];
+  }
+  // backward: L^T x = z
+  for (size_t ii = n; ii-- > 0;) {
+    double v = b[ii];
+    for (size_t k = ii + 1; k < n; ++k) v -= chol[k * n + ii] * b[k];
+    b[ii] = v / chol[ii * n + ii];
+  }
+  return b;
+}
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double sq = 0.0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    sq += d * d;
+  }
+  return signal_variance_ *
+         std::exp(-sq / (2.0 * length_scale_ * length_scale_));
+}
+
+bool GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
+                          const std::vector<double>& y) {
+  size_t n = x.size();
+  std::vector<double> k(n * n);
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double v = Kernel(x[i], x[j]);
+      if (i == j) v += noise_variance_;
+      k[i * n + j] = v;
+      k[j * n + i] = v;
+    }
+  }
+  if (!CholeskyFactor(&k, n)) return false;
+  x_ = x;
+  chol_ = std::move(k);
+  alpha_ = CholeskySolve(chol_, n, y);
+  return true;
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mean,
+                              double* variance) const {
+  size_t n = x_.size();
+  if (n == 0) {
+    *mean = 0.0;
+    *variance = signal_variance_;
+    return;
+  }
+  std::vector<double> ks(n);
+  for (size_t i = 0; i < n; ++i) ks[i] = Kernel(x_[i], x);
+  double m = 0.0;
+  for (size_t i = 0; i < n; ++i) m += ks[i] * alpha_[i];
+  *mean = m;
+  // var = k(x,x) - ks^T (K + nI)^-1 ks, via v = L^-1 ks, var = kxx - v.v
+  std::vector<double> v = ks;
+  for (size_t i = 0; i < n; ++i) {
+    double t = v[i];
+    for (size_t k = 0; k < i; ++k) t -= chol_[i * n + k] * v[k];
+    v[i] = t / chol_[i * n + i];
+  }
+  double reduction = 0.0;
+  for (size_t i = 0; i < n; ++i) reduction += v[i] * v[i];
+  double var = Kernel(x, x) - reduction;
+  *variance = var > 0.0 ? var : 0.0;
+}
+
+}  // namespace optim
+}  // namespace hvd

@@ -1,0 +1,65 @@
+// Gaussian-process regression for the autotuner.
+//
+// TPU-native re-design of the reference's GP (reference:
+// horovod/common/optim/gaussian_process.{h,cc} — Eigen-based GP with an
+// RBF kernel used by the Bayesian-optimization autotuner).  This
+// implementation is dependency-free: the (tiny — tens of samples) dense
+// linear algebra is done with a hand-rolled Cholesky factorization.
+//
+// Model:  y ~ GP(0, k) + N(0, noise_variance)
+//         k(a, b) = signal_variance * exp(-||a - b||^2 / (2 * length_scale^2))
+// Posterior at x*:
+//         mean = k*^T (K + noise I)^-1 y
+//         var  = k(x*,x*) - k*^T (K + noise I)^-1 k*
+#pragma once
+
+#include <cstddef>
+#include <vector>
+
+namespace hvd {
+namespace optim {
+
+class GaussianProcess {
+ public:
+  explicit GaussianProcess(double length_scale = 1.0,
+                           double signal_variance = 1.0,
+                           double noise_variance = 1e-6)
+      : length_scale_(length_scale),
+        signal_variance_(signal_variance),
+        noise_variance_(noise_variance) {}
+
+  // Fit on n points of dimension d.  Returns false if the kernel matrix is
+  // not positive definite (degenerate inputs).
+  bool Fit(const std::vector<std::vector<double>>& x,
+           const std::vector<double>& y);
+
+  // Posterior mean and variance at a query point.  Requires Fit.  Variance
+  // is clamped at zero.
+  void Predict(const std::vector<double>& x, double* mean,
+               double* variance) const;
+
+  size_t num_samples() const { return x_.size(); }
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  double length_scale_;
+  double signal_variance_;
+  double noise_variance_;
+
+  std::vector<std::vector<double>> x_;  // training inputs
+  std::vector<double> alpha_;           // (K + noise I)^-1 y
+  std::vector<double> chol_;            // lower Cholesky factor, row-major
+};
+
+// In-place Cholesky factorization of a symmetric positive-definite n x n
+// row-major matrix; on success the lower triangle holds L with A = L L^T.
+bool CholeskyFactor(std::vector<double>* a, size_t n);
+
+// Solve L z = b (forward) then L^T x = z (backward) given the lower factor.
+std::vector<double> CholeskySolve(const std::vector<double>& chol, size_t n,
+                                  std::vector<double> b);
+
+}  // namespace optim
+}  // namespace hvd

@@ -1,0 +1,164 @@
+#include "parameter_manager.h"
+
+#include <algorithm>
+#include <cmath>
+
+namespace hvd {
+
+namespace {
+// Search space: log2(fusion MB) in [0, 8] (1 MB .. 256 MB), cycle in
+// [1, 25] ms (reference tunes the same two knobs,
+// parameter_manager.cc joint BayesianParameter).
+const double kFusionLogLow = 0.0, kFusionLogHigh = 8.0;
+const double kCycleLow = 1.0, kCycleHigh = 25.0;
+
+int64_t FusionBytesFromLog2Mb(double log2_mb) {
+  return static_cast<int64_t>(std::llround(std::pow(2.0, log2_mb))) * 1024 *
+         1024;
+}
+}  // namespace
+
+ParameterManager::ParameterManager(const Options& opts)
+    : opts_(opts),
+      discard_left_(opts.warmup_samples),
+      best_fusion_log2_mb_(
+          std::log2(std::max<double>(1.0, static_cast<double>(
+                                              opts.fusion_threshold_bytes) /
+                                              (1024.0 * 1024.0)))),
+      best_cycle_ms_(opts.cycle_time_ms),
+      best_cat_{opts.hierarchical_allreduce, opts.hierarchical_allgather,
+                opts.cache_enabled},
+      fusion_bytes_(opts.fusion_threshold_bytes),
+      cycle_ms_(opts.cycle_time_ms),
+      hier_allreduce_(opts.hierarchical_allreduce),
+      hier_allgather_(opts.hierarchical_allgather),
+      cache_enabled_(opts.cache_enabled),
+      tuning_(opts.active),
+      best_score_(0.0) {
+  if (!opts.active) return;
+  // Categorical walk (reference tries its CategoricalParameters
+  // sequentially; same set here: hierarchy on/off, cache on/off).
+  walk_ = {
+      {false, false, true},
+      {true, false, true},
+      {false, true, true},
+      {true, true, true},
+      {false, false, false},
+  };
+  if (!opts.log_path.empty()) {
+    log_ = std::fopen(opts.log_path.c_str(), "w");
+    if (log_) {
+      std::fprintf(log_,
+                   "score_bytes_per_sec,fusion_threshold_mb,cycle_time_ms,"
+                   "hierarchical_allreduce,hierarchical_allgather,"
+                   "cache_enabled\n");
+    }
+  }
+  bayes_ = std::make_unique<optim::BayesianOptimizer>(
+      std::vector<double>{kFusionLogLow, kCycleLow},
+      std::vector<double>{kFusionLogHigh, kCycleHigh},
+      opts.gaussian_process_noise);
+  ApplyPoint(bayes_->Suggest());
+}
+
+ParameterManager::~ParameterManager() {
+  if (log_) std::fclose(log_);
+}
+
+void ParameterManager::Record(int64_t bytes) {
+  if (!tuning_.load()) return;
+  window_bytes_ += bytes;
+}
+
+void ParameterManager::ApplyPoint(const std::vector<double>& point) {
+  current_point_ = point;
+  const Categorical& cat = walk_[walk_index_];
+  fusion_bytes_.store(FusionBytesFromLog2Mb(point[0]));
+  cycle_ms_.store(point[1]);
+  hier_allreduce_.store(cat.hier_allreduce);
+  hier_allgather_.store(cat.hier_allgather);
+  cache_enabled_.store(cat.cache_enabled);
+  discard_left_ = opts_.warmup_samples;
+  window_scores_.clear();
+  window_bytes_ = 0;
+  window_start_ = -1.0;
+}
+
+void ParameterManager::ApplyBest() {
+  fusion_bytes_.store(FusionBytesFromLog2Mb(best_fusion_log2_mb_));
+  cycle_ms_.store(best_cycle_ms_);
+  hier_allreduce_.store(best_cat_.hier_allreduce);
+  hier_allgather_.store(best_cat_.hier_allgather);
+  cache_enabled_.store(best_cat_.cache_enabled);
+  tuning_.store(false);
+  if (log_) {
+    std::fflush(log_);
+  }
+}
+
+void ParameterManager::NextCategorical() {
+  ++walk_index_;
+  if (walk_index_ >= walk_.size()) {
+    ApplyBest();
+    return;
+  }
+  bayes_ = std::make_unique<optim::BayesianOptimizer>(
+      std::vector<double>{kFusionLogLow, kCycleLow},
+      std::vector<double>{kFusionLogHigh, kCycleHigh},
+      opts_.gaussian_process_noise);
+  ApplyPoint(bayes_->Suggest());
+}
+
+void ParameterManager::LogRow(double score) {
+  if (!log_) return;
+  std::fprintf(log_, "%.1f,%.2f,%.2f,%d,%d,%d\n", score,
+               static_cast<double>(fusion_bytes_.load()) / (1024.0 * 1024.0),
+               cycle_ms_.load(), hier_allreduce_.load() ? 1 : 0,
+               hier_allgather_.load() ? 1 : 0, cache_enabled_.load() ? 1 : 0);
+}
+
+bool ParameterManager::Update(double now_seconds) {
+  if (!tuning_.load()) return false;
+  if (window_start_ < 0.0) {
+    window_start_ = now_seconds;
+    window_bytes_ = 0;
+    return false;
+  }
+  double elapsed = now_seconds - window_start_;
+  if (elapsed <= 0.0) return false;
+  double score = static_cast<double>(window_bytes_) / elapsed;
+  window_start_ = now_seconds;
+  window_bytes_ = 0;
+
+  if (discard_left_ > 0) {
+    --discard_left_;
+    return false;
+  }
+  window_scores_.push_back(score);
+  if (window_scores_.size() < static_cast<size_t>(opts_.steady_state_samples))
+    return false;
+
+  // Median of the windows = the observation for the current point.
+  std::sort(window_scores_.begin(), window_scores_.end());
+  double observed = window_scores_[window_scores_.size() / 2];
+  window_scores_.clear();
+  LogRow(observed);
+
+  if (observed > best_score_.load()) {
+    best_score_.store(observed);
+    best_fusion_log2_mb_ = current_point_[0];
+    best_cycle_ms_ = current_point_[1];
+    best_cat_ = walk_[walk_index_];
+  }
+
+  bayes_->AddSample(current_point_, observed);
+  if (bayes_->num_samples() >=
+      static_cast<size_t>(opts_.bayes_opt_max_samples)) {
+    NextCategorical();
+  } else {
+    ApplyPoint(bayes_->Suggest());
+  }
+  return true;
+}
+
+}  // namespace hvd

@@ -1,0 +1,116 @@
+// Online autotuning of runtime knobs.
+//
+// TPU-native re-design of the reference's ParameterManager (reference:
+// horovod/common/parameter_manager.{h,cc} — Bayesian-optimized tuning of
+// fusion threshold and cycle time plus sequentially-tried categorical
+// parameters, scored by negotiated bytes/sec; rank 0 tunes and broadcasts
+// winners via Controller::SynchronizeParameters, controller.cc:33).
+//
+// Differences by design: scoring and search are fully deterministic given
+// the same (bytes, time) observations, and in single-controller mode (this
+// build's native core owns negotiation for all ranks) no cross-rank
+// synchronization step is needed — the tuned values are published to the
+// dispatcher through atomic getters instead.
+//
+// Tuning walk: for each categorical configuration
+//     (hierarchical_allreduce, hierarchical_allgather, cache_enabled)
+// in a fixed order, run `bayes_opt_max_samples` Bayesian-optimization
+// evaluations over (log2 fusion MB, cycle time ms).  Each evaluation point
+// is held for `steady_state_samples` score windows (median taken); the
+// first `warmup_samples` windows after every parameter change are
+// discarded.  When the walk finishes, the globally best configuration is
+// pinned and tuning stops (reference semantics: ParameterManager
+// `SetAutoTuning(false)` once tuning completes).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "optim/bayesian_optimization.h"
+
+namespace hvd {
+
+class ParameterManager {
+ public:
+  struct Options {
+    bool active = false;
+    int warmup_samples = 3;          // HVD_AUTOTUNE_WARMUP_SAMPLES
+    int steady_state_samples = 10;   // HVD_AUTOTUNE_STEADY_STATE_SAMPLES
+    int bayes_opt_max_samples = 20;  // HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES
+    double gaussian_process_noise = 0.8;  // HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE
+    std::string log_path;            // HVD_AUTOTUNE_LOG (CSV)
+
+    // Starting values (the pinned result if tuning is off).
+    int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
+    double cycle_time_ms = 1.0;
+    bool hierarchical_allreduce = false;
+    bool hierarchical_allgather = false;
+    bool cache_enabled = true;
+  };
+
+  explicit ParameterManager(const Options& opts);
+  ~ParameterManager();
+
+  // Record negotiated tensor bytes (coordinator thread, per published
+  // data-plane response).
+  void Record(int64_t bytes);
+
+  // Close a score window at `now_seconds` (any monotonically increasing
+  // clock; the core passes steady-clock seconds, tests pass synthetic
+  // time).  Returns true if the tuned values changed.
+  bool Update(double now_seconds);
+
+  // Current values (any thread).
+  int64_t fusion_threshold_bytes() const { return fusion_bytes_.load(); }
+  double cycle_time_ms() const { return cycle_ms_.load(); }
+  bool hierarchical_allreduce() const { return hier_allreduce_.load(); }
+  bool hierarchical_allgather() const { return hier_allgather_.load(); }
+  bool cache_enabled() const { return cache_enabled_.load(); }
+
+  bool tuning() const { return tuning_.load(); }
+  double best_score() const { return best_score_.load(); }  // bytes/sec
+
+ private:
+  struct Categorical {
+    bool hier_allreduce, hier_allgather, cache_enabled;
+  };
+
+  void ApplyPoint(const std::vector<double>& point);
+  void ApplyBest();
+  void NextCategorical();
+  void LogRow(double score);
+
+  Options opts_;
+  std::vector<Categorical> walk_;
+  size_t walk_index_ = 0;
+  std::unique_ptr<optim::BayesianOptimizer> bayes_;
+  std::vector<double> current_point_;
+
+  // Window accounting (coordinator thread only).
+  int64_t window_bytes_ = 0;
+  double window_start_ = -1.0;
+  int discard_left_;
+  std::vector<double> window_scores_;
+
+  // Best seen across the whole walk.
+  double best_fusion_log2_mb_;
+  double best_cycle_ms_;
+  Categorical best_cat_;
+
+  // Published values.
+  std::atomic<int64_t> fusion_bytes_;
+  std::atomic<double> cycle_ms_;
+  std::atomic<bool> hier_allreduce_;
+  std::atomic<bool> hier_allgather_;
+  std::atomic<bool> cache_enabled_;
+  std::atomic<bool> tuning_;
+  std::atomic<double> best_score_;
+
+  FILE* log_ = nullptr;
+};
+
+}  // namespace hvd

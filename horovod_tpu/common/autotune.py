@@ -1,0 +1,158 @@
+"""Python face of the native autotuner (reference:
+``horovod/common/parameter_manager.{h,cc}``,
+``horovod/common/optim/bayesian_optimization.cc``,
+``horovod/common/optim/gaussian_process.cc``).
+
+The math and the tuning walk live in C++ (``csrc/hvd/parameter_manager.cc``,
+``csrc/hvd/optim/``); these thin ctypes wrappers exist for tests (numpy
+oracle comparisons) and for embedding the tuner in pure-Python controllers.
+"""
+
+import ctypes
+
+import numpy as np
+
+
+_lib_handle = None
+
+
+def _lib():
+    global _lib_handle
+    if _lib_handle is None:
+        from horovod_tpu.ops.native_controller import _load_lib
+        _lib_handle = _load_lib()
+    return _lib_handle
+
+
+def _as_dbl(arr):
+    a = np.ascontiguousarray(arr, dtype=np.float64)
+    return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+class GaussianProcess:
+    """GP regression with an RBF kernel (native implementation).
+
+    k(a, b) = signal_variance * exp(-||a-b||^2 / (2 length_scale^2)),
+    observation noise ``noise_variance`` added on the diagonal.
+    """
+
+    def __init__(self, length_scale=1.0, signal_variance=1.0,
+                 noise_variance=1e-6):
+        self._lib = _lib()
+        self._h = self._lib.hvd_gp_create(length_scale, signal_variance,
+                                          noise_variance)
+
+    def fit(self, x, y):
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        y = np.asarray(y, dtype=np.float64)
+        xa, xp = _as_dbl(x)
+        ya, yp = _as_dbl(y)
+        rc = self._lib.hvd_gp_fit(self._h, xp, yp, x.shape[0], x.shape[1])
+        if rc != 0:
+            raise ValueError("GP fit failed: kernel matrix not SPD")
+        return self
+
+    def predict(self, x):
+        """Posterior (mean, variance) at a single point."""
+        xa, xp = _as_dbl(np.asarray(x, dtype=np.float64).ravel())
+        mean = ctypes.c_double()
+        var = ctypes.c_double()
+        self._lib.hvd_gp_predict(self._h, xp, xa.size, ctypes.byref(mean),
+                                 ctypes.byref(var))
+        return mean.value, var.value
+
+    def __del__(self):
+        try:
+            self._lib.hvd_gp_destroy(self._h)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def expected_improvement(mean, stddev, best, xi=0.01):
+    """EI for maximization (native implementation)."""
+    return float(_lib().hvd_expected_improvement(mean, stddev, best, xi))
+
+
+class BayesianOptimizer:
+    """GP + expected-improvement search over a box (native)."""
+
+    def __init__(self, low, high, gp_noise=1e-4, num_candidates=256):
+        self._lib = _lib()
+        low = np.asarray(low, dtype=np.float64)
+        high = np.asarray(high, dtype=np.float64)
+        self._dim = low.size
+        la, lp = _as_dbl(low)
+        ha, hp = _as_dbl(high)
+        self._h = self._lib.hvd_bo_create(lp, hp, self._dim, gp_noise,
+                                          num_candidates)
+
+    def add_sample(self, x, y):
+        xa, xp = _as_dbl(np.asarray(x, dtype=np.float64).ravel())
+        self._lib.hvd_bo_add_sample(self._h, xp, self._dim, float(y))
+
+    def suggest(self):
+        out = np.zeros(self._dim, dtype=np.float64)
+        oa, op = _as_dbl(out)
+        self._lib.hvd_bo_suggest(self._h, op, self._dim)
+        return oa.copy()
+
+    @property
+    def best_y(self):
+        return float(self._lib.hvd_bo_best_y(self._h))
+
+    def __del__(self):
+        try:
+            self._lib.hvd_bo_destroy(self._h)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class ParameterManager:
+    """Virtual-clock ParameterManager handle (native).  The embedded core
+    drives its own instance off the background loop; this standalone handle
+    is for tests and for pure-Python controllers."""
+
+    def __init__(self, warmup_samples=3, steady_state_samples=10,
+                 bayes_opt_max_samples=20, gp_noise=0.8, log_path=None,
+                 fusion_threshold_bytes=64 * 1024 * 1024, cycle_time_ms=1.0):
+        self._lib = _lib()
+        self._h = self._lib.hvd_pm_create(
+            warmup_samples, steady_state_samples, bayes_opt_max_samples,
+            gp_noise, log_path.encode() if log_path else None,
+            fusion_threshold_bytes, cycle_time_ms)
+
+    def record(self, nbytes):
+        self._lib.hvd_pm_record(self._h, int(nbytes))
+
+    def update(self, now_seconds):
+        return bool(self._lib.hvd_pm_update(self._h, float(now_seconds)))
+
+    @property
+    def fusion_threshold_bytes(self):
+        return int(self._lib.hvd_pm_fusion_bytes(self._h))
+
+    @property
+    def cycle_time_ms(self):
+        return float(self._lib.hvd_pm_cycle_ms(self._h))
+
+    @property
+    def hierarchical_allreduce(self):
+        return bool(self._lib.hvd_pm_hierarchical_allreduce(self._h))
+
+    @property
+    def cache_enabled(self):
+        return bool(self._lib.hvd_pm_cache_enabled(self._h))
+
+    @property
+    def tuning(self):
+        return bool(self._lib.hvd_pm_tuning(self._h))
+
+    @property
+    def best_score(self):
+        return float(self._lib.hvd_pm_best_score(self._h))
+
+    def __del__(self):
+        try:
+            self._lib.hvd_pm_destroy(self._h)
+        except Exception:  # noqa: BLE001
+            pass

@@ -51,7 +51,7 @@ def _build_lib():
     lock_path = os.path.join(os.path.dirname(_LIB_PATH), ".build.lock")
     with open(lock_path, "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
-        if os.path.exists(_LIB_PATH):
+        if not _lib_stale():
             return
         try:
             proc = subprocess.run(["make", "-C", csrc],
@@ -66,8 +66,25 @@ def _build_lib():
                 f"{proc.stdout}\n{proc.stderr}")
 
 
-def _load_lib():
+def _lib_stale():
+    """True when any csrc source is newer than the built library."""
     if not os.path.exists(_LIB_PATH):
+        return True
+    csrc = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "csrc")
+    if not os.path.isdir(csrc):
+        return False
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for root, _, files in os.walk(csrc):
+        for f in files:
+            if f.endswith((".cc", ".h")) or f == "Makefile":
+                if os.path.getmtime(os.path.join(root, f)) > lib_mtime:
+                    return True
+    return False
+
+
+def _load_lib():
+    if _lib_stale():
         _build_lib()
     lib = ctypes.CDLL(_LIB_PATH)
     lib.hvd_core_create.restype = ctypes.c_void_p
@@ -91,6 +108,61 @@ def _load_lib():
                "hvd_core_cache_size"):
         getattr(lib, fn).restype = ctypes.c_uint64
         getattr(lib, fn).argtypes = [ctypes.c_void_p]
+
+    # Autotuned parameter getters (reference: tuned values synchronized by
+    # Controller::SynchronizeParameters; here the dispatcher polls).
+    lib.hvd_core_param_fusion_bytes.restype = ctypes.c_int64
+    lib.hvd_core_param_fusion_bytes.argtypes = [ctypes.c_void_p]
+    lib.hvd_core_param_cycle_ms.restype = ctypes.c_double
+    lib.hvd_core_param_cycle_ms.argtypes = [ctypes.c_void_p]
+    for fn in ("hvd_core_param_hierarchical_allreduce",
+               "hvd_core_param_hierarchical_allgather",
+               "hvd_core_param_cache_enabled", "hvd_core_autotune_tuning"):
+        getattr(lib, fn).restype = ctypes.c_int
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.hvd_core_autotune_best_score.restype = ctypes.c_double
+    lib.hvd_core_autotune_best_score.argtypes = [ctypes.c_void_p]
+
+    # Standalone autotune math (GP / BO / ParameterManager), unit-tested
+    # against numpy oracles in tests/test_autotune.py.
+    dbl_p = ctypes.POINTER(ctypes.c_double)
+    lib.hvd_gp_create.restype = ctypes.c_void_p
+    lib.hvd_gp_create.argtypes = [ctypes.c_double] * 3
+    lib.hvd_gp_destroy.argtypes = [ctypes.c_void_p]
+    lib.hvd_gp_fit.restype = ctypes.c_int
+    lib.hvd_gp_fit.argtypes = [ctypes.c_void_p, dbl_p, dbl_p, ctypes.c_int,
+                               ctypes.c_int]
+    lib.hvd_gp_predict.argtypes = [ctypes.c_void_p, dbl_p, ctypes.c_int,
+                                   dbl_p, dbl_p]
+    lib.hvd_expected_improvement.restype = ctypes.c_double
+    lib.hvd_expected_improvement.argtypes = [ctypes.c_double] * 4
+    lib.hvd_bo_create.restype = ctypes.c_void_p
+    lib.hvd_bo_create.argtypes = [dbl_p, dbl_p, ctypes.c_int, ctypes.c_double,
+                                  ctypes.c_int]
+    lib.hvd_bo_destroy.argtypes = [ctypes.c_void_p]
+    lib.hvd_bo_add_sample.argtypes = [ctypes.c_void_p, dbl_p, ctypes.c_int,
+                                      ctypes.c_double]
+    lib.hvd_bo_suggest.argtypes = [ctypes.c_void_p, dbl_p, ctypes.c_int]
+    lib.hvd_bo_best_y.restype = ctypes.c_double
+    lib.hvd_bo_best_y.argtypes = [ctypes.c_void_p]
+    lib.hvd_pm_create.restype = ctypes.c_void_p
+    lib.hvd_pm_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_double, ctypes.c_char_p,
+                                  ctypes.c_int64, ctypes.c_double]
+    lib.hvd_pm_destroy.argtypes = [ctypes.c_void_p]
+    lib.hvd_pm_record.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.hvd_pm_update.restype = ctypes.c_int
+    lib.hvd_pm_update.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    lib.hvd_pm_fusion_bytes.restype = ctypes.c_int64
+    lib.hvd_pm_fusion_bytes.argtypes = [ctypes.c_void_p]
+    lib.hvd_pm_cycle_ms.restype = ctypes.c_double
+    lib.hvd_pm_cycle_ms.argtypes = [ctypes.c_void_p]
+    for fn in ("hvd_pm_hierarchical_allreduce", "hvd_pm_cache_enabled",
+               "hvd_pm_tuning"):
+        getattr(lib, fn).restype = ctypes.c_int
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.hvd_pm_best_score.restype = ctypes.c_double
+    lib.hvd_pm_best_score.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -165,11 +237,35 @@ class NativeController:
         self._core = None
 
     # ------------------------------------------------------------- statistics
+    def _require_core(self):
+        if self._core is None:
+            raise RuntimeError("horovod_tpu has been shut down")
+        return self._core
+
     def cache_stats(self):
+        self._require_core()
         return {
             "hits": int(self._lib.hvd_core_cache_hits(self._core)),
             "misses": int(self._lib.hvd_core_cache_misses(self._core)),
             "size": int(self._lib.hvd_core_cache_size(self._core)),
+        }
+
+    def tuned_params(self):
+        """Current (possibly autotuned) runtime knob values (reference:
+        ParameterManager values after SynchronizeParameters)."""
+        lib, core = self._lib, self._require_core()
+        return {
+            "fusion_threshold_bytes": int(
+                lib.hvd_core_param_fusion_bytes(core)),
+            "cycle_time_ms": float(lib.hvd_core_param_cycle_ms(core)),
+            "hierarchical_allreduce": bool(
+                lib.hvd_core_param_hierarchical_allreduce(core)),
+            "hierarchical_allgather": bool(
+                lib.hvd_core_param_hierarchical_allgather(core)),
+            "cache_enabled": bool(lib.hvd_core_param_cache_enabled(core)),
+            "tuning": bool(lib.hvd_core_autotune_tuning(core)),
+            "best_score_bytes_per_sec": float(
+                lib.hvd_core_autotune_best_score(core)),
         }
 
     # ------------------------------------------------------------- dispatcher

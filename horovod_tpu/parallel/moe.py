@@ -19,16 +19,20 @@ import jax
 import jax.numpy as jnp
 
 
-def switch_route(router_logits, n_experts, capacity):
+def switch_route(router_logits, n_experts, capacity, valid=None):
     """Top-1 routing tensors from ``[T, E]`` logits.
 
-    Returns (dispatch ``[T, E, C]`` float, combine ``[T, E, C]`` float,
-    aux_loss scalar).
+    ``valid`` (optional ``[T]`` mask) excludes padding tokens: they take no
+    expert-queue positions, no capacity, and do not enter the balancing
+    loss.  Returns (dispatch ``[T, E, C]`` float, combine ``[T, E, C]``
+    float, aux_loss scalar).
     """
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)                 # [T]
     expert_gate = jnp.max(probs, axis=-1)                   # [T]
     routed_1h = jax.nn.one_hot(expert_idx, n_experts)       # [T, E] pre-drop
+    if valid is not None:
+        routed_1h = routed_1h * valid[:, None].astype(routed_1h.dtype)
 
     # position of each token within its expert's queue
     pos_in_expert = (jnp.cumsum(routed_1h, axis=0) - 1.0) * routed_1h  # [T,E]
@@ -43,8 +47,14 @@ def switch_route(router_logits, n_experts, capacity):
     # Switch-Transformer load-balance loss: E * sum_e f_e * p_e, with f
     # from the PRE-drop routing decisions — capacity clamping must not
     # hide imbalance from the balancing gradient.
-    f = jnp.mean(routed_1h, axis=0)        # fraction argmax-routed to e
-    p = jnp.mean(probs, axis=0)            # mean router prob for e
+    if valid is not None:
+        denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        f = jnp.sum(routed_1h, axis=0) / denom
+        p = jnp.sum(probs * valid[:, None].astype(probs.dtype),
+                    axis=0) / denom
+    else:
+        f = jnp.mean(routed_1h, axis=0)    # fraction argmax-routed to e
+        p = jnp.mean(probs, axis=0)        # mean router prob for e
     aux_loss = n_experts * jnp.sum(f * p)
     return dispatch, combine, aux_loss
 
@@ -52,10 +62,10 @@ def switch_route(router_logits, n_experts, capacity):
 def _constrain_ep(y, mesh):
     """Shard the expert dim (axis 1 of [G, E, C, D]) over ``ep``.
 
-    With an explicit mesh, uses it; otherwise tries a bare-axis-name
-    constraint against whatever mesh is ambient at trace time (jit with
-    sharded inputs), and degrades to a no-op when there is none or it has
-    no ``ep`` axis.
+    With an explicit mesh, uses it; otherwise applies a bare-axis-name
+    constraint against the mesh ambient at trace time (jit with sharded
+    inputs), detected explicitly — a no-op only when there is no ambient
+    mesh or it has no ``ep`` axis, so real constraint errors still raise.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -63,10 +73,13 @@ def _constrain_ep(y, mesh):
         from horovod_tpu.parallel.tensor_parallel import constrain
         return constrain(y, mesh, None, "ep", None, None)
     try:
-        return jax.lax.with_sharding_constraint(
-            y, P(None, "ep", None, None))
-    except Exception:
+        ambient = jax.sharding.get_abstract_mesh()
+        ambient_axes = ambient.axis_names if ambient is not None else ()
+    except AttributeError:  # older jax: no ambient-mesh introspection
+        ambient_axes = ()
+    if "ep" not in ambient_axes:
         return y
+    return jax.lax.with_sharding_constraint(y, P(None, "ep", None, None))
 
 
 def switch_moe(x, params, *, capacity_factor=1.25, group_size=4096,
@@ -92,17 +105,26 @@ def switch_moe(x, params, *, capacity_factor=1.25, group_size=4096,
     wo = params["wo"]["kernel"]
     e = wi.shape[0]
 
+    # Pad T up to a multiple of the group size rather than shrinking the
+    # groups (a T with no divisor near group_size would otherwise degrade
+    # to 1-2-token groups, making capacity and the balancing loss
+    # meaningless).  Pad tokens carry zero router weight: their rows of
+    # dispatch/combine are zeroed, so they never consume expert capacity.
     s = min(group_size, t)
-    while t % s:                                            # divisor of T
-        s -= 1
-    g = t // s
+    pad = (-t) % s
+    if pad:
+        xt = jnp.concatenate(
+            [xt, jnp.zeros((pad, d), xt.dtype)], axis=0)
+    g = (t + pad) // s
     xg = xt.reshape(g, s, d)
     capacity = int(math.ceil(capacity_factor * s / e))
 
     logits = jnp.einsum("gsd,de->gse", xg,
                         params["router"]["kernel"])         # [G, S, E]
+    valid = (jnp.arange(g * s) < t).reshape(g, s)           # pad mask
     dispatch, combine, aux = jax.vmap(
-        lambda lg: switch_route(lg, e, capacity))(logits)
+        lambda lg, vg: switch_route(lg, e, capacity, valid=vg))(logits,
+                                                                valid)
     aux = jnp.mean(aux)
 
     expert_in = jnp.einsum("gsd,gsec->gecd", xg.astype(jnp.float32),
@@ -113,6 +135,7 @@ def switch_moe(x, params, *, capacity_factor=1.25, group_size=4096,
     expert_out = jnp.einsum("gecf,efd->gecd", h, wo.astype(jnp.float32))
     expert_out = _constrain_ep(expert_out, mesh)
     out = jnp.einsum("gecd,gsec->gsd", expert_out, combine)  # [G, S, D]
+    out = out.reshape(-1, d)[:t]                            # drop padding
     return out.astype(x.dtype).reshape(orig_shape), aux
 
 

@@ -23,9 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-# unchecked: pallas_call in interpret mode (CPU tests) trips shard_map's
-# varying-manual-axes checker (dynamic_slice of varying+unvarying operands)
-from horovod_tpu.parallel._compat import shard_map_unchecked as shard_map
+from horovod_tpu.parallel._compat import shard_map_kernel_body as shard_map
 from horovod_tpu.parallel.ring_attention import reference_attention
 
 

@@ -1,0 +1,189 @@
+"""Autotuner math and ParameterManager behavior (reference test model:
+the reference validates Adasum against a Python oracle in
+``test_adasum_pytorch.py``; the same oracle pattern is applied here to the
+GP / expected-improvement math of ``horovod/common/optim/*`` and the tuning
+walk of ``horovod/common/parameter_manager.cc``)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import autotune
+
+
+# ---------------------------------------------------------------- numpy oracles
+
+def gp_oracle(x_train, y_train, x_query, length_scale, signal_var, noise_var):
+    """Textbook GP posterior with the documented RBF kernel."""
+    x_train = np.atleast_2d(np.asarray(x_train, float))
+    x_query = np.asarray(x_query, float).ravel()
+
+    def k(a, b):
+        return signal_var * math.exp(
+            -float(np.sum((a - b) ** 2)) / (2.0 * length_scale ** 2))
+
+    n = x_train.shape[0]
+    big_k = np.array([[k(x_train[i], x_train[j]) for j in range(n)]
+                      for i in range(n)]) + noise_var * np.eye(n)
+    ks = np.array([k(x_train[i], x_query) for i in range(n)])
+    inv = np.linalg.inv(big_k)
+    mean = ks @ inv @ np.asarray(y_train, float)
+    var = k(x_query, x_query) - ks @ inv @ ks
+    return mean, max(var, 0.0)
+
+
+def ei_oracle(mean, stddev, best, xi=0.01):
+    imp = mean - best - xi
+    if stddev <= 0:
+        return max(imp, 0.0)
+    z = imp / stddev
+    phi = math.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+    cdf = 0.5 * (1 + math.erf(z / math.sqrt(2)))
+    return imp * cdf + stddev * phi
+
+
+# ----------------------------------------------------------------------- tests
+
+@pytest.mark.parametrize("length_scale,signal_var,noise_var", [
+    (1.0, 1.0, 1e-6),
+    (0.5, 2.0, 1e-3),
+    (2.0, 0.7, 0.1),
+])
+def test_gp_matches_numpy_oracle(length_scale, signal_var, noise_var):
+    rng = np.random.RandomState(42)
+    x = rng.uniform(-2, 2, size=(12, 3))
+    y = np.sin(x[:, 0]) + 0.3 * x[:, 1] - 0.5 * x[:, 2] ** 2
+
+    gp = autotune.GaussianProcess(length_scale, signal_var, noise_var)
+    gp.fit(x, y)
+
+    for q in rng.uniform(-2, 2, size=(8, 3)):
+        mean, var = gp.predict(q)
+        em, ev = gp_oracle(x, y, q, length_scale, signal_var, noise_var)
+        assert mean == pytest.approx(em, rel=1e-8, abs=1e-10)
+        assert var == pytest.approx(ev, rel=1e-6, abs=1e-9)
+
+
+def test_gp_interpolates_training_points_with_tiny_noise():
+    x = np.array([[0.0], [1.0], [2.0]])
+    y = np.array([1.0, -1.0, 0.5])
+    gp = autotune.GaussianProcess(1.0, 1.0, 1e-10).fit(x, y)
+    for xi_, yi in zip(x, y):
+        mean, var = gp.predict(xi_)
+        assert mean == pytest.approx(yi, abs=1e-6)
+        assert var < 1e-6
+
+
+def test_expected_improvement_matches_oracle():
+    cases = [(1.0, 0.5, 0.8), (0.0, 1.0, 2.0), (3.0, 0.0, 1.0),
+             (-1.0, 0.2, -0.5), (2.0, 0.0, 3.0)]
+    for mean, sd, best in cases:
+        assert autotune.expected_improvement(mean, sd, best) == pytest.approx(
+            ei_oracle(mean, sd, best), rel=1e-12, abs=1e-15)
+
+
+def test_ei_zero_when_no_improvement_possible():
+    assert autotune.expected_improvement(0.0, 0.0, 1.0) == 0.0
+    # Positive stddev always gives some exploration value.
+    assert autotune.expected_improvement(0.0, 1.0, 5.0) > 0.0
+
+
+def test_bayes_opt_converges_near_optimum():
+    """Maximize a smooth 1-d function; after a budget of samples the best
+    observed point should be close to the true argmax."""
+    def f(x):
+        return -(x - 3.2) ** 2  # max at 3.2
+
+    bo = autotune.BayesianOptimizer(low=[0.0], high=[8.0], gp_noise=1e-4)
+    best_x = None
+    for _ in range(25):
+        x = bo.suggest()
+        y = f(x[0])
+        bo.add_sample(x, y)
+        if best_x is None or y >= bo.best_y:
+            best_x = x[0]
+    assert bo.best_y > -0.5          # i.e. |x*-3.2| < ~0.7
+    assert abs(best_x - 3.2) < 0.7
+
+
+def test_bayes_opt_suggestions_stay_in_bounds():
+    bo = autotune.BayesianOptimizer(low=[1.0, 2.0], high=[3.0, 10.0])
+    for i in range(10):
+        x = bo.suggest()
+        assert 1.0 <= x[0] <= 3.0
+        assert 2.0 <= x[1] <= 10.0
+        bo.add_sample(x, float(i))
+
+
+def test_parameter_manager_walks_and_pins_best(tmp_path):
+    """Drive the PM with a synthetic workload whose bytes/sec peaks at a
+    32 MB fusion threshold; after the tuning walk finishes the pinned values
+    must reproduce the best-scoring configuration and the CSV log must have
+    one row per observation."""
+    log = tmp_path / "autotune.csv"
+    pm = autotune.ParameterManager(
+        warmup_samples=1, steady_state_samples=3, bayes_opt_max_samples=5,
+        gp_noise=0.1, log_path=str(log))
+
+    def score(fusion_bytes):
+        mb = fusion_bytes / (1024 * 1024)
+        return 1e9 * math.exp(-((math.log2(max(mb, 1e-9)) - 5.0) ** 2) / 8.0)
+
+    t = 0.0
+    seen_best = 0.0
+    for _ in range(5000):
+        if not pm.tuning:
+            break
+        t += 0.01
+        # bytes proportional to the synthetic throughput for this window
+        pm.record(int(score(pm.fusion_threshold_bytes) * 0.01))
+        pm.update(t)
+        seen_best = max(seen_best, pm.best_score)
+    assert not pm.tuning, "tuning walk should finish within the budget"
+
+    # Pinned fusion threshold near the synthetic optimum (32 MB), within the
+    # resolution of a 5-sample-per-categorical BO walk.
+    pinned_mb = pm.fusion_threshold_bytes / (1024 * 1024)
+    assert 4 <= pinned_mb <= 256
+    assert pm.best_score == pytest.approx(seen_best)
+    assert pm.best_score > 0.5e9
+
+    rows = log.read_text().strip().splitlines()
+    assert rows[0].startswith("score_bytes_per_sec,")
+    assert len(rows) > 5  # header + one per observation
+
+
+def test_parameter_manager_warmup_windows_discarded():
+    pm = autotune.ParameterManager(warmup_samples=2, steady_state_samples=2,
+                                   bayes_opt_max_samples=3)
+    # First update only opens the window; two warmup windows discarded; the
+    # two windows after that form the first observation.
+    t = 0.0
+    observations = 0
+    for i in range(5):
+        t += 1.0
+        pm.record(1000)
+        if pm.update(t):
+            observations += 1
+    assert observations == 1  # exactly one tuning step after 5 windows
+
+
+def test_native_core_exposes_tuned_params():
+    """The embedded core publishes live tuned values through the controller
+    (reference: SynchronizeParameters makes tuned values visible)."""
+    import horovod_tpu as hvd
+
+    hvd.init()
+    try:
+        from horovod_tpu.common import basics
+        controller = basics._state.controller
+        if not hasattr(controller, "tuned_params"):
+            pytest.skip("controller without native core")
+        params = controller.tuned_params()
+        assert params["fusion_threshold_bytes"] > 0
+        assert params["cycle_time_ms"] > 0
+        assert params["cache_enabled"] in (True, False)
+        assert params["tuning"] is False  # autotune off by default
+    finally:
+        hvd.shutdown()

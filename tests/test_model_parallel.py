@@ -86,6 +86,34 @@ def test_moe_layer_runs_and_balances():
     assert 0.0 < float(aux) < 16.0
 
 
+def test_moe_padding_for_awkward_token_counts():
+    """Token counts with no divisor near group_size must be padded, not
+    split into degenerate 1-2-token groups — pad tokens take no capacity
+    and the padded result equals routing the same tokens in one group."""
+    rng = jax.random.PRNGKey(1)
+    params = init_moe_params(rng, d_model=16, d_ff=32, n_experts=4)
+    x = np.random.RandomState(3).randn(2 * 31, 16).astype(np.float32)  # 62
+
+    # 62 tokens with group_size=32 -> one full group + one padded group;
+    # with capacity high enough that nothing drops, grouping must not
+    # change any token's routing result.
+    padded, aux_p = switch_moe(jnp.asarray(x), params, capacity_factor=4.0,
+                               group_size=32)
+    whole, aux_w = switch_moe(jnp.asarray(x), params, capacity_factor=4.0,
+                              group_size=128)
+    assert padded.shape == x.shape
+    np.testing.assert_allclose(np.asarray(padded), np.asarray(whole),
+                               rtol=1e-4, atol=1e-5)
+    assert np.isfinite(float(aux_p)) and 0.0 < float(aux_p) < 16.0
+
+    # prime token count: previously degenerated to 1-token groups
+    xp = np.random.RandomState(4).randn(61, 16).astype(np.float32)
+    out, aux = switch_moe(jnp.asarray(xp), params, capacity_factor=4.0,
+                          group_size=32)
+    assert out.shape == xp.shape
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_moe_expert_parallel_matches_unsharded():
     rng = jax.random.PRNGKey(1)
     params = init_moe_params(rng, d_model=32, d_ff=64, n_experts=8)
