@@ -72,6 +72,9 @@ def init(comm=None, controller=None):
 
         from horovod_tpu.ops.xla_executor import XlaExecutor
         executor = XlaExecutor(devices)
+        executor.hierarchical_allreduce = config.hierarchical_allreduce
+        executor.hierarchical_allgather = config.hierarchical_allgather
+        executor.adasum_hierarchical = config.hierarchical_allreduce
 
         timeline = None
         impl = None

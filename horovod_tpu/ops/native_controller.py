@@ -279,11 +279,22 @@ class NativeController:
             self._lib.hvd_core_free(ptr)
 
     def _dispatch_loop(self):
+        autotune = bool(self._config.autotune)
         while True:
             batch_id, is_shutdown, responses = wire.decode_batch(
                 self._next_batch())
             if is_shutdown:
                 return
+            if autotune:
+                # Keep the data plane in step with the tuner's categorical
+                # choices (reference: tuned values take effect through
+                # SynchronizeParameters).
+                params = self.tuned_params()
+                self._executor.hierarchical_allreduce = \
+                    params["hierarchical_allreduce"]
+                self._executor.hierarchical_allgather = \
+                    params["hierarchical_allgather"]
+                autotune = params["tuning"]  # stop polling once pinned
             error = None
             for resp in responses:
                 try:

@@ -36,8 +36,15 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from horovod_tpu.common.ops_enum import ReduceOp
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils.logging import get_logger
 
 AXIS = "hvd"
+
+# The hierarchical data plane pads fused buffers so the reduce-scatter
+# chunks are equal; the reference rounds its fusion buffer to be divisible
+# by local_size * 64 elements the same way (controller.cc:358-376).
+FUSION_ALIGN_ELEMS = 64
 
 
 def _shard_map_gathered(body, mesh, in_specs, out_specs):
@@ -59,7 +66,7 @@ class XlaExecutor:
     """Executes fused collective groups as compiled XLA programs over a 1-D
     device mesh whose axis enumerates logical ranks."""
 
-    def __init__(self, devices):
+    def __init__(self, devices, hier_local_size=None):
         self.devices = list(devices)
         self.num_ranks = len(self.devices)
         self.mesh = Mesh(np.array(self.devices), (AXIS,))
@@ -68,6 +75,52 @@ class XlaExecutor:
         self._fuse_in_cache = {}
         self._allreduce_cache = {}
         self._allgather_cache = {}
+
+        # Two-level (cross, local) mesh for hierarchical collectives
+        # (reference: NCCLHierarchicalAllreduce intra-node/inter-node split,
+        # nccl_operations.cc:162-289).  "local" = ranks sharing fast
+        # interconnect (one host's chips / one ICI slice); "cross" rides
+        # DCN.  Grouping source: explicit arg > HVD_HIER_LOCAL_SIZE env >
+        # device process_index.
+        explicit = hier_local_size is not None
+        if hier_local_size is None:
+            hier_local_size = env_util.get_int(
+                env_util.HVD_HIER_LOCAL_SIZE, 0) or None
+            explicit = hier_local_size is not None
+        if hier_local_size is None:
+            per_proc = {}
+            for d in self.devices:
+                per_proc.setdefault(getattr(d, "process_index", 0),
+                                    []).append(d)
+            sizes = {len(v) for v in per_proc.values()}
+            if len(sizes) == 1:
+                hier_local_size = sizes.pop()
+        self.hier_mesh = None
+        if hier_local_size and 1 < hier_local_size < self.num_ranks:
+            try:
+                from horovod_tpu.parallel.mesh import hierarchical_mesh
+                self.hier_mesh = hierarchical_mesh(hier_local_size,
+                                                   self.devices)
+            except ValueError as exc:
+                if explicit:
+                    get_logger().warning(
+                        "ignoring HVD_HIER_LOCAL_SIZE=%s: %s — hierarchical "
+                        "collectives will run the flat path",
+                        hier_local_size, exc)
+        elif explicit:
+            get_logger().warning(
+                "HVD_HIER_LOCAL_SIZE=%s does not define a two-level "
+                "hierarchy over %d ranks; hierarchical collectives will "
+                "run the flat path", hier_local_size, self.num_ranks)
+        # Allreduce/allgather schedules are flipped by config at init and by
+        # the autotuner at runtime (pure communication-schedule choices —
+        # same numbers either way).  Adasum's hierarchical mode CHANGES THE
+        # REDUCTION SEMANTICS (adasum of per-group averages, reference
+        # AdasumGpuAllreduceOp), so it is pinned at init and never touched
+        # by the tuner.
+        self.hierarchical_allreduce = False
+        self.hierarchical_allgather = False
+        self.adasum_hierarchical = False
 
     # ------------------------------------------------------------------ utils
     def commit(self, tensor, rank):
@@ -136,21 +189,46 @@ class XlaExecutor:
                 bufs.append(self._fuse_in(tensors, sizes, dtype))
         garr = self._stack(bufs, (1, total), dtype)
 
+        hierarchical = bool(self.hierarchical_allreduce
+                            and self.hier_mesh is not None)
         key = (shapes, np.dtype(dtype).name, int(op),
-               float(prescale_factor), float(postscale_factor))
+               float(prescale_factor), float(postscale_factor), hierarchical)
         fn = self._allreduce_cache.get(key)
         if fn is None:
             num_ranks = self.num_ranks
 
-            def body(shard):  # shard: [1, total] on one rank
+            def flat_body(shard):  # shard: [1, total] on one rank
                 x = shard
                 if prescale_factor != 1.0:
                     x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
                 return jax.lax.psum(x, AXIS)
 
+            def hier_body(shard):
+                # reduce-scatter on ICI -> cross allreduce on DCN ->
+                # allgather on ICI (reference: nccl_operations.cc:162-289:
+                # ncclReduceScatter -> MPI allreduce -> ncclAllgather).
+                x = shard.reshape(-1)
+                if prescale_factor != 1.0:
+                    x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+                local = self.hier_mesh.shape["local"]
+                align = local * FUSION_ALIGN_ELEMS
+                padded = -(-total // align) * align
+                if padded != total:
+                    x = jnp.pad(x, (0, padded - total))
+                chunk = jax.lax.psum_scatter(x, "local", scatter_dimension=0,
+                                             tiled=True)
+                chunk = jax.lax.psum(chunk, "cross")
+                full = jax.lax.all_gather(chunk, "local", tiled=True)
+                return full[:total][None]
+
             def fused(g):
-                red = _shard_map(body, mesh=self.mesh, in_specs=P(AXIS),
-                                 out_specs=P())(g)
+                if hierarchical:
+                    red = _shard_map_gathered(
+                        hier_body, self.hier_mesh,
+                        P(("cross", "local")), P())(g)
+                else:
+                    red = _shard_map(flat_body, mesh=self.mesh,
+                                     in_specs=P(AXIS), out_specs=P())(g)
                 flat = red.reshape(-1)
                 if op == ReduceOp.AVERAGE:
                     flat = flat / jnp.asarray(num_ranks, dtype=flat.dtype)
@@ -187,7 +265,9 @@ class XlaExecutor:
         rest = shapes[0][1:]
         max0 = max(dims0)
 
-        key = (shapes, np.dtype(dtype).name)
+        hierarchical = bool(self.hierarchical_allgather
+                            and self.hier_mesh is not None)
+        key = (shapes, np.dtype(dtype).name, hierarchical)
         fn = self._allgather_cache.get(key)
         if fn is None:
             def pad(t, n0=max0):
@@ -198,8 +278,25 @@ class XlaExecutor:
             def body(shard):  # [1, max0, *rest]
                 return jax.lax.all_gather(shard[0], AXIS)  # [N, max0, *rest]
 
+            def hier_body(shard):
+                # gather within the fast local group first, then move the
+                # assembled block once across the slow axis (reference:
+                # MPIHierarchicalAllgather's node-leader + shared-memory
+                # two-phase gather, mpi_operations.cc).  Rank order is
+                # (cross major, local minor), matching host:slots rank
+                # numbering, so the reshape restores flat rank order.
+                g_local = jax.lax.all_gather(shard[0], "local")
+                g = jax.lax.all_gather(g_local, "cross")  # [C, L, max0, ...]
+                return g.reshape((self.num_ranks,) + g.shape[2:])
+
             def gather(g):
-                full = _shard_map_gathered(body, self.mesh, P(AXIS), P())(g)
+                if hierarchical:
+                    full = _shard_map_gathered(
+                        hier_body, self.hier_mesh,
+                        P(("cross", "local")), P())(g)
+                else:
+                    full = _shard_map_gathered(body, self.mesh,
+                                               P(AXIS), P())(g)
                 parts = [jax.lax.slice_in_dim(full[i], 0, dims0[i], axis=0)
                          for i in range(self.num_ranks)]
                 return jnp.concatenate(parts, axis=0)
@@ -229,7 +326,8 @@ class XlaExecutor:
         AdasumMPIAllreduceOp / AdasumGpuAllreduceOp).  Zero stand-ins from
         joined ranks fall out naturally: a zero-norm operand contributes
         plain addition."""
-        from horovod_tpu.ops.adasum import adasum_reduce_stacked
+        from horovod_tpu.ops.adasum import (adasum_reduce_hierarchical,
+                                            adasum_reduce_stacked)
 
         shape = tuple(entry.shape)
         total = _prod(shape)
@@ -243,15 +341,34 @@ class XlaExecutor:
                 bufs.append(self._fuse_in([t], [total], dtype))
         garr = self._stack(bufs, (1, total), dtype)
 
-        key = ("adasum", shape, np.dtype(dtype).name)
+        # Hierarchical Adasum (reference: AdasumGpuAllreduceOp — NCCL
+        # reduce-scatter intra-node, VHDD across nodes, allgather back)
+        # needs a power-of-two cross size for the VHDD pairing tree.  Pinned
+        # at init (adasum_hierarchical), NOT autotuned: the two modes
+        # combine gradients differently by design.
+        hierarchical = bool(
+            self.adasum_hierarchical and self.hier_mesh is not None
+            and (self.hier_mesh.shape["cross"]
+                 & (self.hier_mesh.shape["cross"] - 1)) == 0)
+        key = ("adasum", shape, np.dtype(dtype).name, hierarchical)
         fn = self._allreduce_cache.get(key)
         if fn is None:
-            def fused(g):
-                def body(shard):
-                    gathered = jax.lax.all_gather(shard[0], AXIS)
-                    return adasum_reduce_stacked(gathered)
-                return _shard_map_gathered(
-                    body, self.mesh, P(AXIS), P())(g).reshape(shape)
+            if hierarchical:
+                def fused(g):
+                    def body(shard):
+                        return adasum_reduce_hierarchical(
+                            shard[0], local_axis="local",
+                            cross_axis="cross")[None]
+                    return _shard_map_gathered(
+                        body, self.hier_mesh,
+                        P(("cross", "local")), P())(g).reshape(shape)
+            else:
+                def fused(g):
+                    def body(shard):
+                        gathered = jax.lax.all_gather(shard[0], AXIS)
+                        return adasum_reduce_stacked(gathered)
+                    return _shard_map_gathered(
+                        body, self.mesh, P(AXIS), P())(g).reshape(shape)
 
             fn = jax.jit(fused, donate_argnums=0)
             self._allreduce_cache[key] = fn

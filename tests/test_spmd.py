@@ -153,6 +153,52 @@ def test_adasum_spmd_matches_reference(hvd_init, mesh):
                                rtol=1e-4, atol=1e-5)
 
 
+def test_adasum_vhdd_matches_reference(hvd_init):
+    """ppermute-based vector-halving distance-doubling Adasum (the
+    large-tensor path, reference: adasum.h:194-330) must agree with the
+    numpy pairing-tree oracle, including a length that needs padding."""
+    from horovod_tpu.ops.adasum import adasum_reference, adasum_vhdd
+    from horovod_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"x": 8})
+    for n in (64, 37):  # 37: not divisible by 8, exercises padding
+        rng = np.random.RandomState(11 + n)
+        per_rank = rng.randn(8, n).astype(np.float32)
+        expected = adasum_reference(list(per_rank))
+
+        out = jax.jit(shard_map(
+            lambda g: adasum_vhdd(g[0], "x")[None],
+            mesh=mesh, in_specs=(P("x"),), out_specs=P(),
+            check_vma=False,
+        ))(jnp.asarray(per_rank).reshape(8, 1, n))
+        np.testing.assert_allclose(np.asarray(out).reshape(-1), expected,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_hierarchical_matches_reference(hvd_init):
+    """RS(local sum) -> VHDD(cross) -> AG(local) with the local_size
+    divisor equals adasum(per-group averages) (reference:
+    adasum_gpu_operations.cc + divisor semantics torch/mpi_ops.py:110)."""
+    from horovod_tpu.ops.adasum import (adasum_reduce_hierarchical,
+                                        adasum_reference)
+    from horovod_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"cross": 2, "local": 4})
+    rng = np.random.RandomState(13)
+    per_rank = rng.randn(8, 33).astype(np.float32)  # 33: padding path
+    group_a = per_rank[:4].sum(axis=0) / 4.0
+    group_b = per_rank[4:].sum(axis=0) / 4.0
+    expected = adasum_reference([group_a, group_b])
+
+    out = jax.jit(shard_map(
+        lambda g: adasum_reduce_hierarchical(g[0])[None],
+        mesh=mesh, in_specs=(P(("cross", "local")),), out_specs=P(),
+        check_vma=False,
+    ))(jnp.asarray(per_rank).reshape(8, 1, 33))
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), expected,
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_broadcast_parameters(hvd_init):
     from horovod_tpu.common import basics
 
