@@ -20,6 +20,7 @@ import threading
 
 from horovod_tpu.common import topology as topology_mod
 from horovod_tpu.common.config import Config
+from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils.logging import get_logger
 from horovod_tpu.utils.timeline import Timeline
 
@@ -58,10 +59,22 @@ def init(comm=None, controller=None):
 
         env_topology = topology_mod.from_env()
         if env_topology is not None and env_topology.size > 1:
-            # process-rank mode: multi-process collectives arrive with the
-            # native TCP controller; topology queries work regardless.
+            # process-rank mode: collectives go through the TCP controller
+            # (the reference's Gloo configuration).  The native/python
+            # controllers coordinate a single process's device ranks and
+            # cannot span processes — an explicit request for them here is
+            # a configuration error, not something to override silently.
+            explicit = (controller or
+                        env_util.get_str(env_util.HVD_CONTROLLER))
+            if explicit and explicit != "tcp":
+                raise RuntimeError(
+                    f"HVD_CONTROLLER={explicit} cannot coordinate "
+                    f"{env_topology.size} processes; multi-process jobs "
+                    f"use the tcp controller (the in-process controllers "
+                    f"only coordinate device ranks within one process)")
             topology = env_topology
             devices = jax.local_devices()
+            config.controller = "tcp"
         elif isinstance(comm, (list, tuple)) and comm:
             devices = list(comm)
             topology = topology_mod.from_devices(devices, 0, 1)
@@ -74,11 +87,15 @@ def init(comm=None, controller=None):
         executor = XlaExecutor(devices)
         executor.hierarchical_allreduce = config.hierarchical_allreduce
         executor.hierarchical_allgather = config.hierarchical_allgather
-        executor.adasum_hierarchical = config.hierarchical_allreduce
+        executor.adasum_hierarchical = config.adasum_hierarchical
 
         timeline = None
         impl = None
-        if config.controller == "native":
+        if config.controller == "tcp":
+            from horovod_tpu.ops.tcp_controller import TcpController
+            impl = TcpController(topology, executor, None, config)
+            timeline = Timeline(None)
+        elif config.controller == "native":
             try:
                 from horovod_tpu.ops.native_controller import NativeController
                 impl = NativeController(topology, executor, None, config)
@@ -95,8 +112,8 @@ def init(comm=None, controller=None):
                 raise RuntimeError(
                     f"topology spans {topology.size} ranks but only "
                     f"{len(devices)} devices are addressable in this "
-                    f"process; multi-process collectives require the native "
-                    f"TCP controller (HVD_CONTROLLER=native under hvdrun)")
+                    f"process; multi-process collectives require the tcp "
+                    f"controller (launch with hvdrun)")
             from horovod_tpu.ops.python_controller import PythonController
             impl = PythonController(topology, executor, timeline, config)
         impl.start()

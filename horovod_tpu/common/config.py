@@ -24,6 +24,10 @@ class Config:
     autotune_log: str | None = None
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # Opt-in separately from hierarchical_allreduce: hierarchical Adasum
+    # CHANGES the reduction result (adasum of per-group averages, the
+    # reference's NCCL+MPI Adasum), it is not a schedule-only switch.
+    adasum_hierarchical: bool = False
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -52,4 +56,6 @@ class Config:
                 env_util.HVD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=env_util.get_bool(
                 env_util.HVD_HIERARCHICAL_ALLGATHER),
+            adasum_hierarchical=env_util.get_bool(
+                env_util.HVD_ADASUM_HIERARCHICAL),
         )

@@ -1,0 +1,462 @@
+"""TCP controller: multi-process coordination + data plane.
+
+The process-rank analog of the reference's Gloo configuration
+(``horovod/common/gloo/gloo_controller.cc`` + ``gloo_operations.cc``): a
+job launched as N OS processes (``hvdrun -np N``) coordinates named
+collectives through a rank-0 service instead of the in-process table.
+
+Design (vs the reference):
+
+- Control plane: the reference gathers request lists to rank 0 and
+  broadcasts responses every cycle (gloo p2p + bitvector allreduces).
+  Here each named collective is ONE signed round-trip to the rank-0
+  coordinator service (the HMAC TCP layer from ``run/service``): the
+  connection blocks until all ranks contributed, the reduction result
+  rides back on the response.  Negotiation-order freedom, cross-rank
+  validation, Join zero-stand-ins and stall handling match the reference
+  semantics per name.
+- Data plane: contributions travel as numpy buffers inside the messages
+  and rank 0 reduces them (the "Gloo ref config" — CPU sockets, no
+  accelerator dependency; reference: gloo_operations.cc templated CPU
+  reductions).  This path exists for multi-process correctness and tests.
+  THE PERF PATH ON TPU PODS IS NOT THIS: under ``hvdrun --tpu`` each host
+  is one process whose chips form the local mesh, and training steps run
+  compiled SPMD programs over the global mesh (``horovod_tpu.parallel``)
+  — the eager socket plane only carries small control tensors.
+"""
+
+import base64
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from horovod_tpu.common.ops_enum import ReduceOp, RequestType
+from horovod_tpu.run.service import network
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils.logging import get_logger
+
+CONTROLLER_SCOPE = "controller"
+CONTROLLER_KEY = "addr"
+
+
+# ------------------------------------------------------------------ messages
+class CollectiveMsg:
+    def __init__(self, name, rank, req_type, op, payload, shape, dtype,
+                 root_rank=-1, splits=None, prescale=1.0, postscale=1.0):
+        self.name = name
+        self.rank = rank
+        self.req_type = int(req_type)
+        self.op = int(op)
+        self.payload = payload          # raw little-endian bytes
+        self.shape = tuple(shape)
+        self.dtype = dtype              # numpy dtype string
+        self.root_rank = root_rank
+        self.splits = splits
+        self.prescale = prescale
+        self.postscale = postscale
+
+
+class ResultMsg:
+    def __init__(self, payload=None, shape=None, dtype=None, error=None,
+                 recv_splits=None):
+        self.payload = payload
+        self.shape = shape
+        self.dtype = dtype
+        self.error = error
+        self.recv_splits = recv_splits
+
+
+class JoinMsg:
+    def __init__(self, rank):
+        self.rank = rank
+
+
+class JoinDoneMsg:
+    def __init__(self, last_rank):
+        self.last_rank = last_rank
+
+
+class ShutdownMsg:
+    pass
+
+
+def _decode(msg):
+    return np.frombuffer(msg.payload, dtype=np.dtype(msg.dtype)).reshape(
+        msg.shape)
+
+
+def _encode(arr):
+    arr = np.ascontiguousarray(arr)
+    return ResultMsg(payload=arr.tobytes(), shape=arr.shape,
+                     dtype=arr.dtype.str)
+
+
+# ---------------------------------------------------------------- entry
+class _Entry:
+    """One named collective being negotiated (reference: the coordinator's
+    message table, controller.cc:62)."""
+
+    def __init__(self, req_type):
+        self.req_type = req_type
+        self.requests = {}   # rank -> CollectiveMsg
+        self.results = {}    # rank -> ResultMsg
+        self.done = threading.Event()
+        self.first_ts = time.monotonic()
+        self.stall_warned = False
+
+
+class CoordinatorService(network.BasicService):
+    """Rank 0's collective coordinator."""
+
+    NAME = "horovod_tpu coordinator"
+
+    def __init__(self, size, key, stall_warning_sec=60.0,
+                 stall_shutdown_sec=0.0):
+        self._size = size
+        self._stall_warning = stall_warning_sec
+        self._stall_shutdown = stall_shutdown_sec
+        self._cv = threading.Condition()
+        self._forming = {}          # name -> _Entry
+        self._joined = set()
+        self._join_waiters = []     # (rank, Event, [last_rank])
+        self._log = get_logger()
+        super().__init__(self.NAME, key)
+
+    # ----------------------------------------------------------- negotiation
+    def _handle(self, req, client_address):
+        if isinstance(req, CollectiveMsg):
+            return self._handle_collective(req)
+        if isinstance(req, JoinMsg):
+            return self._handle_join(req)
+        if isinstance(req, ShutdownMsg):
+            return network.AckResponse()
+        return super()._handle(req, client_address)
+
+    def _needed(self):
+        return self._size - len(self._joined)
+
+    def _handle_collective(self, req):
+        with self._cv:
+            entry = self._forming.get(req.name)
+            if entry is None:
+                entry = _Entry(req.req_type)
+                self._forming[req.name] = entry
+            if req.rank in entry.requests:
+                return ResultMsg(error=(
+                    f"duplicate request for tensor '{req.name}' from rank "
+                    f"{req.rank} before previous one completed"))
+            entry.requests[req.rank] = req
+            if len(entry.requests) >= self._needed():
+                self._complete(req.name, entry)
+                self._check_join_barrier()
+        # Wait outside negotiation state; each connection has its own
+        # server thread, so blocking here is the reference's "wait for the
+        # response list" on this rank.
+        deadline = (time.monotonic() + self._stall_shutdown
+                    if self._stall_shutdown > 0 else None)
+        while not entry.done.wait(timeout=1.0):
+            age = time.monotonic() - entry.first_ts
+            if age > self._stall_warning and not entry.stall_warned:
+                with self._cv:
+                    missing = [r for r in range(self._size)
+                               if r not in entry.requests
+                               and r not in self._joined]
+                    entry.stall_warned = True
+                self._log.warning(
+                    "Stalled tensor: %s ready ranks: %s, waiting on: %s "
+                    "for more than %ds", req.name,
+                    sorted(entry.requests), missing,
+                    int(self._stall_warning))
+            if deadline is not None and time.monotonic() > deadline:
+                return ResultMsg(error=(
+                    f"stalled tensor '{req.name}' exceeded shutdown "
+                    f"threshold of {self._stall_shutdown}s"))
+        return entry.results.get(req.rank,
+                                 ResultMsg(error="internal: no result"))
+
+    def _handle_join(self, req):
+        event = threading.Event()
+        slot = [None]
+        with self._cv:
+            self._joined.add(req.rank)
+            self._join_waiters.append((req.rank, event, slot))
+            # a rank joining may complete entries now only missing it
+            for name, entry in list(self._forming.items()):
+                if (entry.requests and
+                        len(entry.requests) >= self._needed()):
+                    self._complete(name, entry)
+            self._check_join_barrier()
+        event.wait()
+        return JoinDoneMsg(slot[0])
+
+    def _check_join_barrier(self):
+        # all ranks joined and nothing pending -> release joins (reference:
+        # controller joined handling: the join barrier completes only when
+        # the tensor table is empty)
+        if (len(self._joined) == self._size and not self._forming
+                and self._join_waiters):
+            last_rank = self._join_waiters[-1][0]
+            for _, event, slot in self._join_waiters:
+                slot[0] = last_rank
+                event.set()
+            self._join_waiters.clear()
+            self._joined.clear()
+
+    # ------------------------------------------------------------- execution
+    def _complete(self, name, entry):
+        """Validate cross-rank agreement and compute every rank's result
+        (reference: ConstructResponse validation + the backend op)."""
+        del self._forming[name]
+        reqs = entry.requests
+        try:
+            results = self._execute(entry)
+        except ValueError as exc:
+            results = {r: ResultMsg(error=str(exc)) for r in reqs}
+        entry.results = results
+        entry.done.set()
+
+    def _execute(self, entry):
+        reqs = entry.requests
+        first = next(iter(reqs.values()))
+        rtype = RequestType(first.req_type)
+
+        for r in reqs.values():
+            if r.req_type != first.req_type:
+                raise ValueError(
+                    f"mismatched collective types for tensor '{first.name}'")
+            if r.dtype != first.dtype:
+                raise ValueError(
+                    f"mismatched dtypes for tensor '{first.name}'")
+
+        if self._joined and rtype in (RequestType.ALLGATHER,
+                                      RequestType.BROADCAST,
+                                      RequestType.ALLTOALL):
+            raise ValueError(f"{rtype.name} is not supported while ranks "
+                             f"have joined")
+
+        if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM):
+            for r in reqs.values():
+                if r.shape != first.shape:
+                    raise ValueError(
+                        f"mismatched shapes for allreduce '{first.name}'")
+                if r.op != first.op or r.prescale != first.prescale \
+                        or r.postscale != first.postscale:
+                    raise ValueError(
+                        f"mismatched reduce ops or scale factors for "
+                        f"tensor '{first.name}'")
+            arrs = {r: _decode(m) for r, m in reqs.items()}
+            if rtype == RequestType.ADASUM:
+                out = self._adasum(arrs, first)
+            else:
+                out = self._allreduce(arrs, first)
+            return {r: _encode(out) for r in reqs}
+
+        if rtype == RequestType.ALLGATHER:
+            shapes = {r: m.shape for r, m in reqs.items()}
+            trailing = {s[1:] for s in shapes.values()}
+            if any(not s for s in shapes.values()):
+                raise ValueError(
+                    f"allgather '{first.name}': 0-d tensors are not "
+                    f"supported; reshape to (1,) first")
+            if len(trailing) > 1:
+                raise ValueError(
+                    f"mismatched trailing dimensions for allgather "
+                    f"'{first.name}'")
+            out = np.concatenate(
+                [_decode(reqs[r]) for r in sorted(reqs)], axis=0)
+            return {r: _encode(out) for r in reqs}
+
+        if rtype == RequestType.BROADCAST:
+            for r in reqs.values():
+                if r.root_rank != first.root_rank:
+                    raise ValueError(
+                        f"mismatched root ranks for broadcast "
+                        f"'{first.name}'")
+                if r.shape != first.shape:
+                    raise ValueError(
+                        f"mismatched shapes for broadcast '{first.name}'")
+            if first.root_rank not in reqs:
+                raise ValueError(
+                    f"broadcast '{first.name}': root rank "
+                    f"{first.root_rank} did not participate")
+            out = _decode(reqs[first.root_rank])
+            return {r: _encode(out) for r in reqs}
+
+        if rtype == RequestType.ALLTOALL:
+            pieces = {}
+            offsets = {}
+            for r, m in reqs.items():
+                if m.splits is None or len(m.splits) != self._size:
+                    raise ValueError(
+                        f"alltoall '{first.name}': splits must have one "
+                        f"entry per rank ({self._size})")
+                if sum(m.splits) != (m.shape[0] if m.shape else 0):
+                    raise ValueError(
+                        f"alltoall '{first.name}': splits sum "
+                        f"{sum(m.splits)} != first dimension "
+                        f"{m.shape[0] if m.shape else 0}")
+                arr = _decode(m)
+                off = 0
+                offsets[r] = []
+                for n in m.splits:
+                    pieces[(r, len(offsets[r]))] = arr[off:off + n]
+                    offsets[r].append(n)
+                    off += n
+            out = {}
+            for dst in reqs:
+                parts = [pieces[(src, dst)] for src in sorted(reqs)]
+                recv_splits = [offsets[src][dst] for src in sorted(reqs)]
+                res = _encode(np.concatenate(parts, axis=0))
+                res.recv_splits = recv_splits
+                out[dst] = res
+            return out
+
+        raise ValueError(f"unknown request type {rtype}")
+
+    def _allreduce(self, arrs, first):
+        acc = None
+        for r in sorted(arrs):
+            a = arrs[r].astype(np.float64) if np.issubdtype(
+                arrs[r].dtype, np.floating) else arrs[r].astype(np.int64)
+            if first.prescale != 1.0:
+                a = a * first.prescale
+            acc = a if acc is None else acc + a
+        if ReduceOp(first.op) == ReduceOp.AVERAGE:
+            acc = acc / self._size
+        if first.postscale != 1.0:
+            acc = acc * first.postscale
+        return acc.astype(np.dtype(first.dtype))
+
+    def _adasum(self, arrs, first):
+        from horovod_tpu.ops.adasum import adasum_reference
+
+        # joined ranks contribute zero stand-ins, like the device-mode
+        # executor (zero norm -> plain addition)
+        tensors = []
+        for r in range(self._size):
+            if r in arrs:
+                tensors.append(arrs[r])
+            else:
+                tensors.append(np.zeros(first.shape,
+                                        dtype=np.dtype(first.dtype)))
+        return adasum_reference(tensors).astype(np.dtype(first.dtype))
+
+
+# ----------------------------------------------------------------- controller
+class TcpController:
+    """Per-process controller facade (same interface as the in-process
+    controllers: enqueue / join / start / shutdown)."""
+
+    def __init__(self, topology, executor, timeline, config):
+        del timeline
+        self._topo = topology
+        self._executor = executor
+        self._config = config
+        self._rank = topology.rank
+        self._size = topology.size
+        self._coordinator = None
+        self._client_addrs = None
+        self._key = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="hvd-tcp")
+        self._log = get_logger()
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self):
+        key_b64 = os.environ.get(env_util.HVD_SECRET_KEY)
+        if key_b64:
+            self._key = base64.b64decode(key_b64)
+        else:
+            # standalone/testing: derive a per-job key from the rendezvous
+            # location so all ranks agree
+            seed = (os.environ.get(env_util.HVD_RENDEZVOUS_ADDR, "local") +
+                    os.environ.get(env_util.HVD_RENDEZVOUS_PORT, "0"))
+            import hashlib
+            self._key = hashlib.sha256(seed.encode()).digest()
+
+        addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
+        port = os.environ.get(env_util.HVD_RENDEZVOUS_PORT)
+        if self._rank == 0:
+            self._coordinator = CoordinatorService(
+                self._size, self._key,
+                stall_warning_sec=self._config.stall_warning_seconds,
+                stall_shutdown_sec=self._config.stall_shutdown_seconds)
+            addrs = [(ip, self._coordinator.port)
+                     for ip in network.local_interfaces().values()]
+            addrs.append(("127.0.0.1", self._coordinator.port))
+            if addr is not None:
+                from horovod_tpu.run import http_client
+                http_client.put(
+                    addr, int(port), CONTROLLER_SCOPE, CONTROLLER_KEY,
+                    ";".join(f"{ip}:{p}" for ip, p in addrs).encode())
+            self._client_addrs = addrs
+        else:
+            if addr is None:
+                raise RuntimeError(
+                    "multi-process mode requires the rendezvous env "
+                    "contract (launch with hvdrun)")
+            from horovod_tpu.run import http_client
+            blob = http_client.get(addr, int(port), CONTROLLER_SCOPE,
+                                   CONTROLLER_KEY, timeout=120).decode()
+            self._client_addrs = []
+            for part in blob.split(";"):
+                ip, p = part.rsplit(":", 1)
+                self._client_addrs.append((ip, int(p)))
+
+    def _client(self):
+        # one client per call: connections are per-request and the pool
+        # runs many collectives concurrently
+        iface = os.environ.get(env_util.HVD_IFACE)
+        addrs = self._client_addrs
+        del iface  # address list already host-filtered by discovery
+        return network.BasicClient(addrs, self._key, timeout=300)
+
+    # ------------------------------------------------------------ producer API
+    def enqueue(self, request):
+        self._pool.submit(self._run_one, request)
+
+    def _run_one(self, request):
+        try:
+            arr = np.asarray(request.tensor)
+            msg = CollectiveMsg(
+                name=request.name, rank=self._rank,
+                req_type=request.req_type, op=request.op,
+                payload=np.ascontiguousarray(arr).tobytes(),
+                shape=arr.shape, dtype=arr.dtype.str,
+                root_rank=request.root_rank, splits=request.splits,
+                prescale=request.prescale_factor,
+                postscale=request.postscale_factor)
+            resp = self._client().send(msg)
+            if resp.error is not None:
+                request.handle.set_error(resp.error)
+                return
+            out = np.frombuffer(resp.payload,
+                                dtype=np.dtype(resp.dtype)).reshape(
+                                    resp.shape)
+            import jax.numpy as jnp
+            result = jnp.asarray(out)
+            if RequestType(request.req_type) == RequestType.ALLTOALL:
+                request.handle.set_result((result, resp.recv_splits))
+            else:
+                request.handle.set_result(result)
+        except Exception as exc:  # noqa: BLE001 — surface on the handle
+            request.handle.set_error(str(exc))
+
+    def join(self, rank, handle):
+        def run():
+            try:
+                resp = self._client().send(JoinMsg(rank))
+                handle.set_result(resp.last_rank)
+            except Exception as exc:  # noqa: BLE001
+                handle.set_error(str(exc))
+
+        self._pool.submit(run)
+
+    def shutdown(self):
+        self._pool.shutdown(wait=False)
+        if self._coordinator is not None:
+            self._coordinator.shutdown()
+            self._coordinator = None

@@ -1,0 +1,96 @@
+"""Launcher-side NIC discovery (reference:
+``horovod/run/driver/driver_service.py:225 get_common_interfaces`` used by
+``runner.py:568-643``): start a task server on every remote host, let each
+probe its successor, and intersect the interface names that are routable
+between every pair.  The winning interface provides the rendezvous bind
+address and is exported as ``HVD_IFACE`` to the workers."""
+
+import base64
+import shlex
+import subprocess
+import sys
+
+from horovod_tpu.run.service import secret
+from horovod_tpu.run.service.driver_service import (DriverService,
+                                                    find_common_interfaces)
+from horovod_tpu.run.service.network import local_interfaces
+from horovod_tpu.run.service.task_service import TaskClient
+from horovod_tpu.utils.logging import get_logger
+
+LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+
+def _task_server_command(index, driver_addrs, key, ssh_port=None, host=None):
+    env = {
+        "HVD_TASK_INDEX": str(index),
+        "HVD_DRIVER_ADDRS": ";".join(f"{ip}:{port}"
+                                     for ip, port in driver_addrs),
+        "HVD_SECRET_KEY": base64.b64encode(key).decode(),
+    }
+    inner = (" ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+             + f" {shlex.quote(sys.executable)} -m "
+               "horovod_tpu.run.service.task_main")
+    if host is None or host in LOCAL_HOSTS:
+        return inner, None
+    port = f"-p {ssh_port} " if ssh_port else ""
+    return (f"ssh -o StrictHostKeyChecking=no {port}{host} "
+            f"{shlex.quote(inner)}"), host
+
+
+def discover_common_interfaces(hostnames, ssh_port=None, timeout=60):
+    """Run the discovery round over the given hosts.
+
+    Returns ``(iface_names, rendezvous_ip)``; raises on failure (callers
+    fall back to hostname resolution).
+    """
+    key = secret.make_secret_key()
+    driver = DriverService(len(hostnames), key)
+    procs = []
+    try:
+        driver_addrs = [(ip, driver.port)
+                        for ip in local_interfaces().values()]
+        for i, host in enumerate(hostnames):
+            cmd, _ = _task_server_command(i, driver_addrs, key,
+                                          ssh_port=ssh_port, host=host)
+            procs.append(subprocess.Popen(cmd, shell=True))
+
+        common = find_common_interfaces(driver, key, len(hostnames),
+                                        timeout=timeout)
+        iface = sorted(common)[0]
+        ip = local_interfaces().get(iface)
+        if ip is None:  # driver host names its NICs differently
+            ip = next(iter(local_interfaces().values()))
+
+        # release the task servers
+        for i in range(len(hostnames)):
+            try:
+                TaskClient(driver.task_addresses(i), key,
+                           timeout=5).shutdown_task()
+            except (OSError, ConnectionError):
+                pass
+        return common, ip
+    finally:
+        driver.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def maybe_discover(slots, ssh_port=None):
+    """Best-effort discovery for multi-host jobs; ``None`` for all-local
+    jobs or when discovery fails (caller falls back)."""
+    hostnames = []
+    for s in slots:
+        if s.hostname not in hostnames:
+            hostnames.append(s.hostname)
+    if all(h in LOCAL_HOSTS for h in hostnames):
+        return None
+    try:
+        return discover_common_interfaces(hostnames, ssh_port=ssh_port)
+    except Exception as exc:  # noqa: BLE001 — discovery is best-effort
+        get_logger().warning(
+            "NIC discovery failed (%s); falling back to hostname "
+            "resolution for the rendezvous address", exc)
+        return None

@@ -35,12 +35,26 @@ def slot_env(slot, rendezvous_addr, rendezvous_port, extra_env=None):
     return env
 
 
+SECRET_ENV_VARS = (env_util.HVD_SECRET_KEY,)
+
+
 def _ssh_command(slot, command, env, ssh_port=None):
-    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    """Build the remote launch command.  Secrets never appear on the remote
+    command line (visible in ps/verbose logs); they travel over ssh stdin
+    into a `read -r` in the remote shell.  Returns (command, stdin_data)."""
+    secrets = {k: v for k, v in env.items() if k in SECRET_ENV_VARS}
+    public = {k: v for k, v in env.items() if k not in SECRET_ENV_VARS}
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in public.items())
     port = f"-p {ssh_port} " if ssh_port else ""
-    inner = f"cd {shlex.quote(os.getcwd())} && {exports} {command}"
-    return (f"ssh -o StrictHostKeyChecking=no {port}"
-            f"{slot.hostname} {shlex.quote(inner)}")
+    stdin_lines = "".join(f"{k}={v}\n" for k, v in secrets.items())
+    reads = "".join(
+        f"IFS= read -r {k}; export {k}=\"${{{k}#{k}=}}\"; "
+        for k in secrets)
+    inner = (f"{reads}cd {shlex.quote(os.getcwd())} && "
+             f"{exports} {command}")
+    cmd = (f"ssh -o StrictHostKeyChecking=no {port}"
+           f"{slot.hostname} {shlex.quote(inner)}")
+    return cmd, stdin_lines.encode() if stdin_lines else None
 
 
 def launch_job(slots, command, rendezvous_addr, rendezvous_port,
@@ -53,19 +67,21 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
 
     def run_rank(i, slot):
         env = slot_env(slot, rendezvous_addr, rendezvous_port, extra_env)
+        stdin_data = None
         if slot.hostname in LOCAL_HOSTS:
+            # local: secrets ride the process env, never a command line
             full_env = dict(os.environ)
             full_env.update(env)
             cmd = command
         else:
             full_env = dict(os.environ)
-            cmd = _ssh_command(slot, command, env, ssh_port)
+            cmd, stdin_data = _ssh_command(slot, command, env, ssh_port)
         if verbose:
             log.warning("launching rank %d on %s: %s", slot.rank,
                         slot.hostname, cmd)
         code = safe_shell_exec.execute(
             cmd, env=full_env, stdout=sys.stdout, stderr=sys.stderr,
-            events=[failure])
+            events=[failure], stdin_data=stdin_data)
         exit_codes[i] = code
         if code != 0:
             failure.set()

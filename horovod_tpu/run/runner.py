@@ -48,6 +48,12 @@ def make_parser():
                        default=None)
     group.add_argument("--hierarchical-allgather", action="store_true",
                        default=None)
+    group.add_argument("--adasum-hierarchical", action="store_true",
+                       default=None,
+                       help="Opt into the reference's NCCL+MPI-style "
+                            "hierarchical Adasum (adasum of per-group "
+                            "averages — numerically different from flat "
+                            "Adasum)")
     group.add_argument("--controller", choices=["native", "python", "tcp"],
                        default=None)
 
@@ -116,11 +122,23 @@ def run_commandline(argv=None) -> int:
     slots = build_slots(args)
     if len(slots) > 1 and env_util.HVD_CONTROLLER not in extra_env:
         extra_env[env_util.HVD_CONTROLLER] = "tcp"
+    if env_util.HVD_SECRET_KEY not in extra_env:
+        import base64
+        from horovod_tpu.run.service import secret
+        extra_env[env_util.HVD_SECRET_KEY] = base64.b64encode(
+            secret.make_secret_key()).decode()
 
     rendezvous = RendezvousServer()
     port = rendezvous.start()
-    addr = os.environ.get("HVD_RENDEZVOUS_HOST_ADDR",
-                          _routable_addr(slots))
+    addr = os.environ.get("HVD_RENDEZVOUS_HOST_ADDR")
+    if addr is None:
+        from horovod_tpu.run.driver_discovery import maybe_discover
+        discovered = maybe_discover(slots, ssh_port=args.ssh_port)
+        if discovered is not None:
+            ifaces, addr = discovered
+            extra_env.setdefault(env_util.HVD_IFACE, sorted(ifaces)[0])
+        else:
+            addr = _routable_addr(slots)
     command = " ".join(args.command)
     try:
         return launch_job(slots, command, addr, port, extra_env=extra_env,

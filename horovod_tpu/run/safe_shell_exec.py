@@ -40,12 +40,15 @@ def terminate_process_group(proc):
 
 
 def execute(command, env=None, stdout=None, stderr=None,
-            events=None) -> int:
+            events=None, stdin_data=None) -> int:
     """Run ``command`` (shell string or argv list) in a new process group.
 
     ``events``: optional list of ``threading.Event``; if any fires, the
     process tree is terminated (the launcher uses this to kill all ranks
     when one fails, reference: gloo_run.py:300-308).
+    ``stdin_data``: bytes written to the child's stdin then closed (used to
+    ship the job secret to ssh-launched ranks without putting it on the
+    remote command line).
     Returns the exit code.
     """
     import sys
@@ -53,8 +56,15 @@ def execute(command, env=None, stdout=None, stderr=None,
     shell = isinstance(command, str)
     proc = subprocess.Popen(
         command, shell=shell, env=env, start_new_session=True,
+        stdin=subprocess.PIPE if stdin_data is not None else None,
         stdout=subprocess.PIPE if stdout is not None else None,
         stderr=subprocess.PIPE if stderr is not None else None)
+    if stdin_data is not None:
+        try:
+            proc.stdin.write(stdin_data)
+            proc.stdin.close()
+        except BrokenPipeError:
+            pass
 
     forwarders = []
     if stdout is not None:

@@ -1,0 +1,22 @@
+"""Driver/task coordination services (reference: ``horovod/run/common/
+service/`` + ``horovod/run/driver/driver_service.py`` + ``horovod/run/
+task/task_service.py``): secret-keyed pickled-message TCP services used by
+the launcher for task registration and routable-NIC discovery."""
+
+from horovod_tpu.run.service.network import (  # noqa: F401
+    AckResponse,
+    BasicClient,
+    BasicService,
+    PingRequest,
+    PingResponse,
+)
+from horovod_tpu.run.service.driver_service import (  # noqa: F401
+    DriverClient,
+    DriverService,
+    find_common_interfaces,
+)
+from horovod_tpu.run.service.task_service import (  # noqa: F401
+    TaskClient,
+    TaskService,
+)
+from horovod_tpu.run.service import secret  # noqa: F401

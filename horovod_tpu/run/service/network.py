@@ -1,0 +1,207 @@
+"""Secret-keyed pickled-message TCP services (reference:
+``horovod/run/common/service/__init__.py`` + ``horovod/run/common/util/
+network.py`` — a threaded socket server exchanging HMAC-signed pickled
+request/response objects, plus interface enumeration helpers used for
+routable-NIC discovery).
+
+Wire format per message: ``[4-byte big-endian length][32-byte HMAC-SHA256
+digest][pickled object]``.  The digest is verified BEFORE unpickling — an
+unauthenticated peer cannot reach the unpickler.
+"""
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+from horovod_tpu.run.service import secret
+
+
+# ------------------------------------------------------------- base messages
+class PingRequest:
+    pass
+
+
+class PingResponse:
+    def __init__(self, service_name):
+        self.service_name = service_name
+
+
+class AckResponse:
+    pass
+
+
+# ---------------------------------------------------------------- wire codec
+def write_message(sock, key, obj):
+    payload = pickle.dumps(obj)
+    digest = secret.sign(key, payload)
+    sock.sendall(struct.pack(">I", len(payload)) + digest + payload)
+
+
+def read_message(sock, key):
+    header = _read_exact(sock, 4 + secret.DIGEST_LEN)
+    (length,) = struct.unpack(">I", header[:4])
+    digest = header[4:]
+    payload = _read_exact(sock, length)
+    if not secret.check(key, payload, digest):
+        raise PermissionError("message failed HMAC verification")
+    return pickle.loads(payload)
+
+
+def _read_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf += chunk
+    return buf
+
+
+# ------------------------------------------------------------------- service
+class BasicService:
+    """Threaded TCP service answering one signed request per connection
+    (reference: ``network.BasicService``)."""
+
+    def __init__(self, name, key):
+        self._name = name
+        self._key = key
+        service = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    req = read_message(self.request, service._key)
+                except (PermissionError, ConnectionError, EOFError):
+                    return  # drop unauthenticated/broken peers silently
+                try:
+                    resp = service._handle(req, self.client_address)
+                except Exception as exc:  # noqa: BLE001 — ship to client
+                    resp = exc
+                write_message(self.request, service._key, resp)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server(("0.0.0.0", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name=f"{name}-service")
+        self._thread.start()
+
+    @property
+    def port(self):
+        return self._server.server_address[1]
+
+    def addresses(self):
+        """{interface: [(ip, port)]} for every non-loopback interface
+        (reference: ``network.get_local_host_addresses``)."""
+        out = {}
+        for iface, ip in local_interfaces().items():
+            out[iface] = [(ip, self.port)]
+        return out
+
+    def _handle(self, req, client_address):
+        if isinstance(req, PingRequest):
+            return PingResponse(self._name)
+        raise ValueError(f"unknown request type {type(req).__name__}")
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+class BasicClient:
+    """One-connection-per-request client (reference:
+    ``network.BasicClient``): tries each known (ip, port) until one
+    answers, remembers the winner."""
+
+    def __init__(self, addresses, key, timeout=10):
+        # addresses: {iface: [(ip, port)]} or flat [(ip, port)]
+        if isinstance(addresses, dict):
+            flat = [a for addrs in addresses.values() for a in addrs]
+        else:
+            flat = list(addresses)
+        if not flat:
+            raise ValueError("no addresses to connect to")
+        self._addresses = flat
+        self._good = None
+        self._key = key
+        self._timeout = timeout
+
+    def _send_one(self, addr, req):
+        with socket.create_connection(addr, timeout=self._timeout) as sock:
+            write_message(sock, self._key, req)
+            resp = read_message(sock, self._key)
+        if isinstance(resp, Exception):
+            raise resp
+        return resp
+
+    def send(self, req):
+        if self._good is not None:
+            return self._send_one(self._good, req)
+        last_error = None
+        for addr in self._addresses:
+            try:
+                resp = self._send_one(addr, req)
+                self._good = addr
+                return resp
+            except (OSError, ConnectionError) as exc:
+                last_error = exc
+        raise ConnectionError(
+            f"could not reach service at any of {self._addresses}: "
+            f"{last_error}")
+
+    def probe(self):
+        """Which of the candidate addresses actually answer a Ping
+        (reference: the task-to-task address check,
+        ``driver_service.py:156``)."""
+        good = []
+        for addr in self._addresses:
+            try:
+                resp = self._send_one(addr, PingRequest())
+                if isinstance(resp, PingResponse):
+                    good.append(addr)
+            except (OSError, ConnectionError, PermissionError):
+                continue
+        return good
+
+
+# ----------------------------------------------------------- NIC enumeration
+def local_interfaces():
+    """{interface_name: ipv4} for every UP non-loopback interface.
+
+    Stdlib-only Linux implementation (ioctl SIOCGIFADDR per interface from
+    ``socket.if_nameindex``); falls back to a hostname lookup pinned to a
+    pseudo-interface when the ioctl path is unavailable.
+    """
+    import fcntl
+
+    out = {}
+    try:
+        ifaces = socket.if_nameindex()
+    except OSError:
+        ifaces = []
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        for _, name in ifaces:
+            if name == "lo":
+                continue
+            try:
+                packed = fcntl.ioctl(
+                    s.fileno(), 0x8915,  # SIOCGIFADDR
+                    struct.pack("256s", name.encode()[:15]))
+                out[name] = socket.inet_ntoa(packed[20:24])
+            except OSError:
+                continue  # interface without an IPv4 address
+    finally:
+        s.close()
+    if not out:
+        try:
+            out["_default"] = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            out["_default"] = "127.0.0.1"
+    return out

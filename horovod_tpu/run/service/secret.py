@@ -1,0 +1,22 @@
+"""Shared-secret generation and message signing (reference:
+``horovod/run/common/util/secret.py`` — an HMAC key minted by the driver
+and passed to tasks through the environment so that only processes of this
+job can talk to its services)."""
+
+import hmac
+import hashlib
+import os
+
+DIGEST_LEN = 32  # sha256
+
+
+def make_secret_key() -> bytes:
+    return os.urandom(32)
+
+
+def sign(key: bytes, payload: bytes) -> bytes:
+    return hmac.new(key, payload, hashlib.sha256).digest()
+
+
+def check(key: bytes, payload: bytes, digest: bytes) -> bool:
+    return hmac.compare_digest(sign(key, payload), digest)

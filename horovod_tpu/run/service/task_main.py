@@ -1,0 +1,46 @@
+"""Task-server process entry (reference: ``horovod/run/task_fn.py`` — one
+short-lived server per host during launch, used by the driver for address
+registration and NIC probing).  Launched as
+
+    python -m horovod_tpu.run.service.task_main
+
+with the contract in env vars: ``HVD_TASK_INDEX``, ``HVD_DRIVER_ADDRS``
+(``ip:port;ip:port``), ``HVD_SECRET_KEY`` (base64)."""
+
+import base64
+import os
+import sys
+import time
+
+from horovod_tpu.run.service.driver_service import DriverClient
+from horovod_tpu.run.service.task_service import TaskService
+
+
+def main():
+    index = int(os.environ["HVD_TASK_INDEX"])
+    key = base64.b64decode(os.environ["HVD_SECRET_KEY"])
+    driver_addrs = []
+    for part in os.environ["HVD_DRIVER_ADDRS"].split(";"):
+        ip, port = part.rsplit(":", 1)
+        driver_addrs.append((ip, int(port)))
+    timeout = float(os.environ.get("HVD_TASK_TIMEOUT", "120"))
+
+    task = TaskService(index, key)
+    try:
+        client = DriverClient(driver_addrs, key)
+        client.register_task(index, task.addresses())
+        deadline = time.time() + timeout
+        while not task.shutdown_requested.is_set():
+            if time.time() > deadline:
+                sys.stderr.write(
+                    f"task server {index}: driver did not finish within "
+                    f"{timeout}s\n")
+                return 1
+            time.sleep(0.1)
+        return 0
+    finally:
+        task.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,7 @@ HVD_STALL_SHUTDOWN_TIME_SECONDS = "HVD_STALL_SHUTDOWN_TIME_SECONDS"
 HVD_HIERARCHICAL_ALLREDUCE = "HVD_HIERARCHICAL_ALLREDUCE"
 HVD_HIERARCHICAL_ALLGATHER = "HVD_HIERARCHICAL_ALLGATHER"
 HVD_HIER_LOCAL_SIZE = "HVD_HIER_LOCAL_SIZE"    # ranks per fast (ICI) group
+HVD_ADASUM_HIERARCHICAL = "HVD_ADASUM_HIERARCHICAL"  # opt-in: different math
 HVD_AUTOTUNE = "HVD_AUTOTUNE"
 HVD_AUTOTUNE_LOG = "HVD_AUTOTUNE_LOG"
 HVD_AUTOTUNE_WARMUP_SAMPLES = "HVD_AUTOTUNE_WARMUP_SAMPLES"
@@ -39,6 +40,7 @@ HVD_LOCAL_RANK = "HVD_LOCAL_RANK"
 HVD_LOCAL_SIZE = "HVD_LOCAL_SIZE"
 HVD_CROSS_RANK = "HVD_CROSS_RANK"
 HVD_CROSS_SIZE = "HVD_CROSS_SIZE"
+HVD_SECRET_KEY = "HVD_SECRET_KEY"              # base64 job secret (HMAC)
 HVD_RENDEZVOUS_ADDR = "HVD_RENDEZVOUS_ADDR"
 HVD_RENDEZVOUS_PORT = "HVD_RENDEZVOUS_PORT"
 HVD_CONTROLLER_ADDR = "HVD_CONTROLLER_ADDR"

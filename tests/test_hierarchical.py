@@ -61,6 +61,21 @@ for o0, o1 in basics.run_parallel(grouped):
     np.testing.assert_allclose(o0, exp0, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(o1, exp1, rtol=1e-4, atol=1e-5)
 
+# hierarchical Adasum (opt-in knob): adasum of per-group averages
+from horovod_tpu.ops.adasum import adasum_reference
+adata = [np.random.RandomState(50 + r).randn(21).astype(np.float32)
+         for r in range(N)]
+ga = np.sum(adata[:4], axis=0) / 4.0
+gb = np.sum(adata[4:], axis=0) / 4.0
+aexpected = adasum_reference([ga, gb])
+
+def afn(r):
+    return np.asarray(hvd.allreduce(jnp.asarray(adata[r]), op=hvd.Adasum,
+                                    name="h.adasum"))
+
+for out in basics.run_parallel(afn):
+    np.testing.assert_allclose(out, aexpected, rtol=1e-4, atol=1e-5)
+
 # allgather with per-rank variable first dimension
 gdata = [np.full((r + 1, 2), float(r), np.float32) for r in range(N)]
 gexpected = np.concatenate(gdata, axis=0)
@@ -92,6 +107,7 @@ def test_hierarchical_collectives_match_flat_expectation():
         "HVD_HIER_LOCAL_SIZE": "4",
         "HVD_HIERARCHICAL_ALLREDUCE": "1",
         "HVD_HIERARCHICAL_ALLGATHER": "1",
+        "HVD_ADASUM_HIERARCHICAL": "1",
     })
     assert result.returncode == 0, result.stderr
     assert "HIERARCHICAL_OK" in result.stdout

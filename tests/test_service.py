@@ -1,0 +1,152 @@
+"""Driver/task service tests (reference: ``test/test_service.py`` —
+in-process client/server over localhost sockets, concurrency + shutdown)."""
+
+import threading
+
+import pytest
+
+from horovod_tpu.run.service import (AckResponse, BasicClient, BasicService,
+                                     DriverClient, DriverService, PingRequest,
+                                     PingResponse, TaskClient, TaskService,
+                                     find_common_interfaces, secret)
+from horovod_tpu.run.service.network import local_interfaces
+
+
+def _local_addrs(service):
+    return {"lo0": [("127.0.0.1", service.port)]}
+
+
+def test_ping_roundtrip():
+    key = secret.make_secret_key()
+    svc = BasicService("test service", key)
+    try:
+        client = BasicClient(_local_addrs(svc), key)
+        resp = client.send(PingRequest())
+        assert isinstance(resp, PingResponse)
+        assert resp.service_name == "test service"
+    finally:
+        svc.shutdown()
+
+
+def test_wrong_key_is_rejected_before_unpickling():
+    key = secret.make_secret_key()
+    svc = BasicService("locked", key)
+    try:
+        client = BasicClient(_local_addrs(svc), secret.make_secret_key())
+        with pytest.raises((ConnectionError, OSError)):
+            client.send(PingRequest())
+    finally:
+        svc.shutdown()
+
+
+def test_unknown_request_returns_exception():
+    key = secret.make_secret_key()
+    svc = BasicService("svc", key)
+    try:
+        client = BasicClient(_local_addrs(svc), key)
+        with pytest.raises(ValueError, match="unknown request"):
+            client.send(object())
+    finally:
+        svc.shutdown()
+
+
+def test_driver_registration_and_nic_discovery():
+    key = secret.make_secret_key()
+    n = 4
+    driver = DriverService(n, key)
+    tasks = [TaskService(i, key) for i in range(n)]
+    try:
+        driver_addrs = _local_addrs(driver)
+
+        def register(i):
+            client = DriverClient(driver_addrs, key)
+            client.register_task(i, _local_addrs(tasks[i]))
+
+        threads = [threading.Thread(target=register, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        common = find_common_interfaces(driver, key, n, timeout=30)
+        assert common == {"lo0"}
+
+        # a driver client can fetch the full address table
+        client = DriverClient(driver_addrs, key)
+        table = client.all_task_addresses()
+        assert set(table.keys()) == set(range(n))
+    finally:
+        for t in tasks:
+            t.shutdown()
+        driver.shutdown()
+
+
+def test_nic_discovery_drops_unreachable_interface():
+    key = secret.make_secret_key()
+    driver = DriverService(2, key)
+    tasks = [TaskService(i, key) for i in range(2)]
+    try:
+        # task 1 advertises a bogus interface alongside the real one; the
+        # probe must drop it and the intersection keeps only the real NIC
+        addrs0 = _local_addrs(tasks[0])
+        addrs1 = {"lo0": [("127.0.0.1", tasks[1].port)],
+                  "bogus": [("10.255.255.1", 1)]}
+        client = DriverClient(_local_addrs(driver), key)
+        client.register_task(0, addrs0)
+        client.register_task(1, addrs1)
+        common = find_common_interfaces(driver, key, 2, timeout=60)
+        assert common == {"lo0"}
+    finally:
+        for t in tasks:
+            t.shutdown()
+        driver.shutdown()
+
+
+def test_task_run_command_reports_exit_code():
+    key = secret.make_secret_key()
+    task = TaskService(0, key)
+    try:
+        client = TaskClient(_local_addrs(task), key)
+        client.run_command("exit 7")
+        import time
+        deadline = time.time() + 30
+        code = None
+        while time.time() < deadline:
+            code = client.command_exit_code()
+            if code is not None:
+                break
+            time.sleep(0.05)
+        assert code == 7
+    finally:
+        task.shutdown()
+
+
+def test_timeout_lists_missing_tasks():
+    key = secret.make_secret_key()
+    driver = DriverService(3, key)
+    try:
+        client = DriverClient(_local_addrs(driver), key)
+        client.register_task(1, {"lo0": [("127.0.0.1", 1)]})
+        with pytest.raises(TimeoutError, match=r"\[0, 2\]"):
+            driver.wait_for_initial_registration(timeout=0.2)
+    finally:
+        driver.shutdown()
+
+
+def test_discovery_with_subprocess_task_servers():
+    """End-to-end discovery round against real task-server processes
+    (locally spawned, the launcher uses the same entry via ssh)."""
+    from horovod_tpu.run.driver_discovery import discover_common_interfaces
+
+    ifaces, ip = discover_common_interfaces(["localhost", "localhost"],
+                                            timeout=60)
+    assert ifaces
+    assert ip.count(".") == 3
+
+
+def test_local_interfaces_enumeration():
+    ifaces = local_interfaces()
+    assert ifaces, "must report at least one interface"
+    for name, ip in ifaces.items():
+        assert isinstance(name, str) and ip.count(".") == 3
