@@ -1,0 +1,54 @@
+"""Adasum vs summed SGD on a small model (reference:
+``examples/adasum_small_model.py`` + ``adasum_bench.ipynb``): the
+scale-invariant combination lets the learning rate stay put as the rank
+count grows.
+
+    python examples/adasum_small_model.py
+    hvdrun -np 2 python examples/adasum_small_model.py --op adasum
+"""
+
+import argparse
+
+import numpy as np
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--op", choices=["adasum", "sum", "average"],
+                        default="adasum")
+    parser.add_argument("--steps", type=int, default=50)
+    args = parser.parse_args()
+    op = {"adasum": hvd.Adasum, "sum": hvd.Sum,
+          "average": hvd.Average}[args.op]
+
+    hvd.init()
+
+    def train(rank):
+        rs = np.random.RandomState(rank)
+        # least squares: per-rank data slice
+        true_w = np.arange(1, 9, dtype=np.float32)
+        xs = rs.randn(64, 8).astype(np.float32)
+        ys = xs @ true_w + 0.01 * rs.randn(64).astype(np.float32)
+
+        w = np.zeros(8, dtype=np.float32)
+        lr = 0.05
+        for step in range(args.steps):
+            grad = 2.0 / len(xs) * xs.T @ (xs @ w - ys)
+            combined = np.asarray(hvd.allreduce(
+                jnp.asarray(grad), op=op, name=f"grad.{step}"))
+            w = w - lr * combined
+        return float(np.linalg.norm(w - true_w))
+
+    errors = basics.run_parallel(train)
+    if hvd.rank() == 0:
+        print(f"op={args.op}: final ||w - w*|| per rank = "
+              f"{[round(e, 4) for e in errors]}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Checkpoint/resume conventions (reference:
+``examples/keras_imagenet_resnet50.py`` — rank 0 writes, every rank
+receives the resume step through a broadcast, parameters re-broadcast
+after restore).
+
+    python examples/checkpoint_resume.py --dir /tmp/ckpts
+"""
+
+import argparse
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP
+from horovod_tpu.utils import checkpoint
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dir", default="/tmp/hvd_tpu_ckpts")
+    parser.add_argument("--steps", type=int, default=10)
+    args = parser.parse_args()
+
+    hvd.init()
+    model = MLP(features=(32, 4))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16)))
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+
+    # resume: all ranks agree on the step via broadcast
+    start = checkpoint.resume_step(args.dir)
+    if start is not None:
+        (params, opt_state), _ = checkpoint.restore_checkpoint(
+            args.dir, (params, opt_state), step=start)
+        params = hvd.broadcast_parameters(params, root_rank=0)
+        if hvd.rank() == 0:
+            print(f"resumed from step {start}")
+    start = 0 if start is None else start + 1
+
+    x = np.random.RandomState(0).randn(64, 16).astype(np.float32)
+    y = np.random.RandomState(1).randn(64, 4).astype(np.float32)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            return jnp.mean((model.apply(p, x) - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for i in range(start, start + args.steps):
+        params, opt_state, loss = step(params, opt_state)
+        if i % 5 == 0:
+            checkpoint.save_checkpoint(args.dir, (params, opt_state), i)
+            if hvd.rank() == 0:
+                print(f"step {i}: loss={float(loss):.5f} (checkpointed)")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Programmatic launch API (reference: ``horovod.run.run(fn)`` —
+``runner.py:648-669``: ship a pickled function to N worker processes and
+collect per-rank results, no CLI involved).
+
+    python examples/interactive_run.py
+"""
+
+import horovod_tpu.run as hvd_run
+
+
+def train(scale):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax.numpy as jnp
+    import horovod_tpu as hvd
+
+    hvd.init()
+    out = np.asarray(hvd.allreduce(
+        jnp.ones((2,)) * (hvd.rank() + 1) * scale, op=hvd.Sum, name="x"))
+    result = (hvd.rank(), out.tolist())
+    hvd.shutdown()
+    return result
+
+
+def main():
+    results = hvd_run.run(train, args=(10.0,), np=2)
+    print("per-rank results:", results)
+
+
+if __name__ == "__main__":
+    main()

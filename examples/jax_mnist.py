@@ -1,0 +1,90 @@
+"""Data-parallel MNIST-style training (JAX binding).
+
+The framework's hello-world, mirroring the reference's
+``examples/tensorflow2_mnist.py`` / ``pytorch_mnist.py``: initialize,
+shard the batch across ranks, wrap the optimizer, broadcast initial
+parameters, train.  Runs on synthetic MNIST-shaped data so it works in
+air-gapped environments; point ``load_data`` at a real loader to train on
+the actual dataset.
+
+    python examples/jax_mnist.py            # single process, all devices
+    hvdrun -np 2 python examples/jax_mnist.py
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP
+from horovod_tpu.parallel import make_mesh
+from horovod_tpu.parallel._compat import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def load_data(n=8192):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 784).astype(np.float32)
+    y = rng.randint(0, 10, (n,))
+    return x, y
+
+
+def parse_args():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=512)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--num-samples", type=int, default=8192)
+    return parser.parse_args()
+
+
+def main(epochs=2, batch=512, lr=0.1, num_samples=8192):
+    hvd.init()
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"hvd": n_dev})
+
+    model = MLP(features=(128, 10))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))
+    # reference convention: rank 0's initial state everywhere
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    opt = hvd.DistributedOptimizer(optax.sgd(lr, momentum=0.9),
+                                   named_axes=("hvd",))
+    opt_state = opt.init(params)
+
+    def per_shard(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+                logits, y))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, \
+            jax.lax.pmean(loss, "hvd")
+
+    step = jax.jit(shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(), P(), P("hvd"), P("hvd")),
+        out_specs=(P(), P(), P())))
+
+    x, y = load_data(num_samples)
+    sharded = NamedSharding(mesh, P("hvd"))
+    steps_per_epoch = len(x) // batch
+    for epoch in range(epochs):
+        for i in range(steps_per_epoch):
+            xb = jax.device_put(x[i * batch:(i + 1) * batch], sharded)
+            yb = jax.device_put(y[i * batch:(i + 1) * batch], sharded)
+            params, opt_state, loss = step(params, opt_state, xb, yb)
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={float(loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    a = parse_args()
+    main(epochs=a.epochs, batch=a.batch_size, lr=a.lr,
+         num_samples=a.num_samples)

@@ -1,0 +1,105 @@
+"""Synthetic CNN training benchmark (reference:
+``examples/pytorch_synthetic_benchmark.py:107-120`` — timed training loop
+over random data, prints img/sec mean over iterations).
+
+    python examples/jax_synthetic_benchmark.py --model resnet50
+    python examples/jax_synthetic_benchmark.py --model vgg16 --batch-size 32
+"""
+
+import argparse
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import InceptionV3, ResNet50, ResNet101, VGG16
+from horovod_tpu.parallel import make_mesh
+from horovod_tpu.parallel._compat import shard_map
+
+MODELS = {
+    "resnet50": (ResNet50, 224),
+    "resnet101": (ResNet101, 224),
+    "vgg16": (VGG16, 224),
+    "inception_v3": (InceptionV3, 299),
+}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet50", choices=MODELS)
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="per-device batch size")
+    parser.add_argument("--num-warmup-batches", type=int, default=3)
+    parser.add_argument("--num-batches-per-iter", type=int, default=10)
+    parser.add_argument("--num-iters", type=int, default=3)
+    args = parser.parse_args()
+
+    hvd.init()
+    cls, img = MODELS[args.model]
+    n = len(jax.devices())
+    mesh = make_mesh({"hvd": n})
+    batch = args.batch_size * n
+
+    model = cls(num_classes=1000, dtype=jnp.bfloat16)
+    x = np.random.RandomState(0).randn(
+        batch, img, img, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 1000, (batch,))
+
+    variables = jax.jit(lambda r, x: model.init(r, x, train=False))(
+        jax.random.PRNGKey(0), jnp.zeros((1, img, img, 3)))
+    params = variables["params"]
+    extra = {k: v for k, v in variables.items() if k != "params"}
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
+                                   named_axes=("hvd",))
+    opt_state = opt.init(params)
+
+    def per_shard(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply({"params": p, **extra}, x, train=False)
+            one_hot = jax.nn.one_hot(y, 1000)
+            return -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(logits) * one_hot, axis=-1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, \
+            jax.lax.pmean(loss, "hvd")
+
+    step = jax.jit(shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(), P(), P("hvd"), P("hvd")),
+        out_specs=(P(), P(), P())), donate_argnums=(0, 1))
+
+    sharded = NamedSharding(mesh, P("hvd"))
+    xd = jax.device_put(x, sharded)
+    yd = jax.device_put(y, sharded)
+
+    for _ in range(args.num_warmup_batches):
+        params, opt_state, loss = step(params, opt_state, xd, yd)
+    jax.block_until_ready(loss)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        start = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, opt_state, loss = step(params, opt_state, xd, yd)
+        jax.block_until_ready(loss)
+        elapsed = time.perf_counter() - start
+        rate = batch * args.num_batches_per_iter / elapsed
+        img_secs.append(rate)
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {rate:.1f} img/sec total")
+    if hvd.rank() == 0:
+        mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+        print(f"Img/sec per device: {mean / n:.1f} +- {conf / n:.1f}")
+        print(f"Total img/sec on {n} device(s): {mean:.1f} +- {conf:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Uneven final batches with Join (reference: ``hvd.join()`` —
+``torch/mpi_ops_v2.cc:240``; joined ranks contribute zero stand-ins so the
+ranks still working can finish their epoch).
+
+    python examples/join_uneven_data.py
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+
+
+def main():
+    hvd.init()
+
+    def train(rank):
+        # rank r has r+1 batches: uneven by construction
+        losses = []
+        for step in range(rank + 1):
+            grad = np.full((4,), 1.0, np.float32)
+            out = np.asarray(hvd.allreduce(jnp.asarray(grad), op=hvd.Sum,
+                                           name=f"g.{step}"))
+            losses.append(float(out[0]))
+        last = hvd.join()  # blocks until every rank has joined
+        return losses, last
+
+    results = basics.run_parallel(train)
+    if hvd.rank() == 0:
+        for r, (losses, last) in enumerate(results):
+            print(f"rank {r}: step sums {losses} (last to join: {last})")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Cluster glue: estimator framework (reference: ``horovod/spark/`` §2.5 —
+Estimators that materialize a dataset to a Store, train one process per
+rank through a Backend, checkpoint to the store and hand back a servable
+model).  Spark itself is optional glue in the reference; the equivalent
+here is backend-pluggable (in-process device ranks, hvdrun processes) with
+the same Store/Params/Estimator shape, so a Spark backend is one subclass
+away."""
+
+from horovod_tpu.cluster.store import LocalStore, Store  # noqa: F401
+from horovod_tpu.cluster.backend import (  # noqa: F401
+    Backend,
+    InProcessBackend,
+    ProcessBackend,
+)
+from horovod_tpu.cluster.estimator import (  # noqa: F401
+    JaxEstimator,
+    JaxModel,
+)
+from horovod_tpu.cluster.torch_estimator import (  # noqa: F401
+    TorchEstimator,
+    TorchModel,
+)

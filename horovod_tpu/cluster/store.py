@@ -1,0 +1,64 @@
+"""Data/checkpoint store (reference: ``horovod/spark/common/store.py:30,149``
+— ``Store`` abstracts local FS / HDFS / S3 locations for intermediate
+training data and checkpoints; ``LocalStore`` is the filesystem flavor).
+
+Training data is materialized as one ``.npz`` shard per rank (the
+reference writes Parquet via Petastorm; npz keeps this dependency-free —
+swap the (de)serializers to change formats)."""
+
+import os
+
+import numpy as np
+
+
+class Store:
+    """Abstract locations + (de)serialization for one training job."""
+
+    def train_data_path(self, rank=None):
+        raise NotImplementedError
+
+    def checkpoint_path(self):
+        raise NotImplementedError
+
+    def save_shard(self, rank, arrays):
+        raise NotImplementedError
+
+    def load_shard(self, rank):
+        raise NotImplementedError
+
+    def exists(self, path):
+        raise NotImplementedError
+
+
+class LocalStore(Store):
+    """Filesystem store (reference: ``store.py`` LocalStore /
+    FilesystemStore)."""
+
+    def __init__(self, prefix_path):
+        self.prefix_path = prefix_path
+        os.makedirs(prefix_path, exist_ok=True)
+
+    def train_data_path(self, rank=None):
+        base = os.path.join(self.prefix_path, "intermediate_train_data")
+        if rank is None:
+            return base
+        return os.path.join(base, f"part_{rank:05d}.npz")
+
+    def checkpoint_path(self):
+        return os.path.join(self.prefix_path, "checkpoints")
+
+    def save_shard(self, rank, arrays):
+        os.makedirs(self.train_data_path(), exist_ok=True)
+        path = self.train_data_path(rank)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+        return path
+
+    def load_shard(self, rank):
+        with np.load(self.train_data_path(rank)) as data:
+            return {k: data[k] for k in data.files}
+
+    def exists(self, path):
+        return os.path.exists(path)

@@ -1,0 +1,130 @@
+"""Torch estimator (reference: ``horovod/spark/torch/estimator.py:449``
+TorchEstimator — same fit contract as the Keras flavor, for torch
+modules: per-rank remote trainer with DistributedOptimizer, checkpoint to
+store, metric averaging)."""
+
+import numpy as np
+
+from horovod_tpu.cluster.backend import InProcessBackend
+from horovod_tpu.cluster.store import LocalStore
+
+
+def _train_one_rank(rank, model_factory, loss_name, store, epochs,
+                    batch_size, learning_rate):
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    model = model_factory()
+    loss_fn = getattr(torch.nn.functional, loss_name)
+    shard = store.load_shard(rank)
+    x = torch.tensor(shard["x"], dtype=torch.float32)
+    y = torch.tensor(shard["y"])
+    if y.dtype == torch.float64:
+        y = y.float()
+
+    optimizer = torch.optim.SGD(model.parameters(), lr=learning_rate,
+                                momentum=0.9)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    loss = torch.zeros(())
+    for _ in range(epochs):
+        for i in range(0, max(len(x) - batch_size + 1, 1), batch_size):
+            optimizer.zero_grad()
+            loss = loss_fn(model(x[i:i + batch_size]), y[i:i + batch_size])
+            loss.backward()
+            optimizer.step()
+
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd_core
+
+    avg_loss = float(np.asarray(hvd_core.allreduce(
+        jnp.asarray([float(loss.detach())]), op=hvd_core.Average,
+        name="torch_estimator.metric.loss"))[0])
+
+    if rank == 0:
+        import os
+
+        os.makedirs(store.checkpoint_path(), exist_ok=True)
+        torch.save(model.state_dict(),
+                   os.path.join(store.checkpoint_path(), "model.pt"))
+    return avg_loss
+
+
+class TorchModel:
+    def __init__(self, model, loss_fn):
+        self.model = model
+        self._loss_fn = loss_fn
+
+    def predict(self, x):
+        import torch
+
+        with torch.no_grad():
+            return self.model(torch.as_tensor(x, dtype=torch.float32))
+
+    def evaluate(self, x, y):
+        import torch
+
+        y = torch.as_tensor(y)
+        if y.dtype == torch.float64:
+            y = y.float()
+        with torch.no_grad():
+            return float(self._loss_fn(self.predict(x), y))
+
+
+class TorchEstimator:
+    """Distributed trainer for a torch module over a Store + Backend.
+
+    ``model_factory`` is a zero-arg callable building the module (modules
+    cross process boundaries by re-construction + checkpoint load, the way
+    the reference serializes models for remote trainers).  ``loss`` is the
+    name of a ``torch.nn.functional`` loss.
+    """
+
+    def __init__(self, model_factory, loss="mse_loss", epochs=1,
+                 batch_size=32, learning_rate=0.01, store=None,
+                 backend=None):
+        self.model_factory = model_factory
+        self.loss = loss
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.learning_rate = learning_rate
+        self.store = store
+        self.backend = backend
+
+    def fit(self, x, y):
+        import os
+        import tempfile
+
+        import torch
+
+        store = self.store or LocalStore(tempfile.mkdtemp(
+            prefix="hvd_tpu_torch_estimator_"))
+        backend = self.backend or InProcessBackend()
+        n = backend.num_processes()
+
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if len(x) < n:
+            raise ValueError(
+                f"need at least one sample per rank ({n}), got {len(x)}")
+        for rank, (xs, ys) in enumerate(
+                zip(np.array_split(x, n), np.array_split(y, n))):
+            store.save_shard(rank, {"x": xs, "y": ys})
+
+        metrics = backend.run(
+            _train_one_rank,
+            args=(self.model_factory, self.loss, store, self.epochs,
+                  self.batch_size, self.learning_rate))
+
+        model = self.model_factory()
+        model.load_state_dict(torch.load(
+            os.path.join(store.checkpoint_path(), "model.pt"),
+            weights_only=True))
+        model.eval()
+        loss_fn = getattr(torch.nn.functional, self.loss)
+        return TorchModel(model, loss_fn), metrics

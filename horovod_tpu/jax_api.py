@@ -98,7 +98,17 @@ def broadcast_parameters(params, root_rank=0):
     the eager-mode / process-mode synchronization primitive, used after
     checkpoint restore or at train start.
     """
+    from horovod_tpu.common import basics
     from horovod_tpu.ops import eager
+
+    state = basics._get_state()
+    if state.config.controller != "tcp":
+        # Device-rank mode: every logical rank lives in this process and
+        # shares the caller's pytree — already root_rank's values.  Only a
+        # per-rank thread context (run_parallel) can legally block on an
+        # eager broadcast here.
+        if getattr(basics._tls, "local_rank", None) is None:
+            return params
 
     leaves, treedef = jax.tree.flatten(params)
     handles = [

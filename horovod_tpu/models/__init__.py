@@ -6,6 +6,8 @@ from horovod_tpu.models.resnet import (  # noqa: F401
     ResNet101,
     ResNet152,
 )
+from horovod_tpu.models.vgg import VGG, VGG16, VGG19  # noqa: F401
+from horovod_tpu.models.inception import InceptionV3  # noqa: F401
 from horovod_tpu.models.mlp import MLP  # noqa: F401
 from horovod_tpu.models.transformer import (  # noqa: F401
     Transformer,

@@ -1,0 +1,155 @@
+"""Inception V3 (flax) — the reference's headline scaling benchmark (90%
+efficiency at 512 GPUs, ``README.rst:79``, ``docs/benchmarks.rst:13``).
+
+Standard Szegedy et al. 2015 topology (mixed 35/17/8 blocks with factorized
+convolutions), TPU-first: NHWC, bfloat16 compute / fp32 params+stats, every
+conv BN'd (no biases).  Input 299x299x3.
+"""
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+class MixedA(nn.Module):  # 35x35 blocks
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b1 = conv(64, (1, 1))(x, train)
+        b5 = conv(48, (1, 1))(x, train)
+        b5 = conv(64, (5, 5))(b5, train)
+        b3 = conv(64, (1, 1))(x, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = conv(self.pool_features, (1, 1))(bp, train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class ReductionA(nn.Module):  # 35 -> 17
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b3 = conv(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        bd = conv(64, (1, 1))(x, train)
+        bd = conv(96, (3, 3))(bd, train)
+        bd = conv(96, (3, 3), (2, 2), padding="VALID")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class MixedB(nn.Module):  # 17x17 blocks, factorized 7x7
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        c = self.channels_7x7
+        b1 = conv(192, (1, 1))(x, train)
+        b7 = conv(c, (1, 1))(x, train)
+        b7 = conv(c, (1, 7))(b7, train)
+        b7 = conv(192, (7, 1))(b7, train)
+        bd = conv(c, (1, 1))(x, train)
+        bd = conv(c, (7, 1))(bd, train)
+        bd = conv(c, (1, 7))(bd, train)
+        bd = conv(c, (7, 1))(bd, train)
+        bd = conv(192, (1, 7))(bd, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = conv(192, (1, 1))(bp, train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class ReductionB(nn.Module):  # 17 -> 8
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b3 = conv(192, (1, 1))(x, train)
+        b3 = conv(320, (3, 3), (2, 2), padding="VALID")(b3, train)
+        b7 = conv(192, (1, 1))(x, train)
+        b7 = conv(192, (1, 7))(b7, train)
+        b7 = conv(192, (7, 1))(b7, train)
+        b7 = conv(192, (3, 3), (2, 2), padding="VALID")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class MixedC(nn.Module):  # 8x8 blocks, expanded filter bank
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b1 = conv(320, (1, 1))(x, train)
+        b3 = conv(384, (1, 1))(x, train)
+        b3a = conv(384, (1, 3))(b3, train)
+        b3b = conv(384, (3, 1))(b3, train)
+        bd = conv(448, (1, 1))(x, train)
+        bd = conv(384, (3, 3))(bd, train)
+        bda = conv(384, (1, 3))(bd, train)
+        bdb = conv(384, (3, 1))(bd, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = conv(192, (1, 1))(bp, train)
+        return jnp.concatenate([b1, b3a, b3b, bda, bdb, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem: 299 -> 35
+        x = conv(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = conv(32, (3, 3), padding="VALID")(x, train)
+        x = conv(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = conv(80, (1, 1), padding="VALID")(x, train)
+        x = conv(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 35x35
+        x = MixedA(32, dtype=self.dtype)(x, train)
+        x = MixedA(64, dtype=self.dtype)(x, train)
+        x = MixedA(64, dtype=self.dtype)(x, train)
+        x = ReductionA(dtype=self.dtype)(x, train)
+        # 17x17
+        x = MixedB(128, dtype=self.dtype)(x, train)
+        x = MixedB(160, dtype=self.dtype)(x, train)
+        x = MixedB(160, dtype=self.dtype)(x, train)
+        x = MixedB(192, dtype=self.dtype)(x, train)
+        x = ReductionB(dtype=self.dtype)(x, train)
+        # 8x8
+        x = MixedC(dtype=self.dtype)(x, train)
+        x = MixedC(dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,48 @@
+"""VGG family (flax) — the reference's hardest-to-scale benchmark model
+(68% scaling efficiency at 512 GPUs vs 90% for ResNet, ``README.rst:79``:
+VGG-16's huge dense layers stress gradient allreduce bandwidth).
+
+TPU-first: NHWC, bfloat16 compute / fp32 params, and the classifier
+expressed as matmuls that tile onto the MXU.
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Configuration "D" (VGG-16) / "E" (VGG-19): numbers are conv widths,
+# "M" is 2x2 max-pool.
+_CFG_16 = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M")
+_CFG_19 = (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+           512, 512, 512, 512, "M", 512, 512, 512, 512, "M")
+
+
+class VGG(nn.Module):
+    cfg: Sequence
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    classifier_width: int = 4096
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding="SAME", dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        for _ in range(2):
+            x = nn.Dense(self.classifier_width, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+VGG16 = partial(VGG, cfg=_CFG_16)
+VGG19 = partial(VGG, cfg=_CFG_19)

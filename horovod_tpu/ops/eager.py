@@ -37,9 +37,27 @@ def _resolve_op(op, average):
     return ReduceOp(op)
 
 
+def _require_rank_context(state, name):
+    """Device-rank mode runs every logical rank inside this process; an
+    eager collective from the plain main thread would wait forever for the
+    other ranks' submissions.  Fail fast with directions instead
+    (reference analog: hanging negotiation is what the StallInspector
+    exists to flag)."""
+    if (state.config.controller != "tcp" and state.topology.size > 1
+            and getattr(basics._tls, "local_rank", None) is None):
+        raise RuntimeError(
+            f"eager collective '{name}' called from the main thread in "
+            f"single-process device-rank mode (size="
+            f"{state.topology.size}): each logical rank needs its own "
+            f"context. Use horovod_tpu.common.basics.run_parallel(fn), "
+            f"launch one process per rank with hvdrun, or use the SPMD "
+            f"API (DistributedOptimizer inside shard_map)")
+
+
 def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
             prescale_factor=1.0, postscale_factor=1.0, splits=None) -> Handle:
     state = basics._get_state()
+    _require_rank_context(state, name)
     committed = state.executor.commit(tensor, basics.local_rank()) \
         if tensor is not None else None
     handle = Handle(name)
@@ -135,6 +153,7 @@ def join() -> int:
     every rank has joined and returns the last rank to join (reference:
     torch/mpi_ops_v2.cc:240 DoJoin, controller.cc joined handling)."""
     state = basics._get_state()
+    _require_rank_context(state, "join")
     handle = Handle("join")
     state.controller.join(basics.rank(), handle)
     return handle.wait()

@@ -29,7 +29,6 @@ import base64
 import os
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -171,9 +170,19 @@ class CoordinatorService(network.BasicService):
                     sorted(entry.requests), missing,
                     int(self._stall_warning))
             if deadline is not None and time.monotonic() > deadline:
-                return ResultMsg(error=(
-                    f"stalled tensor '{req.name}' exceeded shutdown "
-                    f"threshold of {self._stall_shutdown}s"))
+                # fail EVERY waiter and clear the entry: a poisoned name
+                # must not block the join barrier or reject resubmissions
+                # forever (reference: stall shutdown fails all pending)
+                message = (f"stalled tensor '{req.name}' exceeded shutdown "
+                           f"threshold of {self._stall_shutdown}s")
+                with self._cv:
+                    if self._forming.get(req.name) is entry:
+                        del self._forming[req.name]
+                        entry.results = {r: ResultMsg(error=message)
+                                         for r in entry.requests}
+                        entry.done.set()
+                        self._check_join_barrier()
+                break
         return entry.results.get(req.rank,
                                  ResultMsg(error="internal: no result"))
 
@@ -360,8 +369,6 @@ class TcpController:
         self._coordinator = None
         self._client_addrs = None
         self._key = None
-        self._pool = ThreadPoolExecutor(
-            max_workers=16, thread_name_prefix="hvd-tcp")
         self._log = get_logger()
 
     # -------------------------------------------------------------- lifecycle
@@ -384,15 +391,16 @@ class TcpController:
                 self._size, self._key,
                 stall_warning_sec=self._config.stall_warning_seconds,
                 stall_shutdown_sec=self._config.stall_shutdown_seconds)
-            addrs = [(ip, self._coordinator.port)
-                     for ip in network.local_interfaces().values()]
-            addrs.append(("127.0.0.1", self._coordinator.port))
+            tagged = [(iface, ip, self._coordinator.port)
+                      for iface, ip in network.local_interfaces().items()]
+            tagged.append(("lo", "127.0.0.1", self._coordinator.port))
             if addr is not None:
                 from horovod_tpu.run import http_client
                 http_client.put(
                     addr, int(port), CONTROLLER_SCOPE, CONTROLLER_KEY,
-                    ";".join(f"{ip}:{p}" for ip, p in addrs).encode())
-            self._client_addrs = addrs
+                    ";".join(f"{i}={ip}:{p}"
+                             for i, ip, p in tagged).encode())
+            self._client_addrs = self._filter_ifaces(tagged)
         else:
             if addr is None:
                 raise RuntimeError(
@@ -401,22 +409,41 @@ class TcpController:
             from horovod_tpu.run import http_client
             blob = http_client.get(addr, int(port), CONTROLLER_SCOPE,
                                    CONTROLLER_KEY, timeout=120).decode()
-            self._client_addrs = []
+            tagged = []
             for part in blob.split(";"):
-                ip, p = part.rsplit(":", 1)
-                self._client_addrs.append((ip, int(p)))
+                iface, rest = part.split("=", 1)
+                ip, p = rest.rsplit(":", 1)
+                tagged.append((iface, ip, int(p)))
+            self._client_addrs = self._filter_ifaces(tagged)
+
+    @staticmethod
+    def _filter_ifaces(tagged):
+        """Pin to the launcher-discovered interface when HVD_IFACE is set
+        and the coordinator advertises it; otherwise keep every address
+        (reference: NIC discovery exporting the common interface)."""
+        iface = os.environ.get(env_util.HVD_IFACE)
+        pinned = [(ip, p) for i, ip, p in tagged if i == iface]
+        return pinned or [(ip, p) for _, ip, p in tagged]
 
     def _client(self):
-        # one client per call: connections are per-request and the pool
-        # runs many collectives concurrently
-        iface = os.environ.get(env_util.HVD_IFACE)
-        addrs = self._client_addrs
-        del iface  # address list already host-filtered by discovery
-        return network.BasicClient(addrs, self._key, timeout=300)
+        # one client per call — connections are per-request.  The response
+        # read blocks without a deadline: collectives legitimately wait for
+        # the slowest rank and the coordinator owns stall handling.
+        return network.BasicClient(self._client_addrs, self._key,
+                                   timeout=30, read_timeout=None)
+
+    def _spawn(self, target, *args):
+        # one daemon thread per in-flight request (a bounded pool of
+        # blocking round-trips can deadlock: with >pool outstanding
+        # collectives submitted in different per-rank orders, no name ever
+        # has all contributions.  The reference's request inserts are
+        # non-blocking for the same reason.)
+        threading.Thread(target=target, args=args, daemon=True,
+                         name="hvd-tcp-req").start()
 
     # ------------------------------------------------------------ producer API
     def enqueue(self, request):
-        self._pool.submit(self._run_one, request)
+        self._spawn(self._run_one, request)
 
     def _run_one(self, request):
         try:
@@ -453,10 +480,9 @@ class TcpController:
             except Exception as exc:  # noqa: BLE001
                 handle.set_error(str(exc))
 
-        self._pool.submit(run)
+        self._spawn(run)
 
     def shutdown(self):
-        self._pool.shutdown(wait=False)
         if self._coordinator is not None:
             self._coordinator.shutdown()
             self._coordinator = None

@@ -6,6 +6,7 @@ between every pair.  The winning interface provides the rendezvous bind
 address and is exported as ``HVD_IFACE`` to the workers."""
 
 import base64
+import os
 import shlex
 import subprocess
 import sys
@@ -20,21 +21,23 @@ from horovod_tpu.utils.logging import get_logger
 LOCAL_HOSTS = ("localhost", "127.0.0.1")
 
 
-def _task_server_command(index, driver_addrs, key, ssh_port=None, host=None):
+def _task_server_command(index, driver_addrs, ssh_port=None, host=None):
+    """The secret stays OFF the command line (ps-visible on every host) —
+    task_main reads it from stdin, which ssh forwards."""
     env = {
         "HVD_TASK_INDEX": str(index),
         "HVD_DRIVER_ADDRS": ";".join(f"{ip}:{port}"
                                      for ip, port in driver_addrs),
-        "HVD_SECRET_KEY": base64.b64encode(key).decode(),
     }
-    inner = (" ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-             + f" {shlex.quote(sys.executable)} -m "
-               "horovod_tpu.run.service.task_main")
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    inner = (f"cd {shlex.quote(os.getcwd())} && {exports} "
+             f"{shlex.quote(sys.executable)} -m "
+             "horovod_tpu.run.service.task_main")
     if host is None or host in LOCAL_HOSTS:
-        return inner, None
+        return inner
     port = f"-p {ssh_port} " if ssh_port else ""
     return (f"ssh -o StrictHostKeyChecking=no {port}{host} "
-            f"{shlex.quote(inner)}"), host
+            f"{shlex.quote(inner)}")
 
 
 def discover_common_interfaces(hostnames, ssh_port=None, timeout=60):
@@ -49,10 +52,18 @@ def discover_common_interfaces(hostnames, ssh_port=None, timeout=60):
     try:
         driver_addrs = [(ip, driver.port)
                         for ip in local_interfaces().values()]
+        key_line = base64.b64encode(key) + b"\n"
         for i, host in enumerate(hostnames):
-            cmd, _ = _task_server_command(i, driver_addrs, key,
-                                          ssh_port=ssh_port, host=host)
-            procs.append(subprocess.Popen(cmd, shell=True))
+            cmd = _task_server_command(i, driver_addrs,
+                                       ssh_port=ssh_port, host=host)
+            proc = subprocess.Popen(cmd, shell=True,
+                                    stdin=subprocess.PIPE)
+            try:
+                proc.stdin.write(key_line)
+                proc.stdin.close()
+            except BrokenPipeError:
+                pass
+            procs.append(proc)
 
         common = find_common_interfaces(driver, key, len(hostnames),
                                         timeout=timeout)

@@ -139,7 +139,10 @@ def run_commandline(argv=None) -> int:
             extra_env.setdefault(env_util.HVD_IFACE, sorted(ifaces)[0])
         else:
             addr = _routable_addr(slots)
-    command = " ".join(args.command)
+    # Quote each token so arguments with spaces/quotes survive the shell
+    # (reference: runner.py quotes the unknown args the same way).
+    import shlex
+    command = " ".join(shlex.quote(c) for c in args.command)
     try:
         return launch_job(slots, command, addr, port, extra_env=extra_env,
                           ssh_port=args.ssh_port, verbose=args.verbose)

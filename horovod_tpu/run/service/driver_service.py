@@ -85,17 +85,6 @@ class DriverService(network.BasicService):
                 raise TimeoutError(
                     f"tasks {missing} did not register within {timeout}s")
 
-    def wait_for_task_to_task_checks(self, timeout=60):
-        with self._cv:
-            if not self._cv.wait_for(
-                    lambda: len(self._task_to_task) == self._num_proc,
-                    timeout=timeout):
-                missing = [i for i in range(self._num_proc)
-                           if i not in self._task_to_task]
-                raise TimeoutError(
-                    f"tasks {missing} did not report their reachability "
-                    f"probe within {timeout}s")
-
     def task_addresses(self, index):
         with self._cv:
             return self._registered[index]

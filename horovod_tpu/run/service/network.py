@@ -119,8 +119,12 @@ class BasicClient:
     ``network.BasicClient``): tries each known (ip, port) until one
     answers, remembers the winner."""
 
-    def __init__(self, addresses, key, timeout=10):
-        # addresses: {iface: [(ip, port)]} or flat [(ip, port)]
+    def __init__(self, addresses, key, timeout=10, read_timeout="same"):
+        # addresses: {iface: [(ip, port)]} or flat [(ip, port)].
+        # ``timeout`` bounds connection establishment; ``read_timeout``
+        # bounds the response wait (None = wait forever — collectives
+        # legitimately block until every rank contributes, and the
+        # coordinator owns stall detection).
         if isinstance(addresses, dict):
             flat = [a for addrs in addresses.values() for a in addrs]
         else:
@@ -131,9 +135,12 @@ class BasicClient:
         self._good = None
         self._key = key
         self._timeout = timeout
+        self._read_timeout = timeout if read_timeout == "same" \
+            else read_timeout
 
     def _send_one(self, addr, req):
         with socket.create_connection(addr, timeout=self._timeout) as sock:
+            sock.settimeout(self._read_timeout)
             write_message(sock, self._key, req)
             resp = read_message(sock, self._key)
         if isinstance(resp, Exception):
@@ -141,16 +148,31 @@ class BasicClient:
         return resp
 
     def send(self, req):
+        """Address failover happens ONLY at the connect phase.  Once a
+        request has been written, any error propagates — retransmitting a
+        non-idempotent message (e.g. a collective contribution that is
+        merely slow to complete) would hit the coordinator's
+        duplicate-request detection and fail the job."""
         if self._good is not None:
             return self._send_one(self._good, req)
         last_error = None
         for addr in self._addresses:
             try:
-                resp = self._send_one(addr, req)
-                self._good = addr
-                return resp
-            except (OSError, ConnectionError) as exc:
+                sock = socket.create_connection(addr, timeout=self._timeout)
+            except OSError as exc:
                 last_error = exc
+                continue
+            try:
+                with sock:
+                    sock.settimeout(self._read_timeout)
+                    write_message(sock, self._key, req)
+                    resp = read_message(sock, self._key)
+            except OSError:
+                raise  # sent — do NOT failover to another address
+            self._good = addr
+            if isinstance(resp, Exception):
+                raise resp
+            return resp
         raise ConnectionError(
             f"could not reach service at any of {self._addresses}: "
             f"{last_error}")

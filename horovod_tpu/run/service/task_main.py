@@ -4,8 +4,11 @@ registration and NIC probing).  Launched as
 
     python -m horovod_tpu.run.service.task_main
 
-with the contract in env vars: ``HVD_TASK_INDEX``, ``HVD_DRIVER_ADDRS``
-(``ip:port;ip:port``), ``HVD_SECRET_KEY`` (base64)."""
+with the contract: ``HVD_TASK_INDEX`` and ``HVD_DRIVER_ADDRS``
+(``ip:port;ip:port``) in env vars, and the base64 job secret as the first
+line of stdin — never on a command line or remote env export, where it
+would be ps-visible (the secret authenticates a service that can run
+commands)."""
 
 import base64
 import os
@@ -18,7 +21,10 @@ from horovod_tpu.run.service.task_service import TaskService
 
 def main():
     index = int(os.environ["HVD_TASK_INDEX"])
-    key = base64.b64decode(os.environ["HVD_SECRET_KEY"])
+    key = base64.b64decode(sys.stdin.readline().strip())
+    if not key:
+        sys.stderr.write("task server: no secret on stdin\n")
+        return 1
     driver_addrs = []
     for part in os.environ["HVD_DRIVER_ADDRS"].split(";"):
         ip, port = part.rsplit(":", 1)

@@ -1,0 +1,19 @@
+"""Spark binding gate (reference: ``horovod/spark/__init__.py``).
+
+PySpark is not part of this image; the estimator framework itself —
+Store, Backend, JaxEstimator, TorchEstimator (reference §2.5 capabilities)
+— lives Spark-free in :mod:`horovod_tpu.cluster`.  A Spark deployment
+implements ``horovod_tpu.cluster.Backend.run`` over Spark tasks (the
+reference's ``backend.py:90`` shape) and reuses everything else.
+"""
+
+try:
+    import pyspark  # noqa: F401
+except ImportError as exc:  # pragma: no cover
+    raise ImportError(
+        "horovod_tpu.spark requires PySpark, which is not installed in "
+        "this environment. The estimator framework (Store / Backend / "
+        "JaxEstimator / TorchEstimator) is available Spark-free in "
+        "horovod_tpu.cluster; implement a Backend over Spark tasks to "
+        "attach it to a cluster."
+    ) from exc

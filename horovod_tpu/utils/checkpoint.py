@@ -1,0 +1,116 @@
+"""Checkpoint/resume utilities.
+
+The reference has no core checkpointing — its conventions are rank-0-writes
+plus broadcast-on-resume (``examples/keras_imagenet_resnet50.py``:
+``resume_from_epoch = hvd.broadcast(resume_from_epoch, 0)``;
+``torch/__init__.py:452,484`` broadcast_parameters /
+broadcast_optimizer_state).  This module packages those conventions:
+
+- :func:`save_checkpoint` — rank 0 serializes the pytree (flax msgpack)
+  and renames atomically; other ranks no-op.  Old checkpoints pruned.
+- :func:`restore_checkpoint` — load the latest (or a specific) step.
+- :func:`resume_step` — the broadcast convention: every rank receives
+  rank 0's view of the latest step so all ranks resume identically.
+"""
+
+import os
+import re
+
+from flax import serialization
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+
+
+def _ckpt_path(directory, step):
+    return os.path.join(directory, f"ckpt_{step}.msgpack")
+
+
+def _steps_in(directory):
+    try:
+        entries = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    steps = []
+    for e in entries:
+        m = _CKPT_RE.match(e)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(directory):
+    """Highest checkpoint step in ``directory``, or None."""
+    steps = _steps_in(directory)
+    return steps[-1] if steps else None
+
+
+def save_checkpoint(directory, target, step, keep=3, rank=None):
+    """Rank-0-writes checkpoint of ``target`` (any pytree of arrays).
+
+    ``rank`` defaults to :func:`horovod_tpu.rank` when initialized, else 0.
+    Returns the written path on rank 0, None elsewhere.
+    """
+    if rank is None:
+        rank = _current_rank()
+    if rank != 0:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    data = serialization.to_bytes(target)
+    path = _ckpt_path(directory, step)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)  # atomic publish
+    if keep is not None:
+        for old in _steps_in(directory)[:-keep]:
+            try:
+                os.remove(_ckpt_path(directory, old))
+            except FileNotFoundError:
+                pass
+    return path
+
+
+def restore_checkpoint(directory, target, step=None):
+    """Load checkpoint ``step`` (default: latest) into the structure of
+    ``target``.  Returns (restored, step) or (target, None) when no
+    checkpoint exists."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            return target, None
+    with open(_ckpt_path(directory, step), "rb") as f:
+        data = f.read()
+    return serialization.from_bytes(target, data), step
+
+
+def resume_step(directory):
+    """The resume convention: rank 0 reads the latest step and every rank
+    receives it via broadcast, so a rank with a stale filesystem view
+    cannot resume from a different step (reference:
+    ``examples/keras_imagenet_resnet50.py`` resume broadcast)."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    step = latest_step(directory)
+    state = basics._state
+    if state is None or (state.config.controller != "tcp" and
+                         getattr(basics._tls, "local_rank", None) is None):
+        # single-process device mode (or not initialized): the local
+        # filesystem view IS the global view
+        return step
+    out = hvd.broadcast(
+        np.asarray([-1 if step is None else step], dtype=np.int64),
+        root_rank=0, name="checkpoint.resume_step")
+    val = int(np.asarray(out)[0])
+    return None if val < 0 else val
+
+
+def _current_rank():
+    from horovod_tpu.common import basics
+
+    try:
+        return basics.rank()
+    except Exception:  # noqa: BLE001 — not initialized: single process
+        return 0

@@ -1,0 +1,82 @@
+"""Checkpoint/resume convention tests (reference conventions:
+rank-0-writes + broadcast resume, ``examples/keras_imagenet_resnet50.py``)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from horovod_tpu.utils import checkpoint
+
+
+def _tree(value):
+    return {"params": {"w": np.full((3, 2), value, np.float32)},
+            "step_count": np.asarray(value, np.int32)}
+
+
+def test_save_restore_roundtrip(tmp_path):
+    d = str(tmp_path)
+    path = checkpoint.save_checkpoint(d, _tree(7.0), step=10, rank=0)
+    assert path.endswith("ckpt_10.msgpack")
+    restored, step = checkpoint.restore_checkpoint(d, _tree(0.0))
+    assert step == 10
+    np.testing.assert_allclose(restored["params"]["w"],
+                               np.full((3, 2), 7.0))
+
+
+def test_latest_and_pruning(tmp_path):
+    d = str(tmp_path)
+    for s in (1, 2, 3, 4, 5):
+        checkpoint.save_checkpoint(d, _tree(float(s)), step=s, keep=2,
+                                   rank=0)
+    assert checkpoint.latest_step(d) == 5
+    # only the newest two remain
+    restored, step = checkpoint.restore_checkpoint(d, _tree(0.0), step=4)
+    assert step == 4
+    restored, _ = checkpoint.restore_checkpoint(d, _tree(0.0))
+    np.testing.assert_allclose(restored["params"]["w"],
+                               np.full((3, 2), 5.0))
+    import os
+    assert len([e for e in os.listdir(d) if e.endswith(".msgpack")]) == 2
+
+
+def test_non_zero_rank_does_not_write(tmp_path):
+    d = str(tmp_path)
+    assert checkpoint.save_checkpoint(d, _tree(1.0), step=1, rank=3) is None
+    assert checkpoint.latest_step(d) is None
+
+
+def test_restore_empty_dir_returns_target(tmp_path):
+    tree = _tree(2.0)
+    restored, step = checkpoint.restore_checkpoint(str(tmp_path), tree)
+    assert step is None
+    assert restored is tree
+
+
+def test_resume_step_broadcast(hvd, tmp_path):
+    """Every rank sees rank 0's latest step through the broadcast."""
+    from horovod_tpu.common import basics
+
+    d = str(tmp_path)
+    checkpoint.save_checkpoint(d, _tree(1.0), step=42, rank=0)
+
+    def fn(r):
+        return checkpoint.resume_step(d)
+
+    assert basics.run_parallel(fn) == [42] * 8
+
+
+def test_resume_step_no_checkpoint(hvd, tmp_path):
+    from horovod_tpu.common import basics
+
+    def fn(r):
+        return checkpoint.resume_step(str(tmp_path))
+
+    assert basics.run_parallel(fn) == [None] * 8
+
+
+def test_jax_arrays_roundtrip(tmp_path):
+    tree = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)}
+    checkpoint.save_checkpoint(str(tmp_path), tree, step=1, rank=0)
+    restored, _ = checkpoint.restore_checkpoint(
+        str(tmp_path), {"w": jnp.zeros((2, 3), jnp.float32)})
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.arange(6).reshape(2, 3))

@@ -1,0 +1,87 @@
+"""Examples run end-to-end as smoke tests (reference CI runs its examples
+the same way, ``gen-pipeline.sh:145-264``)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run_example(name, *args, timeout=420):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    # Some TPU plugins ignore JAX_PLATFORMS; pin the CPU backend
+    # programmatically before the example module runs.
+    bootstrap = (
+        "import jax, runpy, sys; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        f"sys.argv = [sys.argv[0]] + {list(args)!r}; "
+        f"runpy.run_path({os.path.join(EXAMPLES, name)!r}, "
+        "run_name='__main__')"
+    )
+    return subprocess.run(
+        [sys.executable, "-c", bootstrap],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.mark.parametrize("name,args", [
+    ("adasum_small_model.py", ("--steps", "10")),
+    ("join_uneven_data.py", ()),
+    ("interactive_run.py", ()),
+    ("ring_attention_long_context.py", ("--seq-len", "512")),
+    ("transformer_lm.py", ("--steps", "2", "--d-model", "64",
+                           "--n-layers", "2", "--seq-len", "32")),
+    ("jax_mnist.py", ("--epochs", "1", "--batch-size", "256",
+                      "--num-samples", "512")),
+])
+def test_example_runs(name, args):
+    result = _run_example(name, *args)
+    assert result.returncode == 0, \
+        f"{name} failed\nstdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+
+
+def test_torch_mnist_under_hvdrun():
+    """The torch binding's documented mode: one process per rank."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    worker = (
+        "import jax, runpy, sys; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        f"sys.argv = ['torch_mnist.py', '--epochs', '1', "
+        f"'--num-samples', '256']; "
+        f"runpy.run_path({os.path.join(EXAMPLES, 'torch_mnist.py')!r}, "
+        "run_name='__main__')"
+    )
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hvdrun"), "-np", "2",
+         sys.executable, "-c", worker],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+
+
+def test_checkpoint_resume_example(tmp_path):
+    d = str(tmp_path / "ckpts")
+    first = _run_example("checkpoint_resume.py", "--dir", d, "--steps", "6")
+    assert first.returncode == 0, first.stderr
+    second = _run_example("checkpoint_resume.py", "--dir", d, "--steps", "6")
+    assert second.returncode == 0, second.stderr
+    assert "resumed from step" in second.stdout
+
+
+def test_synthetic_benchmark_tiny():
+    result = _run_example(
+        "jax_synthetic_benchmark.py", "--model", "resnet50",
+        "--batch-size", "1", "--num-warmup-batches", "1",
+        "--num-batches-per-iter", "1", "--num-iters", "1", timeout=600)
+    assert result.returncode == 0, result.stderr
+    assert "Img/sec per device" in result.stdout

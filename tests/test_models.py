@@ -1,0 +1,49 @@
+"""Benchmark-model family shape/dtype checks (reference measurement
+vehicles: ResNet-50/101, VGG-16, Inception V3 — ``docs/benchmarks.rst``).
+Forward passes on tiny inputs; the bench drives the full-size versions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models import InceptionV3, ResNet50, ResNet101, VGG16
+
+
+def _forward(model, x, train=False):
+    variables = model.init(jax.random.PRNGKey(0), x, train=train)
+    return model.apply(variables, x, train=train)
+
+
+@pytest.mark.parametrize("cls", [ResNet50, ResNet101])
+def test_resnet_forward(cls):
+    model = cls(num_classes=10, dtype=jnp.float32)
+    out = _forward(model, jnp.ones((2, 64, 64, 3)))
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_vgg16_forward():
+    model = VGG16(num_classes=10, dtype=jnp.float32, classifier_width=64)
+    out = _forward(model, jnp.ones((2, 64, 64, 3)))
+    assert out.shape == (2, 10)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_inception_v3_forward():
+    model = InceptionV3(num_classes=10, dtype=jnp.float32)
+    # 299x299 is the canonical input; 128 keeps the test light while still
+    # hitting every reduction stage
+    out = _forward(model, jnp.ones((1, 128, 128, 3)))
+    assert out.shape == (1, 10)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_models_bf16_params_stay_fp32():
+    model = ResNet50(num_classes=10)  # default dtype bfloat16
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.ones((1, 64, 64, 3)), train=False)
+    leaves = jax.tree.leaves(variables["params"])
+    assert all(leaf.dtype == jnp.float32 for leaf in leaves), \
+        "params must remain fp32 (bf16 is compute dtype only)"

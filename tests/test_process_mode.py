@@ -115,6 +115,36 @@ def test_process_mode_collectives():
     assert result.stdout.count("PROCESS_MODE_OK") == 2
 
 
+def test_many_outstanding_out_of_order_collectives():
+    """32 async allreduces submitted in opposite orders per rank: more
+    outstanding blocking round-trips than any fixed-size pool — a bounded
+    dispatch would deadlock (regression: per-request threads)."""
+    script = (
+        "import os\n"
+        "os.environ.setdefault('XLA_FLAGS',"
+        " '--xla_force_host_platform_device_count=2')\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np, jax.numpy as jnp\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "r = hvd.rank()\n"
+        "names = [f'n{i}' for i in range(32)]\n"
+        "order = names if r == 0 else names[::-1]\n"
+        "handles = {n: hvd.allreduce_async(jnp.ones((4,)), op=hvd.Sum,"
+        " name=n) for n in order}\n"
+        "for n in names:\n"
+        "    out = np.asarray(hvd.synchronize(handles[n]))\n"
+        "    np.testing.assert_allclose(out, np.full((4,), 2.0))\n"
+        "print('OOO_OK', flush=True)\n"
+        "hvd.shutdown()\n"
+    )
+    result = _run_hvdrun(2, script, timeout=300)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert result.stdout.count("OOO_OK") == 2
+
+
 def test_process_mode_worker_failure_kills_job():
     script = (
         "import os, sys\n"
