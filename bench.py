@@ -7,33 +7,64 @@ timed iterations of a synthetic-data training loop).  Baseline for
 1656.82 images/sec on 16 Pascal GPUs => 103.55 img/sec/GPU
 (``docs/benchmarks.rst:31-43``, BASELINE.md).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Also reports (in the same JSON object, under ``extra``):
+  - ``mfu``: model-FLOPs utilization = achieved training FLOPs/s per
+    chip over the chip's peak bf16 FLOPs/s (XLA cost analysis where
+    available, analytic ResNet-50 estimate otherwise).
+  - ``allreduce_gbs``: eager-path ``hvd.allreduce`` algorithmic
+    bandwidth (GB/s) swept over payload sizes 1KB..256MB — the
+    framework-overhead oracle that autotune tunes against (reference:
+    ``docs/benchmarks.rst:31-43``).
+
+Structure: running ``python bench.py`` starts a supervisor that retries
+the actual measurement in a fresh subprocess (``--worker``), because a
+transiently-held TPU poisons the jax backend cache for the whole
+process.  Prints ONE JSON line at the end.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
 
 BASELINE_IMG_SEC_PER_DEVICE = 1656.82 / 16.0
 
+# Peak bf16 matmul FLOPs/s by TPU generation (public spec sheets).
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,  # v6e
+    "v6e": 918e12,
+}
 
-def main():
+# Analytic fallback: ResNet-50 fwd ~4.09 GFLOPs/image @224x224; training
+# (fwd + bwd) ~3x fwd.
+_RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
+
+
+def _peak_flops_per_chip(device):
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return None
+
+
+def _bench_resnet(devices):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-
+    from horovod_tpu.parallel._compat import shard_map
     import horovod_tpu as hvd
     from horovod_tpu.models import ResNet50
     from horovod_tpu.parallel import make_mesh
 
-    devices = jax.devices()
     n = len(devices)
     mesh = make_mesh({"hvd": n}, devices=devices)
 
@@ -83,30 +114,140 @@ def main():
     x = jax.device_put(x_host, sharded)
     y = jax.device_put(y_host, sharded)
 
-    # warmup + compile
+    # XLA's own FLOP count for the compiled step, if the backend
+    # exposes it; analytic estimate otherwise.
+    flops_per_step = None
+    try:
+        cost = step.lower(params, batch_stats, opt_state, x, y) \
+            .compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops_per_step = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    if not flops_per_step:
+        flops_per_step = _RESNET50_TRAIN_FLOPS_PER_IMG * batch
+
+    # device_get of the loss is the synchronization point: it cannot
+    # complete before the step's program has finished on-device.
+    # (block_until_ready alone can return early on relayed backends.)
     for _ in range(3):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, x, y)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
 
     iters = 20
     start = time.perf_counter()
     for _ in range(iters):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, x, y)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     elapsed = time.perf_counter() - start
 
     img_sec = batch * iters / elapsed
     img_sec_per_device = img_sec / n
+
+    mfu = None
+    peak = _peak_flops_per_chip(devices[0])
+    if peak:
+        achieved = flops_per_step * iters / elapsed / n
+        mfu = achieved / peak
+    return img_sec_per_device, mfu
+
+
+def _bench_allreduce_bandwidth():
+    """Eager hvd.allreduce algorithmic bandwidth over a size sweep."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    out = {}
+    sizes = [1 << 10, 1 << 14, 1 << 18, 1 << 22, 1 << 24, 1 << 26,
+             1 << 28]  # 1KB .. 256MB
+    for nbytes in sizes:
+        n_elem = nbytes // 4
+        x = np.ones((n_elem,), np.float32)
+        # warmup; np.asarray forces the full eager round trip.
+        np.asarray(hvd.allreduce(x, name=f"bw_{nbytes}"))
+        iters = 10 if nbytes <= (1 << 22) else 3
+        start = time.perf_counter()
+        for _ in range(iters):
+            np.asarray(hvd.allreduce(x, name=f"bw_{nbytes}"))
+        elapsed = time.perf_counter() - start
+        label = (f"{nbytes // (1 << 20)}MB" if nbytes >= (1 << 20)
+                 else f"{nbytes // (1 << 10)}KB")
+        out[label] = round(nbytes * iters / elapsed / 1e9, 3)
+    return out
+
+
+def worker():
+    import jax
+
+    devices = jax.devices()
+    platform = devices[0].platform
+
+    import horovod_tpu as hvd
+    hvd.init()
+    img_sec_per_device, mfu = _bench_resnet(devices)
+    allreduce_gbs = _bench_allreduce_bandwidth()
+    hvd.shutdown()
+
     print(json.dumps({
         "metric": "resnet50_synthetic_img_sec_per_chip",
         "value": round(img_sec_per_device, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(img_sec_per_device / BASELINE_IMG_SEC_PER_DEVICE,
-                             3),
+        "vs_baseline": round(
+            img_sec_per_device / BASELINE_IMG_SEC_PER_DEVICE, 3),
+        "extra": {
+            "platform": platform,
+            "n_devices": len(devices),
+            "mfu": round(mfu, 4) if mfu is not None else None,
+            "allreduce_gbs": allreduce_gbs,
+        },
     }))
 
 
+def main():
+    """Supervisor: run the worker in fresh subprocesses with retries, so
+    a transiently-unavailable TPU backend doesn't fail the bench."""
+    attempts = 6
+    delay = 30
+    last_out = ""
+    for attempt in range(attempts):
+        env = dict(os.environ)
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(os.path.dirname(
+                           os.path.abspath(__file__)), ".jax_cache"))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, timeout=1800)
+        except subprocess.TimeoutExpired as exc:
+            sys.stderr.write(
+                f"bench attempt {attempt + 1}/{attempts} timed out\n")
+            last_out = (exc.stdout or b"").decode("utf-8", "replace") \
+                if isinstance(exc.stdout, bytes) else (exc.stdout or "")
+            continue
+        last_out = proc.stdout
+        if proc.returncode == 0:
+            for line in reversed(proc.stdout.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{") and line.endswith("}"):
+                    print(line)
+                    return 0
+        sys.stderr.write(
+            f"bench attempt {attempt + 1}/{attempts} failed "
+            f"(rc={proc.returncode}); tail:\n{proc.stdout[-1500:]}\n")
+        if attempt < attempts - 1:
+            time.sleep(delay)
+    sys.stderr.write("bench: all attempts failed\n")
+    sys.stderr.write(last_out[-3000:] + "\n")
+    return 1
+
+
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        sys.exit(main())
