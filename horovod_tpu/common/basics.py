@@ -58,14 +58,40 @@ def init(comm=None, controller=None):
             config.controller = controller
 
         env_topology = topology_mod.from_env()
-        if env_topology is not None and env_topology.size > 1:
+        explicit = (controller or
+                    env_util.get_str(env_util.HVD_CONTROLLER))
+        use_global_mesh = (
+            env_topology is not None and env_topology.size > 1
+            and (env_util.get_bool(env_util.HVD_GLOBAL_MESH)
+                 or explicit == "gmesh"))
+        if use_global_mesh:
+            # pod mode (hvdrun --tpu / --global-mesh): every process joins
+            # one jax.distributed runtime; each chip is a logical rank;
+            # the data plane is compiled XLA collectives over the GLOBAL
+            # mesh (reference: gloo_context.cc:56-73 full-mesh rendezvous,
+            # replaced by the jax coordinator + GSPMD).
+            from horovod_tpu.common import distributed as dist_mod
+            dist_mod.initialize_jax_distributed(
+                env_topology.rank, env_topology.size)
+            local = list(jax.local_devices())
+            devices = sorted(
+                jax.devices(),
+                key=lambda d: (getattr(d, "process_index", 0), d.id))
+            if len(devices) != len(local) * env_topology.size:
+                raise RuntimeError(
+                    f"heterogeneous device counts: {len(devices)} global "
+                    f"devices across {env_topology.size} processes with "
+                    f"{len(local)} local — global-mesh mode requires the "
+                    f"same chip count on every host")
+            topology = topology_mod.from_devices(
+                local, env_topology.rank, env_topology.size)
+            config.controller = "gmesh"
+        elif env_topology is not None and env_topology.size > 1:
             # process-rank mode: collectives go through the TCP controller
             # (the reference's Gloo configuration).  The native/python
             # controllers coordinate a single process's device ranks and
             # cannot span processes — an explicit request for them here is
             # a configuration error, not something to override silently.
-            explicit = (controller or
-                        env_util.get_str(env_util.HVD_CONTROLLER))
             if explicit and explicit != "tcp":
                 raise RuntimeError(
                     f"HVD_CONTROLLER={explicit} cannot coordinate "
@@ -91,7 +117,18 @@ def init(comm=None, controller=None):
 
         timeline = None
         impl = None
-        if config.controller == "tcp":
+        if config.controller == "gmesh":
+            from horovod_tpu.ops.global_controller import \
+                GlobalMeshController
+            # per-process timeline file; rank-0 aggregation via the
+            # launcher-side merge (utils/timeline.py)
+            path = config.timeline_path
+            if path:
+                path = f"{path}.rank{topology.cross_rank}"
+            timeline = Timeline(path, config.timeline_mark_cycles)
+            impl = GlobalMeshController(topology, executor, timeline,
+                                        config)
+        elif config.controller == "tcp":
             from horovod_tpu.ops.tcp_controller import TcpController
             impl = TcpController(topology, executor, None, config)
             timeline = Timeline(None)

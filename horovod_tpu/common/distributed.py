@@ -1,0 +1,104 @@
+"""Multi-host JAX runtime bootstrap.
+
+The reference forms its full process mesh at init from launcher env
+(``horovod/common/gloo/gloo_context.cc:56-73``: HTTP-store rendezvous →
+``connectFullMesh``).  The JAX analog is ``jax.distributed.initialize``:
+process 0 hosts the coordinator, every process connects, and
+``jax.devices()`` then spans the whole job — the global mesh the SPMD
+data plane compiles against.
+
+The coordinator address is published through the launcher's rendezvous
+KV store (same channel the TCP controller uses), so the env contract
+stays exactly the launcher's: ``HVD_RANK``/``HVD_SIZE`` +
+``HVD_RENDEZVOUS_{ADDR,PORT}``.  ``HVD_COORDINATOR_ADDR`` overrides for
+externally-managed jobs.
+"""
+
+import os
+import socket
+
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils.logging import get_logger
+
+JAXDIST_SCOPE = "jaxdist"
+JAXDIST_KEY = "coordinator"
+
+
+def _reserve_port() -> "tuple[socket.socket, int]":
+    """Bind a free port and KEEP the socket open; the caller closes it
+    immediately before handing the port to jax — shrinking the
+    grab-the-port race window from publish-to-initialize down to
+    microseconds (SO_REUSEADDR lets jax rebind while the probe socket is
+    in TIME_WAIT-free close)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("", 0))
+    return s, s.getsockname()[1]
+
+
+def _my_address() -> str:
+    """The address other processes use to reach this process's
+    coordinator (process 0 only)."""
+    iface = os.environ.get(env_util.HVD_IFACE)
+    if iface:
+        from horovod_tpu.run.service import network
+        ip = network.local_interfaces().get(iface)
+        if ip:
+            return ip
+    rendezvous = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR, "")
+    if rendezvous in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def initialize_jax_distributed(process_id: int, num_processes: int) -> None:
+    """Connect this process to the job-wide JAX runtime (idempotent)."""
+    import jax
+
+    if num_processes <= 1:
+        return
+    try:
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "client", None) is not None:
+            return  # already initialized (e.g. by the user)
+    except ImportError:  # pragma: no cover — private module moved
+        pass
+
+    # CPU multi-process collectives need an explicit cross-process
+    # implementation; harmless for TPU jobs (per-platform setting).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):  # pragma: no cover — older jax
+        pass
+
+    coordinator = os.environ.get(env_util.HVD_COORDINATOR_ADDR)
+    reserved = None
+    if not coordinator:
+        addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
+        port = os.environ.get(env_util.HVD_RENDEZVOUS_PORT)
+        if addr is None:
+            raise RuntimeError(
+                "global-mesh mode needs HVD_COORDINATOR_ADDR or the "
+                "hvdrun rendezvous env contract to agree on the jax "
+                "coordinator address")
+        from horovod_tpu.run import http_client
+        if process_id == 0:
+            reserved, cport = _reserve_port()
+            coordinator = f"{_my_address()}:{cport}"
+            http_client.put(addr, int(port), JAXDIST_SCOPE, JAXDIST_KEY,
+                            coordinator.encode())
+        else:
+            coordinator = http_client.get(addr, int(port), JAXDIST_SCOPE,
+                                          JAXDIST_KEY, timeout=120).decode()
+
+    get_logger().debug(
+        "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+        coordinator, num_processes, process_id)
+    if reserved is not None:
+        reserved.close()
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)

@@ -43,13 +43,13 @@ def _require_rank_context(state, name):
     other ranks' submissions.  Fail fast with directions instead
     (reference analog: hanging negotiation is what the StallInspector
     exists to flag)."""
-    if (state.config.controller != "tcp" and state.topology.size > 1
+    if (state.config.controller != "tcp" and state.topology.local_size > 1
             and getattr(basics._tls, "local_rank", None) is None):
         raise RuntimeError(
             f"eager collective '{name}' called from the main thread in "
-            f"single-process device-rank mode (size="
-            f"{state.topology.size}): each logical rank needs its own "
-            f"context. Use horovod_tpu.common.basics.run_parallel(fn), "
+            f"device-rank mode (local_size="
+            f"{state.topology.local_size}): each logical rank needs its "
+            f"own context. Use horovod_tpu.common.basics.run_parallel(fn), "
             f"launch one process per rank with hvdrun, or use the SPMD "
             f"API (DistributedOptimizer inside shard_map)")
 
@@ -58,7 +58,10 @@ def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
             prescale_factor=1.0, postscale_factor=1.0, splits=None) -> Handle:
     state = basics._get_state()
     _require_rank_context(state, name)
-    committed = state.executor.commit(tensor, basics.local_rank()) \
+    # rank indexes the executor's device list (global in gmesh mode, local
+    # otherwise; commit wraps for process-rank mode where size can exceed
+    # the addressable device count)
+    committed = state.executor.commit(tensor, basics.rank()) \
         if tensor is not None else None
     handle = Handle(name)
     state.controller.enqueue(EagerRequest(

@@ -1,0 +1,641 @@
+"""Global-mesh controller: the TPU-pod data plane.
+
+Multi-process (``hvdrun --tpu`` / ``--global-mesh``) coordination where
+the wire carries **metadata only** and every byte of tensor data moves as
+compiled XLA collectives over the global ``jax.distributed`` device mesh
+(ICI within a slice, DCN across hosts).  This is the reference's
+negotiate-then-execute split (``controller.cc:62`` ComputeResponseList →
+backend op) rebuilt for multi-controller JAX:
+
+- Each process runs a local coordination loop for the device ranks it
+  hosts (same table as :class:`PythonController`).
+- When all local ranks have submitted a name, the process reports the
+  name's *metadata* (shape/dtype/op/... — never the payload) to the
+  rank-0 coordinator service (HMAC TCP, reference: gloo controller's
+  gather to rank 0).
+- The coordinator validates cross-process agreement, fuses compatible
+  allreduces (``controller.cc:640`` FuseResponses), assigns each fused
+  response a **global sequence number**, and long-polls it back to every
+  process (reference: response-list broadcast).
+- Every process executes the response log in sequence order, so all
+  processes issue identical XLA programs in identical order — the
+  multi-controller SPMD contract.  The per-signature compiled-program
+  cache in :class:`XlaExecutor` plays the reference's ResponseCache role.
+
+This replaces round 1's TCP data plane (rank-0 star shipping numpy
+payloads) for pod jobs: the coordinator round-trip is O(names), not
+O(bytes).
+"""
+
+import base64
+import os
+import threading
+import time
+
+import numpy as np
+
+from horovod_tpu.common.ops_enum import ReduceOp, RequestType
+from horovod_tpu.ops.python_controller import GroupEntry, PythonController
+from horovod_tpu.run.service import network
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils.logging import get_logger
+
+GMESH_SCOPE = "gmesh"
+GMESH_KEY = "addr"
+POLL_WAIT_S = 0.2
+
+
+# ------------------------------------------------------------------ messages
+class MetaReq:
+    """One name's metadata from one process (payload-free)."""
+
+    __slots__ = ("name", "req_type", "op", "dtype", "shape", "dims0",
+                 "splits", "root_rank", "prescale", "postscale", "ranks")
+
+    def __init__(self, name, req_type, op, dtype, shape, dims0, splits,
+                 root_rank, prescale, postscale, ranks):
+        self.name = name
+        self.req_type = int(req_type)
+        self.op = int(op)
+        self.dtype = dtype            # numpy dtype string
+        self.shape = tuple(shape)
+        self.dims0 = dims0            # {rank: dim0} for allgather
+        self.splits = splits          # {rank: [..]} for alltoall
+        self.root_rank = root_rank
+        self.prescale = prescale
+        self.postscale = postscale
+        self.ranks = tuple(ranks)     # local ranks that submitted
+
+
+class CycleMsg:
+    __slots__ = ("pid", "reqs", "joined", "last_seq")
+
+    def __init__(self, pid, reqs, joined, last_seq):
+        self.pid = pid
+        self.reqs = reqs
+        self.joined = tuple(joined)
+        self.last_seq = last_seq
+
+
+class LogEntry:
+    """One globally-ordered response (possibly a fused allreduce bucket)."""
+
+    __slots__ = ("seq", "kind", "req_type", "names", "shapes", "dtype",
+                 "op", "prescale", "postscale", "root_rank", "all_dims0",
+                 "splits_matrix", "error", "last_rank", "joined")
+
+    def __init__(self, seq, kind, req_type=None, names=(), shapes=(),
+                 dtype=None, op=0, prescale=1.0, postscale=1.0,
+                 root_rank=-1, all_dims0=None, splits_matrix=None,
+                 error=None, last_rank=-1, joined=()):
+        self.seq = seq
+        self.kind = kind              # "group" | "error" | "join_done"
+        self.req_type = req_type
+        self.names = tuple(names)
+        self.shapes = tuple(tuple(s) for s in shapes)
+        self.dtype = dtype
+        self.op = op
+        self.prescale = prescale
+        self.postscale = postscale
+        self.root_rank = root_rank
+        self.all_dims0 = all_dims0
+        self.splits_matrix = splits_matrix
+        self.error = error
+        self.last_rank = last_rank
+        self.joined = tuple(joined)   # global joined snapshot at emit time
+
+
+class CycleResp:
+    __slots__ = ("entries",)
+
+    def __init__(self, entries):
+        self.entries = entries
+
+
+class _GlobalName:
+    __slots__ = ("first_ts", "reqs", "stall_warned")
+
+    def __init__(self):
+        self.first_ts = time.monotonic()
+        self.reqs = {}   # pid -> MetaReq
+        self.stall_warned = False
+
+
+# ---------------------------------------------------------------- coordinator
+class MetaCoordinatorService(network.BasicService):
+    """Rank-0 process's metadata coordinator (reference: rank 0 in
+    ComputeResponseList — gathers requests, validates, fuses, broadcasts
+    the ordered response list)."""
+
+    NAME = "horovod_tpu gmesh coordinator"
+
+    def __init__(self, num_processes, local_sizes, key, fusion_threshold,
+                 stall_warning_sec=60.0, stall_shutdown_sec=0.0):
+        self._nproc = num_processes
+        self._local_sizes = local_sizes      # ranks per process
+        self._rank_pid = {}
+        base = 0
+        for pid, ls in enumerate(local_sizes):
+            for r in range(base, base + ls):
+                self._rank_pid[r] = pid
+            base += ls
+        self._world = base
+        self._fusion_threshold = fusion_threshold
+        self._stall_warning = stall_warning_sec
+        self._stall_shutdown = stall_shutdown_sec
+        self._cv = threading.Condition()
+        self._table = {}                 # name -> _GlobalName (ordered)
+        self._joined = set()             # global ranks
+        self._join_order = []            # coordinator-serialized arrivals
+        self._log_entries = []
+        self._acked = {}                 # pid -> highest seq acknowledged
+        self._seq = 0
+        self._log = get_logger()
+        super().__init__(self.NAME, key)
+
+    # ------------------------------------------------------------- protocol
+    def _handle(self, req, client_address):
+        if isinstance(req, CycleMsg):
+            return self._handle_cycle(req)
+        return super()._handle(req, client_address)
+
+    def _required_pids(self):
+        """Processes that still host at least one non-joined rank."""
+        out = set()
+        base = 0
+        for pid, ls in enumerate(self._local_sizes):
+            if any(r not in self._joined for r in range(base, base + ls)):
+                out.add(pid)
+            base += ls
+        return out
+
+    def _handle_cycle(self, msg):
+        with self._cv:
+            self._acked[msg.pid] = max(self._acked.get(msg.pid, 0),
+                                       msg.last_seq)
+            self._trim_log()
+            for r in msg.joined:
+                if r not in self._joined:
+                    self._joined.add(r)
+                    self._join_order.append(r)
+            for req in msg.reqs:
+                entry = self._table.get(req.name)
+                if entry is None:
+                    entry = _GlobalName()
+                    self._table[req.name] = entry
+                entry.reqs[msg.pid] = req
+            self._advance()
+            self._check_stalls()
+            entries = [e for e in self._log_entries if e.seq > msg.last_seq]
+            if entries:
+                return CycleResp(entries)
+        # long-poll outside the lock-held fast path
+        deadline = time.monotonic() + POLL_WAIT_S
+        with self._cv:
+            while True:
+                entries = [e for e in self._log_entries
+                           if e.seq > msg.last_seq]
+                if entries:
+                    return CycleResp(entries)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._check_stalls()
+                    return CycleResp([])
+                self._cv.wait(timeout=remaining)
+
+    # ------------------------------------------------------- response build
+    def _advance(self):
+        """Emit log entries for names every required process reported.
+        Caller holds the lock."""
+        required = self._required_pids()
+        ready = [(name, entry) for name, entry in self._table.items()
+                 if required.issubset(entry.reqs.keys())]
+        if not ready and not self._join_done_ready():
+            return
+
+        bucket = []          # (name, MetaReq-first) accumulated allreduces
+        bucket_bytes = 0
+        bucket_key = None
+
+        def flush():
+            nonlocal bucket, bucket_bytes, bucket_key
+            if bucket:
+                first = bucket[0][1]
+                self._emit(LogEntry(
+                    self._next_seq(), "group",
+                    req_type=int(RequestType.ALLREDUCE),
+                    names=[n for n, _ in bucket],
+                    shapes=[m.shape for _, m in bucket],
+                    dtype=first.dtype, op=first.op,
+                    prescale=first.prescale, postscale=first.postscale,
+                    joined=sorted(self._joined)))
+                bucket, bucket_bytes, bucket_key = [], 0, None
+
+        for name, entry in ready:
+            del self._table[name]
+            err, meta = self._validate(name, entry)
+            if err is not None:
+                flush()
+                self._emit(LogEntry(self._next_seq(), "error",
+                                    names=[name], error=err))
+                continue
+            rtype = RequestType(meta["req_type"])
+            if rtype == RequestType.ALLREDUCE:
+                nbytes = (np.dtype(meta["dtype"]).itemsize *
+                          int(np.prod(meta["shape"] or (1,))))
+                key = (meta["dtype"], meta["op"], meta["prescale"],
+                       meta["postscale"])
+                if bucket and (key != bucket_key or
+                               bucket_bytes + nbytes
+                               > self._fusion_threshold):
+                    flush()
+                first = next(iter(entry.reqs.values()))
+                bucket.append((name, first))
+                bucket_key = key
+                bucket_bytes += nbytes
+            else:
+                flush()
+                self._emit(LogEntry(
+                    self._next_seq(), "group", req_type=int(rtype),
+                    names=[name], shapes=[meta["shape"]],
+                    dtype=meta["dtype"], op=meta["op"],
+                    prescale=meta["prescale"], postscale=meta["postscale"],
+                    root_rank=meta["root_rank"],
+                    all_dims0=meta.get("all_dims0"),
+                    splits_matrix=meta.get("splits_matrix"),
+                    joined=sorted(self._joined)))
+        flush()
+        self._maybe_emit_join_done()
+
+    def _join_done_ready(self):
+        return (self._joined and len(self._joined) == self._world
+                and not self._table)
+
+    def _maybe_emit_join_done(self):
+        if self._join_done_ready():
+            # the last rank to join in coordinator-arrival order
+            # (reference: join() returns the last joining rank so it can
+            # seed a broadcast from the most-advanced worker)
+            last = self._join_order[-1]
+            self._emit(LogEntry(self._next_seq(), "join_done",
+                                last_rank=last))
+            self._joined.clear()
+            self._join_order.clear()
+
+    def _next_seq(self):
+        self._seq += 1
+        return self._seq
+
+    def _emit(self, entry):
+        self._log_entries.append(entry)
+        self._cv.notify_all()
+
+    def _trim_log(self):
+        """Drop entries every process has acknowledged (via CycleMsg
+        last_seq) — never an entry some process hasn't fetched yet."""
+        if len(self._log_entries) < 1024 or len(self._acked) < self._nproc:
+            return
+        floor = min(self._acked.values())
+        self._log_entries = [e for e in self._log_entries if e.seq > floor]
+
+    # ------------------------------------------------------------ validation
+    def _validate(self, name, entry):
+        """Cross-process agreement (reference: ConstructResponse,
+        controller.cc:378).  Returns (error, meta)."""
+        reqs = list(entry.reqs.values())
+        first = reqs[0]
+
+        if any(r.req_type != first.req_type for r in reqs):
+            return (f"mismatched collective types for tensor '{name}'",
+                    None)
+        if any(r.dtype != first.dtype for r in reqs):
+            return (f"mismatched dtypes for tensor '{name}'", None)
+        rtype = RequestType(first.req_type)
+
+        if self._joined and rtype in (RequestType.ALLGATHER,
+                                      RequestType.BROADCAST,
+                                      RequestType.ALLTOALL):
+            return (f"{rtype.name} is not supported while ranks have "
+                    f"joined", None)
+
+        meta = {"req_type": first.req_type, "dtype": first.dtype,
+                "op": first.op, "prescale": first.prescale,
+                "postscale": first.postscale, "root_rank": first.root_rank,
+                "shape": first.shape}
+
+        if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM):
+            if any(r.shape != first.shape for r in reqs):
+                return (f"mismatched shapes for allreduce '{name}'", None)
+            if any(r.op != first.op or r.prescale != first.prescale
+                   or r.postscale != first.postscale for r in reqs):
+                return (f"mismatched reduce ops or scale factors for "
+                        f"tensor '{name}'", None)
+        elif rtype == RequestType.ALLGATHER:
+            trailing = {tuple(r.shape[1:]) for r in reqs}
+            if len(trailing) > 1:
+                return (f"mismatched trailing dimensions for allgather "
+                        f"'{name}'", None)
+            if any(not r.shape for r in reqs):
+                return (f"allgather '{name}': 0-d tensors are not "
+                        f"supported; reshape to (1,) first", None)
+            dims = {}
+            for r in reqs:
+                dims.update(r.dims0 or {})
+            missing = [r for r in range(self._world)
+                       if r not in dims and r not in self._joined]
+            if missing:
+                return (f"allgather '{name}': missing first-dim info for "
+                        f"ranks {missing}", None)
+            meta["all_dims0"] = [int(dims.get(r, 0))
+                                 for r in range(self._world)]
+        elif rtype == RequestType.BROADCAST:
+            if any(r.root_rank != first.root_rank for r in reqs):
+                return (f"mismatched root ranks for broadcast '{name}'",
+                        None)
+            if any(r.shape != first.shape for r in reqs):
+                return (f"mismatched shapes for broadcast '{name}'", None)
+            root_pid = self._rank_pid.get(first.root_rank)
+            if root_pid is None or first.root_rank not in \
+                    entry.reqs[root_pid].ranks:
+                return (f"broadcast '{name}': root rank "
+                        f"{first.root_rank} did not participate", None)
+        elif rtype == RequestType.ALLTOALL:
+            splits = {}
+            for r in reqs:
+                splits.update(r.splits or {})
+            missing = [r for r in range(self._world) if r not in splits]
+            if missing:
+                return (f"alltoall '{name}': missing splits for ranks "
+                        f"{missing}", None)
+            dims = {}
+            for r in reqs:
+                dims.update(r.dims0 or {})
+            for r, row in splits.items():
+                if len(row) != self._world:
+                    return (f"alltoall '{name}': splits must have one "
+                            f"entry per rank ({self._world})", None)
+                if r in dims and sum(row) != dims[r]:
+                    return (f"alltoall '{name}': splits sum {sum(row)} "
+                            f"!= first dimension {dims[r]} on rank {r}",
+                            None)
+            meta["splits_matrix"] = [list(splits[r])
+                                     for r in range(self._world)]
+        return (None, meta)
+
+    # ----------------------------------------------------------------- stall
+    def _check_stalls(self):
+        """Caller holds the lock (reference: StallInspector on rank 0)."""
+        now = time.monotonic()
+        for name, entry in list(self._table.items()):
+            age = now - entry.first_ts
+            if age > self._stall_warning and not entry.stall_warned:
+                waiting = sorted(set(range(self._nproc))
+                                 - set(entry.reqs.keys()))
+                self._log.warning(
+                    "Stalled tensor: %s reported by processes %s, waiting "
+                    "on processes %s for more than %ds", name,
+                    sorted(entry.reqs.keys()), waiting,
+                    int(self._stall_warning))
+                entry.stall_warned = True
+            if self._stall_shutdown > 0 and age > self._stall_shutdown:
+                del self._table[name]
+                self._emit(LogEntry(
+                    self._next_seq(), "error", names=[name],
+                    error=(f"stalled tensor '{name}' exceeded shutdown "
+                           f"threshold of {self._stall_shutdown}s")))
+
+
+# ----------------------------------------------------------------- controller
+class GlobalMeshController(PythonController):
+    """Per-process controller for global-mesh (pod) jobs.
+
+    Local device ranks negotiate in-process exactly like the single-host
+    :class:`PythonController`; globally-ready work is discovered through
+    the metadata coordinator and executed in coordinator-assigned
+    sequence order by every process."""
+
+    def __init__(self, topology, executor, timeline, config):
+        super().__init__(topology, executor, timeline, config)
+        self._pid = topology.cross_rank
+        self._nproc = topology.cross_size
+        self._local_size = topology.local_size
+        base = self._pid * self._local_size
+        self._local_rank_set = set(range(base, base + self._local_size))
+        self._reported = set()
+        self._joined_reported = set()
+        self._last_seq = 0
+        self._coordinator = None
+        self._client_addrs = None
+        self._client_obj = None
+        self._key = None
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self):
+        key_b64 = os.environ.get(env_util.HVD_SECRET_KEY)
+        if key_b64:
+            self._key = base64.b64decode(key_b64)
+        else:
+            import hashlib
+            seed = (os.environ.get(env_util.HVD_RENDEZVOUS_ADDR, "local") +
+                    os.environ.get(env_util.HVD_RENDEZVOUS_PORT, "0"))
+            self._key = hashlib.sha256(seed.encode()).digest()
+
+        addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
+        port = os.environ.get(env_util.HVD_RENDEZVOUS_PORT)
+        from horovod_tpu.run import http_client
+        if self._pid == 0:
+            self._coordinator = MetaCoordinatorService(
+                self._nproc,
+                [self._local_size] * self._nproc,
+                self._key,
+                self._config.fusion_threshold_bytes,
+                stall_warning_sec=self._config.stall_warning_seconds,
+                stall_shutdown_sec=self._config.stall_shutdown_seconds)
+            tagged = [(iface, ip, self._coordinator.port)
+                      for iface, ip in network.local_interfaces().items()]
+            tagged.append(("lo", "127.0.0.1", self._coordinator.port))
+            if addr is not None:
+                http_client.put(
+                    addr, int(port), GMESH_SCOPE, GMESH_KEY,
+                    ";".join(f"{i}={ip}:{p}"
+                             for i, ip, p in tagged).encode())
+            self._client_addrs = self._filter_ifaces(tagged)
+        else:
+            if addr is None:
+                raise RuntimeError(
+                    "global-mesh mode requires the rendezvous env "
+                    "contract (launch with hvdrun)")
+            blob = http_client.get(addr, int(port), GMESH_SCOPE,
+                                   GMESH_KEY, timeout=120).decode()
+            tagged = []
+            for part in blob.split(";"):
+                iface, rest = part.split("=", 1)
+                ip, p = rest.rsplit(":", 1)
+                tagged.append((iface, ip, int(p)))
+            self._client_addrs = self._filter_ifaces(tagged)
+        super().start()
+
+    @staticmethod
+    def _filter_ifaces(tagged):
+        iface = os.environ.get(env_util.HVD_IFACE)
+        pinned = [(ip, p) for i, ip, p in tagged if i == iface]
+        return pinned or [(ip, p) for _, ip, p in tagged]
+
+    def _client(self):
+        # one long-lived client: only the coordination-loop thread uses
+        # it, and reusing the instance keeps the learned-good address
+        # instead of re-probing the advertised NIC list every cycle
+        if self._client_obj is None:
+            self._client_obj = network.BasicClient(
+                self._client_addrs, self._key, timeout=30,
+                read_timeout=None)
+        return self._client_obj
+
+    def shutdown(self):
+        super().shutdown()
+        if self._coordinator is not None:
+            self._coordinator.shutdown()
+            self._coordinator = None
+
+    # --------------------------------------------------------- the wire cycle
+    def _run_cycle(self, pending):
+        with self._lock:
+            self._joined_view = set(self._joined)
+
+        self._absorb(pending)
+        if not self._config.stall_check_disable:
+            self._check_local_stalls()
+
+        # names whose local ranks have all contributed -> report metadata
+        needed_local = self._local_rank_set - self._joined_view
+        new_reqs = []
+        for name, entry in self._table.items():
+            if name in self._reported:
+                continue
+            if needed_local and not needed_local.issubset(
+                    entry.requests.keys()):
+                continue
+            new_reqs.append(self._meta_for(name, entry))
+            self._reported.add(name)
+
+        newly_joined = sorted(self._joined_view - self._joined_reported)
+
+        with self._lock:
+            join_outstanding = bool(self._join_handles)
+        if not (new_reqs or newly_joined or self._reported
+                or join_outstanding):
+            return
+
+        msg = CycleMsg(self._pid, new_reqs, newly_joined, self._last_seq)
+        resp = self._client().send(msg)
+        self._joined_reported.update(newly_joined)
+
+        for entry in resp.entries:
+            self._apply(entry)
+            self._last_seq = entry.seq
+
+        # keep polling while work is outstanding
+        with self._lock:
+            join_outstanding = bool(self._join_handles)
+        if self._reported or join_outstanding:
+            self._wakeup.set()
+
+    def _meta_for(self, name, entry):
+        reqs = entry.requests
+        first = next(iter(reqs.values()))
+        shape = tuple(first.tensor.shape) if first.tensor is not None else ()
+        dtype = (np.dtype(first.tensor.dtype).name
+                 if first.tensor is not None else "float32")
+        dims0 = {rank: (r.tensor.shape[0] if r.tensor is not None
+                        and r.tensor.ndim else 0)
+                 for rank, r in reqs.items()}
+        splits = {rank: list(r.splits) for rank, r in reqs.items()
+                  if r.splits is not None}
+        return MetaReq(
+            name=name, req_type=first.req_type, op=first.op, dtype=dtype,
+            shape=shape, dims0=dims0, splits=splits,
+            root_rank=first.root_rank, prescale=first.prescale_factor,
+            postscale=first.postscale_factor, ranks=sorted(reqs.keys()))
+
+    # ------------------------------------------------------------- execution
+    def _apply(self, entry):
+        if entry.kind == "error":
+            for name in entry.names:
+                local = self._table.pop(name, None)
+                self._reported.discard(name)
+                if local is not None:
+                    for request in local.requests.values():
+                        request.handle.set_error(entry.error)
+            return
+
+        if entry.kind == "join_done":
+            with self._lock:
+                for handle in self._join_handles.values():
+                    handle.set_result(entry.last_rank)
+                self._join_handles.clear()
+                self._joined.clear()
+            self._joined_reported.clear()
+            self._joined_view = set()
+            return
+
+        rtype = RequestType(entry.req_type)
+        joined_global = set(entry.joined)
+        groups = []
+        for name, shape in zip(entry.names, entry.shapes):
+            local = self._table.pop(name, None)
+            self._reported.discard(name)
+            requests = local.requests if local is not None else {}
+            tensors = {rank: r.tensor for rank, r in requests.items()}
+            for rank in self._local_rank_set:
+                if rank in joined_global or rank not in tensors:
+                    tensors.setdefault(rank, None)
+            groups.append(GroupEntry(
+                name=name, shape=tuple(shape), dtype=np.dtype(entry.dtype),
+                tensors=tensors,
+                handles={rank: r.handle for rank, r in requests.items()},
+                root_rank=entry.root_rank,
+                splits=(entry.splits_matrix
+                        if entry.splits_matrix is not None else None),
+                op=ReduceOp(entry.op), prescale_factor=entry.prescale,
+                postscale_factor=entry.postscale,
+                all_dims0=entry.all_dims0))
+            self._timeline.end(name)
+
+        def fail(exc):
+            self._log.error("collective execution failed: %s", exc)
+            for g in groups:
+                for handle in g.handles.values():
+                    handle.set_error(f"collective execution failed: {exc}")
+
+        try:
+            if rtype == RequestType.ALLREDUCE:
+                first = groups[0]
+                self._timeline_begin_groups(groups, "ALLREDUCE")
+                self._executor.allreduce_fused(
+                    groups, op=first.op,
+                    prescale_factor=first.prescale_factor,
+                    postscale_factor=first.postscale_factor)
+                self._timeline_end_groups(groups)
+            else:
+                self._execute_single(rtype, groups[0])
+        except Exception as exc:  # noqa: BLE001 — surface on handles
+            fail(exc)
+
+    # ------------------------------------------------------------------ stall
+    def _check_local_stalls(self):
+        """Warn about names stuck waiting on LOCAL ranks (pre-report);
+        once reported, the coordinator owns stall handling."""
+        now = time.monotonic()
+        warn_after = self._config.stall_warning_seconds
+        for name, entry in list(self._table.items()):
+            if name in self._reported:
+                continue
+            age = now - entry.first_ts
+            if age > warn_after and not entry.stall_warned:
+                ready = sorted(entry.requests.keys())
+                missing = sorted(self._local_rank_set - set(ready)
+                                 - self._joined_view)
+                self._log.warning(
+                    "Tensor %s waiting on local ranks %s (ready: %s) for "
+                    "more than %ds", name, missing, ready, int(warn_after))
+                entry.stall_warned = True

@@ -59,11 +59,12 @@ class GroupEntry:
     work (reference: TensorTableEntry, common.h:233-250)."""
 
     __slots__ = ("name", "shape", "dtype", "tensors", "handles", "root_rank",
-                 "splits", "op", "prescale_factor", "postscale_factor")
+                 "splits", "op", "prescale_factor", "postscale_factor",
+                 "all_dims0")
 
     def __init__(self, name, shape, dtype, tensors, handles, root_rank=-1,
                  splits=None, op=ReduceOp.SUM, prescale_factor=1.0,
-                 postscale_factor=1.0):
+                 postscale_factor=1.0, all_dims0=None):
         self.name = name
         self.shape = shape
         self.dtype = dtype
@@ -74,6 +75,7 @@ class GroupEntry:
         self.op = op
         self.prescale_factor = prescale_factor
         self.postscale_factor = postscale_factor
+        self.all_dims0 = all_dims0
 
 
 class PythonController:
@@ -158,13 +160,9 @@ class PythonController:
                 request.handle.set_error(message)
         self._table.clear()
 
-    def _run_cycle(self, pending):
-        # snapshot joined state once per cycle (rank threads mutate it under
-        # the lock; iterating the live set would race)
-        with self._lock:
-            self._joined_view = set(self._joined)
-
-        # 1. absorb new requests into the message table
+    def _absorb(self, pending):
+        """Absorb new requests into the message table (reference:
+        TensorQueue pop + table insert)."""
         for request in pending:
             entry = self._table.get(request.name)
             if entry is None:
@@ -179,6 +177,15 @@ class PythonController:
                 continue
             entry.requests[request.rank] = request
             self._timeline.instant(request.name, f"{request.rank}")
+
+    def _run_cycle(self, pending):
+        # snapshot joined state once per cycle (rank threads mutate it under
+        # the lock; iterating the live set would race)
+        with self._lock:
+            self._joined_view = set(self._joined)
+
+        # 1. absorb new requests into the message table
+        self._absorb(pending)
 
         # 2. stall inspection
         if not self._config.stall_check_disable:

@@ -71,10 +71,20 @@ class XlaExecutor:
         self.num_ranks = len(self.devices)
         self.mesh = Mesh(np.array(self.devices), (AXIS,))
         self._sharded = NamedSharding(self.mesh, P(AXIS))
+        # Multi-process (global-mesh) support: this process only produces
+        # and consumes the shards that live on its own devices; the
+        # compiled program spans the full mesh (reference analog: each
+        # worker contributes its ranks' buffers, NCCL moves the bytes).
+        my_pid = jax.process_index()
+        self.local_ranks = [
+            i for i, d in enumerate(self.devices)
+            if getattr(d, "process_index", my_pid) == my_pid]
+        self.multiprocess = len(self.local_ranks) != self.num_ranks
         # caches are touched only from the coordinator thread
         self._fuse_in_cache = {}
         self._allreduce_cache = {}
         self._allgather_cache = {}
+        self._alltoall_cache = {}
 
         # Two-level (cross, local) mesh for hierarchical collectives
         # (reference: NCCLHierarchicalAllreduce intra-node/inter-node split,
@@ -125,7 +135,7 @@ class XlaExecutor:
     # ------------------------------------------------------------------ utils
     def commit(self, tensor, rank):
         """Pin a rank's tensor to its device (no-op if already there)."""
-        dev = self.devices[rank]
+        dev = self.devices[rank % self.num_ranks]
         if isinstance(tensor, jax.Array):
             try:
                 if tensor.devices() == {dev}:
@@ -143,6 +153,8 @@ class XlaExecutor:
         raise RuntimeError(f"no addressable shard on {dev}")
 
     def _stack(self, per_rank_bufs, shard_shape, dtype):
+        """Assemble the mesh-sharded fusion buffer from this process's
+        per-rank shards (``per_rank_bufs``: list in local-rank order)."""
         global_shape = (self.num_ranks,) + tuple(shard_shape[1:])
         return jax.make_array_from_single_device_arrays(
             global_shape, self._sharded, per_rank_bufs)
@@ -181,7 +193,7 @@ class XlaExecutor:
         dtype = entries[0].dtype
 
         bufs = []
-        for rank in range(self.num_ranks):
+        for rank in self.local_ranks:
             tensors = [e.tensors.get(rank) for e in entries]
             if any(t is None for t in tensors):
                 bufs.append(self._zeros_buf(total, dtype, rank))
@@ -258,16 +270,22 @@ class XlaExecutor:
         controller.cc:453-518 computes recvcounts/displacements; here the
         compiled program pads to max(dim0), all-gathers over the mesh and
         concatenates the valid rows)."""
-        shapes = tuple(tuple(entry.tensors[r].shape)
-                       for r in range(self.num_ranks))
         dtype = entry.dtype
-        dims0 = [s[0] if s else 1 for s in shapes]
-        rest = shapes[0][1:]
+        if getattr(entry, "all_dims0", None) is not None:
+            # multi-process: per-rank first dims were negotiated globally
+            dims0 = [int(d) for d in entry.all_dims0]
+            some_local = entry.tensors[self.local_ranks[0]]
+            rest = tuple(some_local.shape[1:])
+        else:
+            shapes_all = tuple(tuple(entry.tensors[r].shape)
+                               for r in range(self.num_ranks))
+            dims0 = [s[0] if s else 1 for s in shapes_all]
+            rest = shapes_all[0][1:]
         max0 = max(dims0)
 
         hierarchical = bool(self.hierarchical_allgather
                             and self.hier_mesh is not None)
-        key = (shapes, np.dtype(dtype).name, hierarchical)
+        key = (tuple(dims0), rest, np.dtype(dtype).name, hierarchical)
         fn = self._allgather_cache.get(key)
         if fn is None:
             def pad(t, n0=max0):
@@ -305,7 +323,7 @@ class XlaExecutor:
             self._allgather_cache[key] = fn
 
         pad_fn, gather_fn = fn
-        bufs = [pad_fn(entry.tensors[r]) for r in range(self.num_ranks)]
+        bufs = [pad_fn(entry.tensors[r]) for r in self.local_ranks]
         garr = self._stack(bufs, (1, max0) + rest, dtype)
         out = gather_fn(garr)
         for rank, handle in entry.handles.items():
@@ -313,12 +331,54 @@ class XlaExecutor:
 
     # -------------------------------------------------------------- broadcast
     def broadcast(self, entry):
-        """Replicate the root rank's tensor to every rank's device via an XLA
-        transfer (reference: MPIBroadcast / NCCLBroadcast)."""
-        src = entry.tensors[entry.root_rank]
-        replicated = jax.device_put(src, NamedSharding(self.mesh, P()))
+        """Replicate the root rank's tensor to every rank's device
+        (reference: MPIBroadcast / NCCLBroadcast).
+
+        Single-process: direct XLA replication transfer.  Multi-process:
+        one compiled program — non-root ranks contribute zero rows to the
+        mesh-stacked buffer and a ``psum`` over the rank axis materializes
+        the root's data everywhere (data rides ICI/DCN collectives, never
+        the host control plane)."""
+        if not self.multiprocess:
+            src = entry.tensors[entry.root_rank]
+            replicated = jax.device_put(src, NamedSharding(self.mesh, P()))
+            for rank, handle in entry.handles.items():
+                handle.set_result(self._shard_for(replicated, rank))
+            return
+
+        shape = tuple(entry.shape)
+        total = _prod(shape)
+        dtype = entry.dtype
+        bufs = []
+        for rank in self.local_ranks:
+            if rank == entry.root_rank:
+                bufs.append(self._fuse_in([entry.tensors[rank]], [total],
+                                          dtype))
+            else:
+                bufs.append(self._zeros_buf(total, dtype, rank))
+        garr = self._stack(bufs, (1, total), dtype)
+
+        key = ("broadcast", shape, np.dtype(dtype).name)
+        fn = self._allreduce_cache.get(key)
+        if fn is None:
+            def fused(g):
+                def body(shard):
+                    x = shard
+                    # pred/int psum: sum of one real row + zeros is exact
+                    if x.dtype == jnp.bool_:
+                        x = x.astype(jnp.uint8)
+                    out = jax.lax.psum(x, AXIS)
+                    return out.astype(shard.dtype)
+                red = _shard_map(body, mesh=self.mesh,
+                                 in_specs=P(AXIS), out_specs=P())(g)
+                return red.reshape(shape)
+
+            fn = jax.jit(fused, donate_argnums=0)
+            self._allreduce_cache[key] = fn
+
+        out = fn(garr)
         for rank, handle in entry.handles.items():
-            handle.set_result(self._shard_for(replicated, rank))
+            handle.set_result(self._shard_for(out, rank))
 
     # ----------------------------------------------------------------- adasum
     def adasum(self, entry):
@@ -333,7 +393,7 @@ class XlaExecutor:
         total = _prod(shape)
         dtype = entry.dtype
         bufs = []
-        for rank in range(self.num_ranks):
+        for rank in self.local_ranks:
             t = entry.tensors.get(rank)
             if t is None:
                 bufs.append(self._zeros_buf(total, dtype, rank))
@@ -379,30 +439,80 @@ class XlaExecutor:
 
     # --------------------------------------------------------------- alltoall
     def alltoall(self, entry):
-        """Variable-split all-to-all (API parity with later reference
-        versions; also the Ulysses sequence-parallel primitive).
+        """Variable-split all-to-all as ONE compiled XLA program (API
+        parity with later reference versions; also the Ulysses
+        sequence-parallel primitive).
 
-        Host-orchestrated v1: splits differ per rank so there is no single
-        static program; each destination concatenates its segments on its own
-        device.
+        Each rank pads its per-destination segments to the global max
+        split, the compiled program runs ``lax.all_to_all`` over the mesh
+        axis, and a second compiled program (keyed by the negotiated
+        receive splits) slices out the valid rows — the same pad/slice
+        trick the variable-dim allgather uses.  Replaces the round-1
+        host-orchestrated per-destination ``device_put`` loop.  Sizing
+        logic mirrors ``controller.cc:453-518`` recvcounts/displacements.
         """
         num_ranks = self.num_ranks
-        offsets = {}
-        for src in range(num_ranks):
-            splits = entry.splits[src]
-            off, offs = 0, []
-            for n in splits:
-                offs.append((off, n))
-                off += n
-            offsets[src] = offs
+        splits_matrix = tuple(tuple(int(s) for s in entry.splits[r])
+                              for r in range(num_ranks))
+        some_local = entry.tensors[self.local_ranks[0]]
+        rest = tuple(some_local.shape[1:])
+        dtype = entry.dtype
+        max_split = max((max(row) if row else 0)
+                        for row in splits_matrix) or 1
 
-        for dst in range(num_ranks):
-            pieces = []
-            for src in range(num_ranks):
-                off, n = offsets[src][dst]
-                piece = jax.lax.slice_in_dim(entry.tensors[src], off, off + n,
-                                             axis=0)
-                pieces.append(jax.device_put(piece, self.devices[dst]))
-            out = jnp.concatenate(pieces, axis=0)
-            recv_splits = [offsets[src][dst][1] for src in range(num_ranks)]
-            entry.handles[dst].set_result((out, recv_splits))
+        key = (splits_matrix, rest, np.dtype(dtype).name)
+        fns = self._alltoall_cache.get(key)
+        if fns is None:
+            def make_pad(row):
+                # [sum(row), *rest] -> [1, N, max_split, *rest]
+                def pad(t):
+                    out = jnp.zeros((num_ranks, max_split) + rest,
+                                    dtype=t.dtype)
+                    off = 0
+                    for dst, n in enumerate(row):
+                        if n:
+                            seg = jax.lax.slice_in_dim(t, off, off + n,
+                                                       axis=0)
+                            out = jax.lax.dynamic_update_slice(
+                                out, seg[None],
+                                (dst, 0) + (0,) * len(rest))
+                        off += n
+                    return out[None]
+                return jax.jit(pad)
+
+            def exchange(g):  # [N, N, max_split, *rest] sharded on axis 0
+                def body(shard):
+                    return jax.lax.all_to_all(
+                        shard[0], AXIS, split_axis=0, concat_axis=0)[None]
+                return _shard_map(body, mesh=self.mesh,
+                                  in_specs=P(AXIS), out_specs=P(AXIS))(g)
+
+            def make_unpack(recv_row):
+                # [N, max_split, *rest] -> [sum(recv_row), *rest]
+                def unpack(x):
+                    parts = [jax.lax.slice_in_dim(x[src], 0, n, axis=0)
+                             for src, n in enumerate(recv_row) if n]
+                    if not parts:
+                        return jnp.zeros((0,) + rest, dtype=x.dtype)
+                    return jnp.concatenate(parts, axis=0)
+                return jax.jit(unpack)
+
+            pad_fns = {r: make_pad(splits_matrix[r])
+                       for r in self.local_ranks}
+            unpack_fns = {
+                r: make_unpack(tuple(splits_matrix[src][r]
+                                     for src in range(num_ranks)))
+                for r in self.local_ranks}
+            fns = (pad_fns, jax.jit(exchange, donate_argnums=0),
+                   unpack_fns)
+            self._alltoall_cache[key] = fns
+
+        pad_fns, exchange_fn, unpack_fns = fns
+        bufs = [pad_fns[r](entry.tensors[r]) for r in self.local_ranks]
+        garr = self._stack(bufs, (1, num_ranks, max_split) + rest, dtype)
+        out = exchange_fn(garr)
+        for rank, handle in entry.handles.items():
+            recv_splits = [splits_matrix[src][rank]
+                           for src in range(num_ranks)]
+            shard = self._shard_for(out, rank)[0]  # [N, max_split, *rest]
+            handle.set_result((unpack_fns[rank](shard), recv_splits))

@@ -2,6 +2,7 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     data_parallel_mesh,
     hierarchical_mesh,
+    shard_global_batch,
     MeshAxes,
 )
 from horovod_tpu.parallel.ring_attention import (  # noqa: F401

@@ -73,6 +73,42 @@ def data_parallel_mesh(devices=None) -> Mesh:
     return make_mesh({MeshAxes.DP: -1}, devices=devices)
 
 
+def shard_global_batch(local_batch, mesh=None, axis=MeshAxes.HVD):
+    """Assemble a global, mesh-sharded batch from this process's local
+    rows.
+
+    Pod jobs load data per host (reference: each Horovod rank reads its
+    own shard); under a multi-host global mesh the training step wants
+    ONE global ``jax.Array``.  Each process calls this with its local
+    rows; the result is the concatenated global batch sharded over
+    ``axis`` with this process contributing exactly its devices' shards.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if mesh is None:
+        from horovod_tpu.common import basics
+        mesh = basics.mesh()
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+    try:
+        return jax.make_array_from_process_local_data(sharding, local_batch)
+    except (AttributeError, TypeError):  # pragma: no cover — older jax
+        local_devices = [d for d in mesh.devices.flat
+                         if d.process_index == jax.process_index()]
+        if local_batch.shape[0] % len(local_devices) != 0:
+            raise ValueError(
+                f"local batch rows ({local_batch.shape[0]}) must be "
+                f"divisible by this process's device count "
+                f"({len(local_devices)})")
+        rows = local_batch.shape[0] // len(local_devices)
+        bufs = [jax.device_put(local_batch[i * rows:(i + 1) * rows], d)
+                for i, d in enumerate(local_devices)]
+        n_global = mesh.devices.size
+        global_shape = (rows * n_global,) + tuple(local_batch.shape[1:])
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, bufs)
+
+
 def hierarchical_mesh(local_size=None, devices=None) -> Mesh:
     """2-D (cross, local) mesh mirroring the reference's hierarchical
     allreduce topology (``nccl_operations.cc:162-289``): reduce-scatter over

@@ -35,7 +35,14 @@ def make_parser():
     parser.add_argument("--tpu", action="store_true",
                         help="TPU pod mode: one process per host; ranks map "
                              "onto pod-slice coordinates and in-process "
-                             "chips become the local axis.")
+                             "chips become the local axis.  Implies "
+                             "--global-mesh.")
+    parser.add_argument("--global-mesh", action="store_true",
+                        help="Join all processes into one jax.distributed "
+                             "runtime: every chip is a logical rank and "
+                             "collectives run as compiled XLA programs "
+                             "over the global mesh (metadata-only control "
+                             "plane).")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("--config-file", default=None,
                         help="YAML config file (CLI flags take precedence).")
@@ -120,7 +127,11 @@ def run_commandline(argv=None) -> int:
 
     extra_env = config_parser.env_from_args(args)
     slots = build_slots(args)
-    if len(slots) > 1 and env_util.HVD_CONTROLLER not in extra_env:
+    global_mesh = args.tpu or args.global_mesh
+    if global_mesh:
+        extra_env[env_util.HVD_GLOBAL_MESH] = "1"
+    if len(slots) > 1 and not global_mesh \
+            and env_util.HVD_CONTROLLER not in extra_env:
         extra_env[env_util.HVD_CONTROLLER] = "tcp"
     if env_util.HVD_SECRET_KEY not in extra_env:
         import base64

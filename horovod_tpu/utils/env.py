@@ -45,6 +45,8 @@ HVD_RENDEZVOUS_ADDR = "HVD_RENDEZVOUS_ADDR"
 HVD_RENDEZVOUS_PORT = "HVD_RENDEZVOUS_PORT"
 HVD_CONTROLLER_ADDR = "HVD_CONTROLLER_ADDR"
 HVD_IFACE = "HVD_IFACE"
+HVD_GLOBAL_MESH = "HVD_GLOBAL_MESH"            # pod mode: one global jax mesh
+HVD_COORDINATOR_ADDR = "HVD_COORDINATOR_ADDR"  # jax.distributed coordinator
 
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
 DEFAULT_CYCLE_TIME_MS = 1.0
