@@ -30,6 +30,7 @@ from horovod_tpu.common.basics import (  # noqa: F401
     cross_rank,
     cross_size,
     mesh,
+    local_device,
     nccl_built,
     mpi_built,
     gloo_built,

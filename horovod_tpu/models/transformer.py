@@ -42,6 +42,19 @@ class TransformerConfig:
     n_experts: int = 8
 
 
+def default_attention():
+    """The hot-path kernel: Pallas flash attention on TPU (O(T) memory,
+    MXU-tiled blocks — ``ops/pallas/flash_attention.py``); the dense
+    reference path elsewhere (interpret-mode Pallas on CPU is far slower
+    than XLA's fused softmax for test-sized problems)."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        from horovod_tpu.ops.pallas.flash_attention import flash_attention
+        return flash_attention
+    return reference_attention
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -52,7 +65,7 @@ class Attention(nn.Module):
         qkv = nn.DenseGeneral((3, h, d), use_bias=False, dtype=cfg.dtype,
                               name="qkv")(x)
         q, k, v = (qkv[..., i, :, :] for i in range(3))
-        attn = cfg.attn_fn or reference_attention
+        attn = cfg.attn_fn or default_attention()
         o = attn(q, k, v, causal=True)
         o = o.reshape(o.shape[:-2] + (h * d,))
         return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,

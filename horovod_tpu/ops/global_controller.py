@@ -122,7 +122,7 @@ class _GlobalName:
 
 
 # ---------------------------------------------------------------- coordinator
-class MetaCoordinatorService(network.BasicService):
+class MetaCoordinatorService(network.MuxService):
     """Rank-0 process's metadata coordinator (reference: rank 0 in
     ComputeResponseList — gathers requests, validates, fuses, broadcasts
     the ordered response list)."""
@@ -482,13 +482,12 @@ class GlobalMeshController(PythonController):
         return pinned or [(ip, p) for _, ip, p in tagged]
 
     def _client(self):
-        # one long-lived client: only the coordination-loop thread uses
-        # it, and reusing the instance keeps the learned-good address
-        # instead of re-probing the advertised NIC list every cycle
+        # one long-lived multiplexed connection: only the
+        # coordination-loop thread sends, and the persistent socket skips
+        # re-probing the advertised NIC list every cycle
         if self._client_obj is None:
-            self._client_obj = network.BasicClient(
-                self._client_addrs, self._key, timeout=30,
-                read_timeout=None)
+            self._client_obj = network.MuxClient(
+                self._client_addrs, self._key, timeout=30)
         return self._client_obj
 
     def shutdown(self):

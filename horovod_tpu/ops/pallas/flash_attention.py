@@ -241,16 +241,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(res, g, *, scale, causal, block_q, block_k, interpret):
+def _bwd(res, g, *, scale, causal, block_q, block_k, interpret,
+         g_lse=None):
     q3, k3, v3, out, lse = res
     bh, t, d = q3.shape
     t_kv = k3.shape[1]
     nq = t // block_q
     nk = t_kv // block_k
 
-    # delta_i = rowsum(dO * O) — cheap elementwise, leave it to XLA
+    # delta_i = rowsum(dO * O) — cheap elementwise, leave it to XLA.
+    # A cotangent on lse folds in exactly here: d s = p*(dp - delta)*scale
+    # gains p*g_lse*scale (since dlse/ds = p), i.e. delta -= g_lse.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                                # [bh, t]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     lse_b = jnp.broadcast_to(lse[:, :, None], (bh, t, 128))
     delta_b = jnp.broadcast_to(delta[:, :, None], (bh, t, 128))
 
@@ -327,14 +332,41 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    """Like ``_flash`` but also returns the logsumexp — the streaming-
+    softmax state ring attention needs to combine per-block results."""
+    return _fwd(q3, k3, v3, scale=scale, causal=causal, block_q=block_q,
+                block_k=block_k, interpret=interpret)
+
+
+def _flash_lse_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q3, k3, v3, scale=scale, causal=causal,
+                    block_q=block_q, block_k=block_k, interpret=interpret)
+    return (out, lse), (q3, k3, v3, out, lse)
+
+
+def _flash_lse_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    g_out, g_lse = g
+    return _bwd(res, g_out, scale=scale, causal=causal, block_q=block_q,
+                block_k=block_k, interpret=interpret, g_lse=g_lse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None):
+                    block_k=128, interpret=None, return_lse=False):
     """Flash multi-head attention, ``[B, T, H, D] -> [B, T, H, D]``.
 
     Differentiable (custom VJP with Pallas backward kernels).  On
     non-TPU backends runs in Pallas interpret mode (tests);
     drop-in for ``TransformerConfig.attn_fn`` and as the local-block
     kernel of ring/Ulysses attention.
+
+    ``return_lse=True`` additionally returns the logsumexp ``[B, H, T]``
+    (differentiable), which lets callers combine partial attention
+    results streaming-softmax style (ring attention's per-block use).
     """
     b, t, h, d = q.shape
     t_kv = k.shape[1]
@@ -348,6 +380,12 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
     def to3(x):
         tt = x.shape[1]
         return x.transpose(0, 2, 1, 3).reshape(b * h, tt, x.shape[3])
+
+    if return_lse:
+        out3, lse3 = _flash_lse(to3(q), to3(k), to3(v), scale, causal,
+                                block_q, block_k, interpret)
+        out = out3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+        return out, lse3.reshape(b, h, t)
 
     out3 = _flash(to3(q), to3(k), to3(v), scale, causal, block_q, block_k,
                   interpret)

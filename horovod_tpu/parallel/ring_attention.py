@@ -69,7 +69,7 @@ def _combine(o1, l1, m1, o2, l2, m2):
 
 
 def ring_attention(q, k, v, *, axis_name, causal=False, scale=None,
-                   query_chunk_idx=None):
+                   query_chunk_idx=None, use_flash=None):
     """Exact multi-head attention with K/V blocks rotating over ``axis_name``.
 
     Must run inside ``shard_map``/``pmap`` with ``axis_name`` bound; the
@@ -79,6 +79,13 @@ def ring_attention(q, k, v, *, axis_name, causal=False, scale=None,
     ``[i*Tq, (i+1)*Tq)`` and keys ``[i*Tkv, (i+1)*Tkv)``.  Off-diagonal
     blocks fully behind the queries are computed unmasked; blocks fully
     ahead are skipped via ``lax.cond`` (no FLOPs on the MXU for them).
+
+    use_flash: compute each local block with the Pallas flash kernel
+    (``ops/pallas/flash_attention.py``) instead of the dense einsum —
+    O(block) VMEM instead of the O(Tq*Tkv) score matrix.  Default: on
+    when running on TPU.  The kernel's logsumexp output feeds the same
+    streaming-softmax combine as the dense path, so results are exact
+    either way.
     """
     p_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name) if query_chunk_idx is None \
@@ -87,6 +94,8 @@ def ring_attention(q, k, v, *, axis_name, causal=False, scale=None,
     tkv = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
 
     q32 = q.astype(jnp.float32)
     o0 = jnp.zeros((b, tq, h, d), jnp.float32)
@@ -102,8 +111,38 @@ def ring_attention(q, k, v, *, axis_name, causal=False, scale=None,
 
     perm = [(i, (i + 1) % p_size) for i in range(p_size)]
 
+    def _flash_block(kc, vc, kv_idx):
+        """Local block via the Pallas kernel.  On the diagonal block the
+        global causal mask reduces to the local one (tq == tkv and equal
+        offsets), behind-blocks are unmasked, ahead-blocks were already
+        skipped — so the kernel's static `causal` flag suffices."""
+        from horovod_tpu.ops.pallas.flash_attention import flash_attention
+
+        def run(is_causal):
+            out, lse = flash_attention(
+                q, kc.astype(q.dtype), vc.astype(q.dtype),
+                causal=is_causal, scale=scale, return_lse=True)
+            # represent as (numerator, denom, max): normalized out with
+            # denom=1 in lse units plugs into the same _combine rule
+            ones = jnp.ones((b, h, tq), jnp.float32)
+            if hasattr(lax, "pcast"):
+                ones = lax.pcast(ones, (axis_name,), to="varying")
+            elif hasattr(lax, "pvary"):  # pragma: no cover
+                ones = lax.pvary(ones, (axis_name,))
+            return (out.astype(jnp.float32), ones, lse)
+
+        if causal:
+            return lax.cond(kv_idx == my_idx,
+                            lambda _: run(True),
+                            lambda _: run(False), operand=None)
+        return run(False)
+
     def block(o, l, m, kc, vc, kv_idx):
         def attend(_):
+            # the kernel's local causal mask only matches the global one
+            # on equal-length shards; fall back to the dense path else
+            if use_flash and (not causal or tq == tkv):
+                return _flash_block(kc, vc, kv_idx)
             if causal:
                 q_pos = my_idx * tq + jnp.arange(tq)
                 k_pos = kv_idx * tkv + jnp.arange(tkv)

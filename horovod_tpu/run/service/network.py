@@ -192,6 +192,184 @@ class BasicClient:
         return good
 
 
+# ------------------------------------------------- persistent mux transport
+class MuxService(BasicService):
+    """Persistent-connection variant: each connection carries a stream of
+    ``(req_id, request)`` frames; every request is handled on its own
+    thread and the ``(req_id, response)`` frame is written back whenever
+    it completes — so slow (blocking) requests don't head-of-line-block
+    the connection.  The reference keeps persistent Gloo pairs the same
+    way; round 1's one-connection-per-request client was the analog of
+    re-running rendezvous per collective."""
+
+    def __init__(self, name, key):
+        self._name = name
+        self._key = key
+        service = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                write_lock = threading.Lock()
+                sock = self.request
+                while True:
+                    try:
+                        frame = read_message(sock, service._key)
+                    except (PermissionError, ConnectionError, EOFError,
+                            OSError):
+                        return
+                    if not (isinstance(frame, tuple) and len(frame) == 2):
+                        return
+                    req_id, req = frame
+
+                    def run(req_id=req_id, req=req):
+                        try:
+                            resp = service._handle(req,
+                                                   self.client_address)
+                        except Exception as exc:  # noqa: BLE001
+                            resp = exc
+                        if req_id is None:
+                            return  # fire-and-forget frame: no response
+                        try:
+                            with write_lock:
+                                write_message(sock, service._key,
+                                              (req_id, resp))
+                        except OSError:
+                            pass  # client went away
+
+                    threading.Thread(target=run, daemon=True,
+                                     name=f"{service._name}-req").start()
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server(("0.0.0.0", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name=f"{name}-service")
+        self._thread.start()
+
+
+class MuxClient:
+    """Client for :class:`MuxService`: ONE persistent socket, concurrent
+    in-flight requests demultiplexed by id.  Thread-safe."""
+
+    def __init__(self, addresses, key, timeout=10):
+        if isinstance(addresses, dict):
+            flat = [a for addrs in addresses.values() for a in addrs]
+        else:
+            flat = list(addresses)
+        if not flat:
+            raise ValueError("no addresses to connect to")
+        self._addresses = flat
+        self._key = key
+        self._timeout = timeout
+        self._sock = None
+        self._send_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._pending = {}    # req_id -> [event, response]
+        self._next_id = 0
+        self._reader = None
+        self._broken = None
+
+    def _connect(self):
+        last_error = None
+        for addr in self._addresses:
+            try:
+                sock = socket.create_connection(addr,
+                                                timeout=self._timeout)
+                sock.settimeout(None)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock = sock
+                self._broken = None
+                self._reader = threading.Thread(
+                    target=self._read_loop, args=(sock,), daemon=True,
+                    name="mux-client-reader")
+                self._reader.start()
+                return
+            except OSError as exc:
+                last_error = exc
+        raise ConnectionError(
+            f"could not reach service at any of {self._addresses}: "
+            f"{last_error}")
+
+    def _read_loop(self, sock):
+        while True:
+            try:
+                frame = read_message(sock, self._key)
+            except (PermissionError, ConnectionError, EOFError, OSError) \
+                    as exc:
+                with self._state_lock:
+                    self._broken = exc
+                    pending, self._pending = self._pending, {}
+                for event, slot in pending.values():
+                    slot[0] = ConnectionError(
+                        f"connection to service lost: {exc}")
+                    event.set()
+                return
+            req_id, resp = frame
+            with self._state_lock:
+                entry = self._pending.pop(req_id, None)
+            if entry is not None:
+                entry[1][0] = resp
+                entry[0].set()
+
+    def send(self, req, timeout=None):
+        with self._state_lock:
+            if self._sock is None or self._broken is not None:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                self._connect()
+            req_id = self._next_id
+            self._next_id += 1
+            event, slot = threading.Event(), [None]
+            self._pending[req_id] = (event, slot)
+        try:
+            with self._send_lock:
+                write_message(self._sock, self._key, (req_id, req))
+        except OSError:
+            with self._state_lock:
+                self._pending.pop(req_id, None)
+            raise
+        if not event.wait(timeout):
+            with self._state_lock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError("no response from service")
+        resp = slot[0]
+        if isinstance(resp, Exception):
+            raise resp
+        return resp
+
+    def post(self, req):
+        """Fire-and-forget: write the frame without expecting a response
+        (req_id None).  TCP ordering + HMAC still apply; used by the ring
+        data plane so chunk streams aren't serialized on ack round-trips."""
+        with self._state_lock:
+            if self._sock is None or self._broken is not None:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                self._connect()
+        with self._send_lock:
+            write_message(self._sock, self._key, (None, req))
+
+    def close(self):
+        with self._state_lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
 # ----------------------------------------------------------- NIC enumeration
 def local_interfaces():
     """{interface_name: ipv4} for every UP non-loopback interface.

@@ -67,6 +67,15 @@ class Timeline:
         self._pids = {}
         self._next_pid = 1
         self._start = time.monotonic()
+        if self._writer is not None:
+            # wall-clock epoch of ts==0, so multi-rank merges can align
+            # traces from processes that started at different times
+            # (hosts are assumed NTP-synced, as chrome tracing itself
+            # assumes for multi-process captures)
+            self._writer.enqueue({
+                "name": "hvd_epoch", "ph": "M", "pid": 0,
+                "args": {"epoch_us": int(time.time() * 1e6)},
+            })
 
     @property
     def enabled(self):
@@ -124,3 +133,48 @@ class Timeline:
         if self._writer:
             self._writer.close()
             self._writer = None
+
+
+def merge_timeline_contents(contents, out_path):
+    """Merge per-rank chrome traces into one file (reference: rank 0
+    writes a single timeline for all ranks, ``timeline.cc``).
+
+    ``contents``: {rank: json_text}.  Tensor rows (pids) are offset per
+    rank and process_name metadata is prefixed with the rank so every
+    rank's lifecycle is visible side by side in chrome://tracing.
+    """
+    parsed = {}
+    epochs = {}
+    for rank in sorted(contents):
+        try:
+            events = json.loads(contents[rank])
+        except json.JSONDecodeError:
+            continue
+        parsed[rank] = events
+        for event in events:
+            if event.get("name") == "hvd_epoch":
+                epochs[rank] = event.get("args", {}).get("epoch_us", 0)
+                break
+    base_epoch = min(epochs.values()) if epochs else 0
+
+    merged = []
+    for rank, events in parsed.items():
+        offset = (rank + 1) * 100000
+        # shift each rank's relative timestamps onto the shared epoch so
+        # concurrent events line up in the viewer
+        shift = epochs.get(rank, base_epoch) - base_epoch
+        for event in events:
+            event = dict(event)
+            if event.get("name") == "hvd_epoch":
+                continue
+            if "pid" in event:
+                event["pid"] = event["pid"] + offset
+            if "ts" in event:
+                event["ts"] = event["ts"] + shift
+            if event.get("name") == "process_name":
+                args = dict(event.get("args") or {})
+                args["name"] = f"rank {rank}: {args.get('name', '')}"
+                event["args"] = args
+            merged.append(event)
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
