@@ -40,3 +40,13 @@ class ResponseType(enum.IntEnum):
     ADASUM = 4
     ALLTOALL = 5
     ERROR = 6
+
+
+def is_float_dtype(dt) -> bool:
+    """Float detection covering ml_dtypes extension types (bfloat16,
+    float8_*) whose numpy kind is not 'f' — shared by the TCP star and
+    ring data planes and the torch binding."""
+    import numpy as np
+
+    dt = np.dtype(dt)
+    return np.issubdtype(dt, np.floating) or "float" in dt.name

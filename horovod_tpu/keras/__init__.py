@@ -1,14 +1,232 @@
-"""Keras binding gate (reference: ``horovod/keras/__init__.py``).
+"""Keras binding (reference: ``horovod/keras/__init__.py`` +
+``horovod/_keras/``): ``DistributedOptimizer`` and the callback family
+over the Keras 3 callback API, backed by the same eager collectives as
+the TF binding.
 
-Requires TensorFlow/Keras, not present in this image; see
-``horovod_tpu.tensorflow``.
+Per-symbol import guard: imports cleanly without TF/Keras; symbols raise
+with guidance on first use.
 """
 
 try:
-    import tensorflow  # noqa: F401
-except ImportError as exc:  # pragma: no cover
-    raise ImportError(
-        "horovod_tpu.keras requires TensorFlow/Keras, which is not "
-        "installed in this environment. Use the JAX-native API "
-        "(horovod_tpu + flax) or horovod_tpu.torch instead."
-    ) from exc
+    import keras as _keras
+    _KERAS_ERROR = None
+except ImportError as _exc:  # pragma: no cover — keras present in image
+    _keras = None
+    _KERAS_ERROR = _exc
+
+from horovod_tpu.common import basics as _basics
+from horovod_tpu.common.ops_enum import (  # noqa: F401
+    Adasum, Average, Sum)
+
+init = _basics.init
+shutdown = _basics.shutdown
+rank = _basics.rank
+size = _basics.size
+local_rank = _basics.local_rank
+local_size = _basics.local_size
+
+
+def _require_keras():
+    if _keras is None:  # pragma: no cover
+        raise ImportError(
+            "horovod_tpu.keras requires Keras/TensorFlow, which is not "
+            "installed in this environment. Use the JAX-native API "
+            "(horovod_tpu + flax) or horovod_tpu.torch instead."
+        ) from _KERAS_ERROR
+
+
+def DistributedOptimizer(optimizer, name=None, op=Average,
+                         compression=None, backward_passes_per_step=1):
+    """Keras flavor of the TF binding's optimizer wrapper (reference:
+    ``keras/__init__.py`` delegating to ``_keras/__init__.py:48``)."""
+    _require_keras()
+    from horovod_tpu import tensorflow as hvd_tf
+
+    return hvd_tf.DistributedOptimizer(
+        optimizer, name=name, op=op, compression=compression,
+        backward_passes_per_step=backward_passes_per_step)
+
+
+def broadcast_global_variables(model_or_variables, root_rank=0):
+    """Sync weights from ``root_rank`` (reference:
+    ``keras/__init__.py`` broadcast_global_variables)."""
+    _require_keras()
+    from horovod_tpu import tensorflow as hvd_tf
+
+    variables = getattr(model_or_variables, "variables",
+                        model_or_variables)
+    hvd_tf.broadcast_variables(variables, root_rank)
+
+
+def load_model(filepath, custom_objects=None, compression=None):
+    """Load a Keras model and wrap its optimizer (reference:
+    ``keras/__init__.py:117`` load_model with optimizer rehydration).
+
+    Models saved with a wrapped optimizer serialize the dynamic
+    ``Distributed<Base>`` class name; wrappers for every standard keras
+    optimizer are pre-registered here so such saves round-trip."""
+    _require_keras()
+    from horovod_tpu.tensorflow import _make_distributed_class
+
+    custom = dict(custom_objects or {})
+    for attr in dir(_keras.optimizers):
+        obj = getattr(_keras.optimizers, attr)
+        if isinstance(obj, type) \
+                and issubclass(obj, _keras.optimizers.Optimizer) \
+                and obj is not _keras.optimizers.Optimizer:
+            cls = _make_distributed_class(obj, compression=compression)
+            custom.setdefault(cls.__name__, cls)
+    model = _keras.models.load_model(filepath, custom_objects=custom)
+    if getattr(model, "optimizer", None) is not None and not getattr(
+            model.optimizer, "_hvd_wrapped", False):
+        model.optimizer = DistributedOptimizer(model.optimizer,
+                                               compression=compression)
+    return model
+
+
+# ------------------------------------------------------------- callbacks
+if _keras is not None:
+    class BroadcastGlobalVariablesCallback(_keras.callbacks.Callback):
+        """Broadcast initial weights + optimizer state from root_rank at
+        the start of training (reference: ``_keras/callbacks.py:22``)."""
+
+        def __init__(self, root_rank=0):
+            super().__init__()
+            self.root_rank = root_rank
+            self._done = False
+
+        def on_batch_end(self, batch, logs=None):
+            if self._done:
+                return
+            from horovod_tpu import tensorflow as hvd_tf
+
+            hvd_tf.broadcast_variables(self.model.variables,
+                                       self.root_rank)
+            if getattr(self.model, "optimizer", None) is not None:
+                hvd_tf.broadcast_variables(
+                    self.model.optimizer.variables, self.root_rank)
+            self._done = True
+
+    class MetricAverageCallback(_keras.callbacks.Callback):
+        """Average epoch metrics over ranks before other callbacks read
+        them (reference: ``_keras/callbacks.py:48``)."""
+
+        def on_epoch_end(self, epoch, logs=None):
+            from horovod_tpu.callbacks import metric_average
+
+            if logs:
+                for key in list(logs):
+                    try:
+                        logs[key] = metric_average(
+                            float(logs[key]), f"{key}.{epoch}")
+                    except (TypeError, ValueError):
+                        continue
+
+    class LearningRateWarmupCallback(_keras.callbacks.Callback):
+        """Epoch-based warmup from the single-worker LR to the
+        size-scaled LR (reference: ``_keras/callbacks.py:172``)."""
+
+        def __init__(self, initial_lr=None, warmup_epochs=5,
+                     momentum_correction=True, steps_per_epoch=None,
+                     verbose=0):
+            super().__init__()
+            self.initial_lr = initial_lr
+            self.warmup_epochs = warmup_epochs
+            self.steps_per_epoch = steps_per_epoch
+            self.verbose = verbose
+            self._epoch = 0
+            del momentum_correction  # keras 3 has no momentum var hook
+
+        def _set_lr(self, value):
+            self.model.optimizer.learning_rate.assign(value)
+
+        def on_train_begin(self, logs=None):
+            if self.initial_lr is None:
+                self.initial_lr = float(
+                    self.model.optimizer.learning_rate.numpy())
+
+        def on_epoch_begin(self, epoch, logs=None):
+            self._epoch = epoch
+
+        def on_train_batch_begin(self, batch, logs=None):
+            if self._epoch >= self.warmup_epochs:
+                return
+            if self.steps_per_epoch:
+                progress = (self._epoch +
+                            batch / self.steps_per_epoch) \
+                    / self.warmup_epochs
+            else:
+                progress = (self._epoch + 1) / self.warmup_epochs
+            scale = 1.0 + progress * (_basics.size() - 1.0)
+            self._set_lr(self.initial_lr * scale)
+
+        def on_epoch_end(self, epoch, logs=None):
+            if epoch + 1 == self.warmup_epochs:
+                self._set_lr(self.initial_lr * _basics.size())
+                if self.verbose and _basics.rank() == 0:
+                    print(f"Warmup complete: lr = "
+                          f"{self.initial_lr * _basics.size()}")
+
+    class LearningRateScheduleCallback(_keras.callbacks.Callback):
+        """Multiplier schedule vs the initial LR (reference:
+        ``_keras/callbacks.py:89``)."""
+
+        def __init__(self, multiplier, start_epoch=0, end_epoch=None,
+                     staircase=True, momentum_correction=True,
+                     steps_per_epoch=None, initial_lr=None):
+            super().__init__()
+            self.multiplier = multiplier if callable(multiplier) \
+                else (lambda epoch: multiplier)
+            self.start_epoch = start_epoch
+            self.end_epoch = end_epoch
+            self.staircase = staircase
+            self.steps_per_epoch = steps_per_epoch
+            self.initial_lr = initial_lr
+            self._epoch = 0
+            del momentum_correction
+
+        def on_train_begin(self, logs=None):
+            if self.initial_lr is None:
+                self.initial_lr = float(
+                    self.model.optimizer.learning_rate.numpy())
+
+        def _in_range(self, epoch):
+            return (epoch >= self.start_epoch and
+                    (self.end_epoch is None or epoch < self.end_epoch))
+
+        def on_epoch_begin(self, epoch, logs=None):
+            self._epoch = epoch
+            if self.staircase and self._in_range(epoch):
+                self.model.optimizer.learning_rate.assign(
+                    self.initial_lr * self.multiplier(epoch))
+
+        def on_train_batch_begin(self, batch, logs=None):
+            if self.staircase or not self._in_range(self._epoch):
+                return
+            if self.steps_per_epoch:
+                epoch = self._epoch + batch / self.steps_per_epoch
+            else:
+                epoch = self._epoch
+            self.model.optimizer.learning_rate.assign(
+                self.initial_lr * self.multiplier(epoch))
+else:  # pragma: no cover — surface helpful errors without keras
+    def _missing(*_args, **_kwargs):
+        _require_keras()
+
+    BroadcastGlobalVariablesCallback = _missing
+    MetricAverageCallback = _missing
+    LearningRateWarmupCallback = _missing
+    LearningRateScheduleCallback = _missing
+
+
+class callbacks:  # namespace parity: hvd.callbacks.MetricAverageCallback
+    BroadcastGlobalVariablesCallback = None
+    MetricAverageCallback = None
+    LearningRateWarmupCallback = None
+    LearningRateScheduleCallback = None
+
+
+callbacks.BroadcastGlobalVariablesCallback = BroadcastGlobalVariablesCallback
+callbacks.MetricAverageCallback = MetricAverageCallback
+callbacks.LearningRateWarmupCallback = LearningRateWarmupCallback
+callbacks.LearningRateScheduleCallback = LearningRateScheduleCallback

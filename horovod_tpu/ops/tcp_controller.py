@@ -42,7 +42,8 @@ from collections import OrderedDict
 
 import numpy as np
 
-from horovod_tpu.common.ops_enum import ReduceOp, RequestType
+from horovod_tpu.common.ops_enum import (ReduceOp, RequestType,
+                                         is_float_dtype)
 from horovod_tpu.ops.tcp_dataplane import (DEFAULT_RING_THRESHOLD,
                                            PeerService, RingPlane)
 from horovod_tpu.run.service import network
@@ -104,15 +105,31 @@ class ShutdownMsg:
     pass
 
 
+def _wire_dtype(arr):
+    """(native-endian array, wire dtype string).  Extension dtypes
+    (bfloat16) have opaque ``.str`` so they travel by name; fixed-width
+    bytes/str keep ``.str`` (their ``.name`` doesn't round-trip); any
+    non-native byte order is normalized before the bytes hit the wire."""
+    dt = arr.dtype
+    if dt.kind in "SU":
+        return arr, dt.str
+    if dt.byteorder == ">":
+        arr = arr.astype(dt.newbyteorder("="))
+    return arr, arr.dtype.name
+
+
 def _decode(msg):
     return np.frombuffer(msg.payload, dtype=np.dtype(msg.dtype)).reshape(
         msg.shape)
 
 
 def _encode(arr):
-    arr = np.ascontiguousarray(arr)
-    return ResultMsg(payload=arr.tobytes(), shape=arr.shape,
-                     dtype=arr.dtype.str)
+    arr = np.asarray(arr)
+    # ascontiguousarray promotes 0-d to 1-d; keep the true shape
+    shape = arr.shape
+    arr, dtype = _wire_dtype(arr)
+    return ResultMsg(payload=np.ascontiguousarray(arr).tobytes(),
+                     shape=shape, dtype=dtype)
 
 
 def _signature(msg) -> bytes:
@@ -420,8 +437,8 @@ class CoordinatorService(network.MuxService):
     def _allreduce(self, arrs, first):
         acc = None
         for r in sorted(arrs):
-            a = arrs[r].astype(np.float64) if np.issubdtype(
-                arrs[r].dtype, np.floating) else arrs[r].astype(np.int64)
+            a = arrs[r].astype(np.float64) if is_float_dtype(
+                arrs[r].dtype) else arrs[r].astype(np.int64)
             if first.prescale != 1.0:
                 a = a * first.prescale
             acc = a if acc is None else acc + a
@@ -587,6 +604,7 @@ class TcpController:
     def _run_one(self, request):
         try:
             arr = np.asarray(request.tensor)
+            arr, wire_dtype = _wire_dtype(arr)
             rtype = RequestType(request.req_type)
             ring = self._use_ring(request.req_type, arr.nbytes)
             msg = CollectiveMsg(
@@ -594,7 +612,7 @@ class TcpController:
                 req_type=request.req_type, op=request.op,
                 payload=(None if ring
                          else np.ascontiguousarray(arr).tobytes()),
-                shape=arr.shape, dtype=arr.dtype.str,
+                shape=arr.shape, dtype=wire_dtype,
                 root_rank=request.root_rank, splits=request.splits,
                 prescale=request.prescale_factor,
                 postscale=request.postscale_factor, ring=ring)
@@ -642,7 +660,7 @@ class TcpController:
                     resp.ring_id,
                     arr if self._rank == request.root_rank else None,
                     resp.participants, request.root_rank,
-                    shape=tuple(arr.shape), dtype=arr.dtype.str,
+                    shape=tuple(arr.shape), dtype=arr.dtype.name,
                     timeout=timeout)
             else:  # ALLGATHER
                 blocks = self._ring.allgather(

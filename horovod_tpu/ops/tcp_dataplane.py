@@ -128,9 +128,10 @@ class RingPlane:
         participants = sorted(participants)
         p = len(participants)
         idx = participants.index(self.rank)
+        from horovod_tpu.common.ops_enum import is_float_dtype
+
         out_dtype = arr.dtype
-        acc_dtype = np.float64 if np.issubdtype(arr.dtype, np.floating) \
-            else np.int64
+        acc_dtype = np.float64 if is_float_dtype(arr.dtype) else np.int64
         flat = arr.reshape(-1).astype(acc_dtype)
         if prescale != 1.0:
             flat = flat * prescale

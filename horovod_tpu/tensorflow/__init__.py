@@ -1,16 +1,369 @@
-"""TensorFlow binding (reference: ``horovod/tensorflow/__init__.py``).
+"""TensorFlow 2 binding (reference: ``horovod/tensorflow/__init__.py``).
 
-TensorFlow is not part of this image's environment; the binding is gated and
-raises a clear error on import.  The TF2 surface (DistributedGradientTape,
-DistributedOptimizer, broadcast_variables) maps onto the same core
-collectives the torch binding uses.
+The TF surface — eager collectives, ``DistributedGradientTape``
+(``__init__.py:515-535``), ``DistributedOptimizer`` (``:271-433``),
+``broadcast_variables`` (``mpi_ops.py``), IndexedSlices sparse handling
+(``mpi_ops.py:111-144``) — routed through the same controller + XLA/ring
+data plane the torch binding uses, instead of per-framework C++ custom
+ops.  TF tensors cross into the core as numpy (zero-copy on CPU eager);
+results come back as ``tf.Tensor``.
+
+Per-symbol import guard: this module imports cleanly without TensorFlow
+(symbols raise with guidance on first use), and activates fully when TF
+is present.
 """
 
 try:
-    import tensorflow  # noqa: F401
-except ImportError as exc:  # pragma: no cover
-    raise ImportError(
-        "horovod_tpu.tensorflow requires TensorFlow, which is not installed "
-        "in this environment. The JAX-native API (horovod_tpu) and the "
-        "torch binding (horovod_tpu.torch) provide the same capabilities."
-    ) from exc
+    import tensorflow as _tf
+    _TF_ERROR = None
+except ImportError as _exc:  # pragma: no cover — TF present in CI image
+    _tf = None
+    _TF_ERROR = _exc
+
+import numpy as _np
+
+from horovod_tpu.common import basics as _basics
+from horovod_tpu.common.ops_enum import (  # noqa: F401
+    Adasum, Average, ReduceOp, Sum)
+from horovod_tpu.ops import eager as _eager
+
+# re-exported process-model surface (reference: tensorflow/__init__.py
+# re-exports basics through `hvd.`)
+init = _basics.init
+shutdown = _basics.shutdown
+is_initialized = _basics.is_initialized
+rank = _basics.rank
+size = _basics.size
+local_rank = _basics.local_rank
+local_size = _basics.local_size
+cross_rank = _basics.cross_rank
+cross_size = _basics.cross_size
+mpi_built = _basics.mpi_built
+gloo_built = _basics.gloo_built
+nccl_built = _basics.nccl_built
+xla_built = _basics.xla_built
+
+
+def _require_tf():
+    if _tf is None:  # pragma: no cover
+        raise ImportError(
+            "horovod_tpu.tensorflow requires TensorFlow, which is not "
+            "installed in this environment. The JAX-native API "
+            "(horovod_tpu) and the torch binding (horovod_tpu.torch) "
+            "provide the same capabilities.") from _TF_ERROR
+
+
+def _to_tf(result, dtype=None):
+    out = _tf.constant(_np.asarray(result))
+    if dtype is not None and out.dtype != dtype:
+        out = _tf.cast(out, dtype)
+    return out
+
+
+# --------------------------------------------------------------- collectives
+def _graph_bridge(fn, tensor, out_dtype, out_shape=None):
+    """Run an eager collective inside a traced ``tf.function`` via
+    ``tf.py_function`` (the reference uses registered custom ops for
+    graph mode, ``tensorflow/mpi_ops.cc``; the py_function node plays
+    that role here — it executes the eager data-plane call at step time
+    with a trace-stable name)."""
+    out = _tf.py_function(fn, [tensor], Tout=out_dtype)
+    if out_shape is not None:
+        out.set_shape(out_shape)
+    return out
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              compression=None):
+    """Allreduce a ``tf.Tensor`` (or ``tf.IndexedSlices``).
+
+    IndexedSlices follow the reference's sparse path
+    (``mpi_ops.py:111-144``): values/indices are allgathered instead of
+    densified, and Average divides the gathered values by size.
+
+    Works in eager mode and inside ``tf.function`` (via a py_function
+    bridge node).
+    """
+    _require_tf()
+    if not _tf.executing_eagerly() and not isinstance(
+            tensor, _tf.IndexedSlices):
+        return _graph_bridge(
+            lambda t: allreduce(t, average=average, name=name, op=op,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor,
+                                compression=compression),
+            tensor, tensor.dtype, tensor.shape)
+    if isinstance(tensor, _tf.IndexedSlices):
+        resolved = ReduceOp(op) if op is not None else (
+            Sum if average is False else Average)
+        if resolved == Adasum:
+            raise NotImplementedError(
+                "Adasum is not supported for tf.IndexedSlices")
+        values = allgather(tensor.values,
+                           name=f"{name}.values" if name else None)
+        indices = allgather(tensor.indices,
+                            name=f"{name}.indices" if name else None)
+        if resolved == Average:
+            values = values / size()
+        return _tf.IndexedSlices(values, indices,
+                                 dense_shape=tensor.dense_shape)
+
+    from horovod_tpu.tensorflow.compression import Compression
+    comp = compression or Compression.none
+    tensor = _tf.convert_to_tensor(tensor)
+    compressed, ctx = comp.compress(tensor)
+    out = _eager.allreduce(
+        compressed.numpy(), average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+    return comp.decompress(_to_tf(out, compressed.dtype), ctx)
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None):
+    _require_tf()
+    base = name or "tf_grouped"
+    tensors = [_tf.convert_to_tensor(t) for t in tensors]
+    if not _tf.executing_eagerly():
+        outs = _tf.py_function(
+            lambda *ts: grouped_allreduce(list(ts), average=average,
+                                          name=base, op=op),
+            tensors, Tout=[t.dtype for t in tensors])
+        for out, t in zip(outs, tensors):
+            out.set_shape(t.shape)
+        return list(outs)
+    arrays = [t.numpy() for t in tensors]
+    outs = _eager.grouped_allreduce(arrays, average=average, name=base,
+                                    op=op)
+    return [_to_tf(o, t.dtype) for o, t in zip(outs, tensors)]
+
+
+def allgather(tensor, name=None):
+    _require_tf()
+    tensor = _tf.convert_to_tensor(tensor)
+    if not _tf.executing_eagerly():
+        return _graph_bridge(
+            lambda t: allgather(t, name=name), tensor, tensor.dtype,
+            _tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    out = _eager.allgather(tensor.numpy(), name=name)
+    return _to_tf(out, tensor.dtype)
+
+
+def broadcast(tensor, root_rank, name=None):
+    _require_tf()
+    tensor = _tf.convert_to_tensor(tensor)
+    if not _tf.executing_eagerly():
+        return _graph_bridge(
+            lambda t: broadcast(t, root_rank, name=name), tensor,
+            tensor.dtype, tensor.shape)
+    out = _eager.broadcast(tensor.numpy(), root_rank, name=name)
+    return _to_tf(out, tensor.dtype)
+
+
+def alltoall(tensor, splits=None, name=None):
+    _require_tf()
+    tensor = _tf.convert_to_tensor(tensor)
+    if not _tf.executing_eagerly():
+        return _graph_bridge(
+            lambda t: alltoall(t, splits=splits, name=name), tensor,
+            tensor.dtype,
+            _tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    out = _eager.alltoall(tensor.numpy(), splits=splits, name=name)
+    return _to_tf(out, tensor.dtype)
+
+
+def join():
+    return _eager.join()
+
+
+# ---------------------------------------------------------------- variables
+def broadcast_variables(variables, root_rank):
+    """Assign every variable the root rank's value (reference:
+    ``broadcast_variables`` / ``BroadcastGlobalVariablesHook``).  Names
+    are positional so ranks pair up regardless of scope naming.  All
+    broadcasts are submitted asynchronously and synchronized together,
+    so a 500-variable model pays overlapping round-trips, not 500
+    sequential ones."""
+    _require_tf()
+    variables = list(variables)
+    handles = [
+        _eager.broadcast_async(
+            _tf.convert_to_tensor(var).numpy(), root_rank,
+            name=f"bcast_var.{i}")
+        for i, var in enumerate(variables)]
+    for var, handle in zip(variables, handles):
+        value = _to_tf(_eager.synchronize(handle))
+        var.assign(_tf.cast(value, var.dtype))
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Pickle-based object broadcast (reference:
+    ``tensorflow/functions.py`` broadcast_object)."""
+    import pickle
+
+    name = name or "tf_bcast_object"
+    if _basics.rank() == root_rank:
+        payload = _np.frombuffer(pickle.dumps(obj), dtype=_np.uint8)
+        length = _np.array([payload.size], dtype=_np.int64)
+    else:
+        payload = None
+        length = _np.zeros((1,), dtype=_np.int64)
+    length = _np.asarray(_eager.broadcast(length, root_rank,
+                                          name=f"{name}.len"))
+    if payload is None:
+        payload = _np.zeros((int(length[0]),), dtype=_np.uint8)
+    out = _np.asarray(_eager.broadcast(payload, root_rank,
+                                       name=f"{name}.data"))
+    return pickle.loads(out.tobytes())
+
+
+# ------------------------------------------------------------ gradient tape
+class _DistributedGradientTape:
+    """Wraps a ``tf.GradientTape``; ``gradient()`` allreduces the result
+    (reference: ``tensorflow/__init__.py:515`` _DistributedGradientTape)."""
+
+    def __init__(self, tape, op=Average, compression=None,
+                 prescale_factor=1.0, postscale_factor=1.0):
+        self.__dict__["_tape"] = tape
+        self.__dict__["_op"] = op
+        self.__dict__["_compression"] = compression
+        self.__dict__["_prescale"] = prescale_factor
+        self.__dict__["_postscale"] = postscale_factor
+        self.__dict__["_counter"] = 0
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_tape"], item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        gradients = self._tape.gradient(target, sources, output_gradients)
+        self.__dict__["_counter"] += 1
+        return _allreduce_grads(
+            gradients, op=self._op, compression=self._compression,
+            prescale_factor=self._prescale,
+            postscale_factor=self._postscale,
+            name_prefix=f"tape{self._counter}")
+
+
+def DistributedGradientTape(gradtape, op=Average, compression=None,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            device_dense="", device_sparse="",
+                            persistent=False):
+    """Factory matching the reference signature
+    (``tensorflow/__init__.py:535``); device args accepted for API
+    compatibility (placement is the data plane's concern here)."""
+    _require_tf()
+    del device_dense, device_sparse, persistent
+    return _DistributedGradientTape(
+        gradtape, op=op, compression=compression,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+
+
+def _allreduce_grads(gradients, op=Average, compression=None,
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     name_prefix="grad"):
+    flat_is_list = isinstance(gradients, (list, tuple))
+    grads = list(gradients) if flat_is_list else [gradients]
+    out = []
+    for i, grad in enumerate(grads):
+        if grad is None:
+            out.append(None)
+        else:
+            out.append(allreduce(
+                grad, op=op, name=f"{name_prefix}.{i}",
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                compression=compression))
+    if flat_is_list:
+        return tuple(out) if isinstance(gradients, tuple) else out
+    return out[0]
+
+
+# -------------------------------------------------------------- optimizer
+def _make_distributed_class(base_cls, name=None, op=Average,
+                            compression=None, backward_passes_per_step=1,
+                            prescale_factor=1.0, postscale_factor=1.0):
+    """Build the dynamic ``Distributed<Base>`` optimizer class.  Exposed
+    separately so ``keras.load_model`` can reconstruct serialized
+    instances (the class name lands in saved model configs)."""
+
+    class _Distributed(base_cls):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            if backward_passes_per_step > 1 \
+                    and not _tf.executing_eagerly():
+                # the accumulation counter is Python state: inside a
+                # traced tf.function it would freeze at trace time and
+                # the compiled step would never apply updates
+                raise RuntimeError(
+                    "backward_passes_per_step > 1 requires eager "
+                    "execution (model.compile(..., run_eagerly=True) "
+                    "or an eager training loop)")
+            grads_and_vars = list(grads_and_vars)
+            grads = [g for g, _ in grads_and_vars]
+            hvariables = [v for _, v in grads_and_vars]
+            state = self.__dict__.setdefault(
+                "_hvd_state", {"count": 0, "acc": None, "rounds": 0})
+            if backward_passes_per_step > 1:
+                dense = [
+                    _tf.convert_to_tensor(g) if g is not None else None
+                    for g in grads]
+                if state["acc"] is None:
+                    state["acc"] = dense
+                else:
+                    state["acc"] = [
+                        a + g if (a is not None and g is not None)
+                        else (a if g is None else g)
+                        for a, g in zip(state["acc"], dense)]
+                state["count"] += 1
+                if state["count"] % backward_passes_per_step != 0:
+                    return None
+                grads, state["acc"] = state["acc"], None
+                grads = [g / backward_passes_per_step
+                         if g is not None else None for g in grads]
+            state["rounds"] += 1
+            reduced = _allreduce_grads(
+                grads, op=op, compression=compression,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                name_prefix=f"opt.{name or 'grad'}.{state['rounds']}")
+            return super().apply_gradients(
+                zip(reduced, hvariables), *args, **kwargs)
+
+    _Distributed.__name__ = f"Distributed{base_cls.__name__}"
+    return _Distributed
+
+
+def DistributedOptimizer(optimizer, name=None, op=Average,
+                         compression=None, backward_passes_per_step=1,
+                         prescale_factor=1.0, postscale_factor=1.0,
+                         device_dense="", device_sparse="",
+                         sparse_as_dense=False):
+    """Wrap a Keras optimizer so ``apply_gradients`` allreduces first
+    (reference: ``tensorflow/__init__.py:271,433`` — the TF2/Keras
+    flavor; the TF1 ``compute_gradients`` graph path has no analog on
+    this stack).  ``backward_passes_per_step > 1`` accumulates locally
+    and exchanges every N-th call (reference:
+    ``gradient_aggregation_eager.py`` semantics)."""
+    _require_tf()
+    del device_dense, device_sparse, sparse_as_dense
+    cls = _make_distributed_class(
+        optimizer.__class__, name=name, op=op, compression=compression,
+        backward_passes_per_step=backward_passes_per_step,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+    return cls.from_config(optimizer.get_config())
+
+
+def broadcast_global_variables(root_rank):
+    """TF1 global-collection broadcast has no TF2 analog; directs users
+    to ``broadcast_variables`` (reference API parity stub)."""
+    _require_tf()
+    raise NotImplementedError(
+        "TF1 global collections do not exist on TF2; use "
+        "broadcast_variables(model.variables, root_rank)")

@@ -1,0 +1,45 @@
+"""Gradient compression for the TensorFlow binding (reference:
+``horovod/tensorflow/compression.py``): fp16-on-the-wire with
+decompression back to the source dtype.  On TPU the natural wire type is
+bfloat16 (no precision cliff on the MXU), so ``fp16`` here maps to
+bf16 — same redesign as the torch binding's compression."""
+
+import tensorflow as tf
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class NoneCompressor(Compressor):
+    pass
+
+
+class FP16Compressor(Compressor):
+    """Casts floating tensors to bfloat16 for transport."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating and tensor.dtype != tf.bfloat16:
+            return tf.cast(tensor, tf.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return tf.cast(tensor, ctx)
+        return tensor
+
+
+class Compression:
+    """Namespace matching the reference API (``Compression.none`` /
+    ``Compression.fp16``)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
