@@ -1,0 +1,69 @@
+"""Keras ``model.fit`` with the full callback family (reference:
+``examples/tensorflow2_keras_mnist.py``): DistributedOptimizer,
+broadcast + metric-average + LR-warmup callbacks, rank-0 checkpointing.
+
+    python examples/tensorflow2_keras_mnist.py
+    hvdrun -np 2 python examples/tensorflow2_keras_mnist.py
+"""
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+import keras
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=0.001)
+    parser.add_argument("--num-samples", type=int, default=2048)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(args.num_samples // hvd.size(), 784).astype(np.float32)
+    y = rng.randint(0, 10, (len(x),))
+
+    model = keras.Sequential([
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            keras.optimizers.Adam(args.lr)),
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"],
+        run_eagerly=True,  # the data plane crosses into numpy per step
+    )
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            warmup_epochs=1, steps_per_epoch=len(x) // args.batch_size),
+    ]
+    # rank 0 writes checkpoints, everyone else trains only (reference
+    # pattern: callbacks appended on rank 0)
+    ckpt = os.path.join(tempfile.gettempdir(), "hvd_keras_mnist.keras")
+    if hvd.rank() == 0:
+        callbacks.append(keras.callbacks.ModelCheckpoint(ckpt))
+
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=callbacks, verbose=2 if hvd.rank() == 0 else 0)
+
+    if hvd.rank() == 0:
+        reloaded = hvd.load_model(ckpt)
+        print("reloaded optimizer wrapped:",
+              getattr(reloaded.optimizer, "_hvd_wrapped", False))
+        print("KERAS_MNIST_DONE")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""TF2 synthetic benchmark (reference:
+``examples/tensorflow2_synthetic_benchmark.py``): timed training loop
+over random data through the TF binding, img/sec mean +- 1.96 sigma.
+
+    python examples/tensorflow2_synthetic_benchmark.py --model small
+    python examples/tensorflow2_synthetic_benchmark.py --model resnet50
+"""
+
+import argparse
+import time
+
+import numpy as np
+import tensorflow as tf
+import keras
+
+import horovod_tpu.tensorflow as hvd
+
+
+def build_model(name, img):
+    if name == "resnet50":
+        return keras.applications.ResNet50(weights=None,
+                                           input_shape=(img, img, 3))
+    return keras.Sequential([
+        keras.layers.Conv2D(32, 3, strides=2, activation="relu"),
+        keras.layers.Conv2D(64, 3, strides=2, activation="relu"),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dense(1000),
+    ])
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet50",
+                        choices=["resnet50", "small"])
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--img", type=int, default=224)
+    parser.add_argument("--num-warmup-batches", type=int, default=2)
+    parser.add_argument("--num-batches-per-iter", type=int, default=5)
+    parser.add_argument("--num-iters", type=int, default=3)
+    args = parser.parse_args()
+
+    hvd.init()
+    model = build_model(args.model, args.img)
+    opt = keras.optimizers.SGD(0.01 * hvd.size(), momentum=0.9)
+    loss_fn = keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+    rng = np.random.RandomState(hvd.rank())
+    x = tf.constant(rng.rand(args.batch_size, args.img, args.img,
+                             3).astype(np.float32))
+    y = tf.constant(rng.randint(0, 1000, (args.batch_size,)))
+
+    hvd.broadcast_variables(model.variables, root_rank=0)
+
+    def step():
+        with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = loss_fn(y, model(x, training=True))
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+
+    for _ in range(args.num_warmup_batches):
+        step()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        start = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            step()
+        elapsed = time.perf_counter() - start
+        img_secs.append(
+            args.batch_size * args.num_batches_per_iter / elapsed)
+
+    if hvd.rank() == 0:
+        mean = np.mean(img_secs)
+        conf = 1.96 * np.std(img_secs)
+        print(f"Img/sec per rank: {mean:.1f} +- {conf:.1f}")
+        print(f"Total img/sec on {hvd.size()} rank(s): "
+              f"{hvd.size() * mean:.1f} +- {hvd.size() * conf:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

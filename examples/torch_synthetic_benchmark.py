@@ -1,0 +1,86 @@
+"""Torch-binding synthetic benchmark (reference:
+``examples/pytorch_synthetic_benchmark.py:107-120``): timed training
+iterations over random data, img/sec mean +- 1.96 sigma, through
+``horovod_tpu.torch``'s DistributedOptimizer hooks.
+
+    python examples/torch_synthetic_benchmark.py
+    hvdrun -np 2 python examples/torch_synthetic_benchmark.py
+"""
+
+import argparse
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class SmallConvNet(nn.Module):
+    def __init__(self, classes=1000):
+        super().__init__()
+        self.c1 = nn.Conv2d(3, 32, 3, stride=2)
+        self.c2 = nn.Conv2d(32, 64, 3, stride=2)
+        self.fc = nn.Linear(64, classes)
+
+    def forward(self, x):
+        x = F.relu(self.c1(x))
+        x = F.relu(self.c2(x))
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--img", type=int, default=64)
+    parser.add_argument("--num-warmup-batches", type=int, default=2)
+    parser.add_argument("--num-batches-per-iter", type=int, default=5)
+    parser.add_argument("--num-iters", type=int, default=3)
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(hvd.rank())
+
+    model = SmallConvNet()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=0.01 * hvd.size(), momentum=0.9)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    x = torch.randn(args.batch_size, 3, args.img, args.img)
+    y = torch.randint(0, 1000, (args.batch_size,))
+
+    def step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        optimizer.step()
+
+    for _ in range(args.num_warmup_batches):
+        step()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        start = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            step()
+        elapsed = time.perf_counter() - start
+        img_secs.append(
+            args.batch_size * args.num_batches_per_iter / elapsed)
+
+    if hvd.rank() == 0:
+        mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+        print(f"Img/sec per rank: {mean:.1f} +- {conf:.1f}")
+        print(f"Total img/sec on {hvd.size()} rank(s): "
+              f"{hvd.size() * mean:.1f} +- {hvd.size() * conf:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,14 +1,198 @@
-"""MXNet binding gate (reference: ``horovod/mxnet/__init__.py``).
+"""MXNet binding (reference: ``horovod/mxnet/__init__.py`` +
+``mpi_ops.py``): DistributedOptimizer (allreduce inside ``update``),
+gluon DistributedTrainer (``__init__.py:87``), ``broadcast_parameters``
+(``:120``), and the eager collective surface — routed through the same
+controller + data plane as the torch/TF bindings instead of
+``MXEnginePushAsync`` C shims (``mxnet/mpi_ops.cc:135``).
 
-MXNet is not present in this image (and is EOL upstream); the binding
-surface (DistributedOptimizer update-hook, DistributedTrainer,
-broadcast_parameters) is covered by the torch and JAX bindings.
+Per-symbol import guard: imports cleanly without MXNet (which is EOL
+upstream and absent from this image — the binding activates when MXNet
+is installed; it is exercised by inspection, not CI, a documented scope
+note in README).
 """
 
 try:
-    import mxnet  # noqa: F401
-except ImportError as exc:  # pragma: no cover
-    raise ImportError(
-        "horovod_tpu.mxnet requires MXNet, which is not installed in this "
-        "environment. Use horovod_tpu.torch or the JAX-native API instead."
-    ) from exc
+    import mxnet as _mx
+    _MX_ERROR = None
+except ImportError as _exc:  # pragma: no cover — mxnet absent in image
+    _mx = None
+    _MX_ERROR = _exc
+
+import numpy as _np
+
+from horovod_tpu.common import basics as _basics
+from horovod_tpu.common.ops_enum import (  # noqa: F401
+    Adasum, Average, Sum)
+from horovod_tpu.ops import eager as _eager
+
+init = _basics.init
+shutdown = _basics.shutdown
+rank = _basics.rank
+size = _basics.size
+local_rank = _basics.local_rank
+local_size = _basics.local_size
+mpi_built = _basics.mpi_built
+gloo_built = _basics.gloo_built
+nccl_built = _basics.nccl_built
+
+
+def _require_mx():
+    if _mx is None:  # pragma: no cover
+        raise ImportError(
+            "horovod_tpu.mxnet requires MXNet, which is not installed in "
+            "this environment. Use horovod_tpu.torch or the JAX-native "
+            "API instead.") from _MX_ERROR
+
+
+def _to_mx(result, like):
+    arr = _mx.nd.array(_np.asarray(result), dtype=like.dtype)
+    return arr.as_in_context(like.context)
+
+
+# --------------------------------------------------------------- collectives
+def allreduce(tensor, average=True, name=None, prescale_factor=1.0,
+              postscale_factor=1.0):
+    _require_mx()
+    out = _eager.allreduce(tensor.asnumpy(), average=average, name=name,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+    return _to_mx(out, tensor)
+
+
+def allreduce_(tensor, average=True, name=None, priority=0):
+    """In-place allreduce (reference: ``mpi_ops.py`` allreduce_);
+    priority accepted for API parity (the controller orders by
+    negotiation, not engine priority)."""
+    _require_mx()
+    del priority
+    out = _eager.allreduce(tensor.asnumpy(), average=average, name=name)
+    tensor[:] = _to_mx(out, tensor)
+    return tensor
+
+
+def allgather(tensor, name=None):
+    _require_mx()
+    return _to_mx(_eager.allgather(tensor.asnumpy(), name=name), tensor)
+
+
+def broadcast(tensor, root_rank, name=None):
+    _require_mx()
+    return _to_mx(
+        _eager.broadcast(tensor.asnumpy(), root_rank, name=name), tensor)
+
+
+def broadcast_(tensor, root_rank, name=None):
+    _require_mx()
+    out = _eager.broadcast(tensor.asnumpy(), root_rank, name=name)
+    tensor[:] = _to_mx(out, tensor)
+    return tensor
+
+
+def alltoall(tensor, splits=None, name=None):
+    _require_mx()
+    return _to_mx(
+        _eager.alltoall(tensor.asnumpy(), splits=splits, name=name),
+        tensor)
+
+
+# ----------------------------------------------------------------- optimizer
+def DistributedOptimizer(optimizer):
+    """Wrap an ``mx.optimizer.Optimizer``: gradients are summed across
+    ranks inside ``update``/``update_multi_precision`` and
+    ``rescale_grad`` is divided by size, which is equivalent to — and
+    cheaper than — averaging in the allreduce (reference:
+    ``mxnet/__init__.py:40-85``)."""
+    _require_mx()
+
+    class _Distributed(_mx.optimizer.Optimizer):
+        _hvd_wrapped = True
+
+        def __init__(self, opt):
+            self._optimizer = opt
+            self._optimizer.rescale_grad /= size()
+
+        def __getattr__(self, item):
+            return getattr(self.__dict__["_optimizer"], item)
+
+        def create_state_multi_precision(self, index, weight):
+            return self._optimizer.create_state_multi_precision(
+                index, weight)
+
+        def _do_allreduce(self, index, grad):
+            if size() == 1:
+                return
+            if isinstance(index, (tuple, list)):
+                for i, idx in enumerate(index):
+                    allreduce_(grad[i], average=False, name=str(idx),
+                               priority=-i)
+            else:
+                allreduce_(grad, average=False, name=str(index))
+
+        def update(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            self._optimizer.update(index, weight, grad, state)
+
+        def update_multi_precision(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            self._optimizer.update_multi_precision(index, weight, grad,
+                                                   state)
+
+        def set_learning_rate(self, lr):
+            self._optimizer.set_learning_rate(lr)
+
+        def set_lr_mult(self, args_lr_mult):
+            self._optimizer.set_lr_mult(args_lr_mult)
+
+        def set_wd_mult(self, args_wd_mult):
+            self._optimizer.set_wd_mult(args_wd_mult)
+
+    return _Distributed(optimizer)
+
+
+if _mx is not None:
+    class DistributedTrainer(_mx.gluon.Trainer):
+        """Gluon trainer whose ``_allreduce_grads`` exchanges gradients
+        (reference: ``mxnet/__init__.py:87``); the scale trick matches
+        the reference: gradients are summed, the update rescales by
+        1/size."""
+
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     **kwargs):
+            if getattr(optimizer, "_hvd_wrapped", False):
+                # double-wrapping would sum gradients twice AND apply the
+                # 1/size rescale twice — hard error, not silent corruption
+                raise ValueError(
+                    "DistributedTrainer wraps a plain optimizer; do not "
+                    "pass a DistributedOptimizer")
+            super().__init__(params, optimizer,
+                             optimizer_params=optimizer_params, **kwargs)
+            self._scale /= size()
+
+        def _allreduce_grads(self):
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for grad in param.list_grad():
+                        allreduce_(grad, average=False,
+                                   name=str(i), priority=-i)
+else:  # pragma: no cover
+    def DistributedTrainer(*_args, **_kwargs):
+        _require_mx()
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast a gluon ``ParameterDict`` / param dict from root
+    (reference: ``mxnet/__init__.py:120``)."""
+    _require_mx()
+    if hasattr(params, "items"):
+        tensors = []
+        names = []
+        for name, param in sorted(params.items()):
+            try:
+                tensors.append(param.data())
+                names.append(name)
+            except _mx.gluon.parameter.DeferredInitializationError:
+                continue
+    else:
+        raise ValueError(f"invalid params of type {type(params)}")
+    for name, tensor in zip(names, tensors):
+        broadcast_(tensor, root_rank, name=f"param.{name}")

@@ -150,10 +150,33 @@ def broadcast_parameters(params, root_rank=0):
         mpi_ops.synchronize(handle)
 
 
+def _broadcast_scalar(scalar, root_rank, name):
+    """Type- and value-preserving scalar broadcast.  The scalar rides as
+    its 8 raw little-endian bytes in a uint8 tensor: the XLA bridge
+    downcasts int64/float64 (jax_enable_x64 is off), so any 64-bit wide
+    representation would silently truncate step counters > 2**31 or lose
+    float64 precision — bytes survive exactly."""
+    import struct
+
+    if isinstance(scalar, bool):
+        fmt, conv = "<q", lambda v: bool(v)
+        payload = struct.pack(fmt, int(scalar))
+    elif isinstance(scalar, int):
+        fmt, conv = "<q", int
+        payload = struct.pack(fmt, scalar)
+    else:
+        fmt, conv = "<d", float
+        payload = struct.pack(fmt, float(scalar))
+    wrapped = torch.tensor(list(payload), dtype=torch.uint8)
+    out = mpi_ops.broadcast(wrapped, root_rank, name=name)
+    return conv(struct.unpack(fmt, bytes(out.tolist()))[0])
+
+
 def broadcast_optimizer_state(optimizer, root_rank=0):
     """Broadcast optimizer state from root (reference:
     torch/__init__.py:484).  Tensor state entries broadcast directly;
-    scalar entries (step counters, lr, ...) ride through 0-d tensors."""
+    scalar entries (step counters, lr, ...) ride type-preserving 0-d
+    broadcasts."""
     state_dict = optimizer.state_dict()
 
     scalars = {}
@@ -166,12 +189,7 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
                     mpi_ops.broadcast_async_(value, root_rank, name=name))
             else:
                 scalar = value.item() if torch.is_tensor(value) else value
-                wrapped = torch.tensor([float(scalar)],
-                                       dtype=torch.float64)
-                out = mpi_ops.broadcast(wrapped, root_rank, name=name)
-                restored = out.item()
-                if isinstance(scalar, int):
-                    restored = int(restored)
+                restored = _broadcast_scalar(scalar, root_rank, name)
                 scalars[(pid, key)] = (value, restored)
 
     for handle in handles:
@@ -186,11 +204,8 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
 
     for gi, group in enumerate(state_dict.get("param_groups", [])):
         for key, value in group.items():
-            if isinstance(value, (int, float)) and not isinstance(value,
-                                                                  bool):
-                wrapped = torch.tensor([float(value)], dtype=torch.float64)
-                out = mpi_ops.broadcast(wrapped, root_rank,
-                                        name=f"opt_group.{gi}.{key}")
-                group[key] = type(value)(out.item())
+            if isinstance(value, (int, float)):
+                group[key] = _broadcast_scalar(
+                    value, root_rank, name=f"opt_group.{gi}.{key}")
 
     optimizer.load_state_dict(state_dict)

@@ -41,6 +41,12 @@ def _run_example(name, *args, timeout=420):
                            "--n-layers", "2", "--seq-len", "32")),
     ("jax_mnist.py", ("--epochs", "1", "--batch-size", "256",
                       "--num-samples", "512")),
+    ("jax_imagenet_resnet50.py", ("--epochs", "1", "--steps", "2",
+                                  "--batch-size", "1")),
+    ("moe_expert_parallel.py", ("--steps", "4", "--d-model", "64",
+                                "--seq-len", "32")),
+    ("ulysses_long_context.py", ("--seq-len", "256", "--head-dim", "16")),
+    ("cluster_estimator.py", ("--epochs", "3",)),
 ])
 def test_example_runs(name, args):
     result = _run_example(name, *args)
@@ -50,21 +56,8 @@ def test_example_runs(name, args):
 
 def test_torch_mnist_under_hvdrun():
     """The torch binding's documented mode: one process per rank."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    worker = (
-        "import jax, runpy, sys; "
-        "jax.config.update('jax_platforms', 'cpu'); "
-        f"sys.argv = ['torch_mnist.py', '--epochs', '1', "
-        f"'--num-samples', '256']; "
-        f"runpy.run_path({os.path.join(EXAMPLES, 'torch_mnist.py')!r}, "
-        "run_name='__main__')"
-    )
-    result = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bin", "hvdrun"), "-np", "2",
-         sys.executable, "-c", worker],
-        env=env, capture_output=True, text=True, timeout=420)
+    result = _run_example_hvdrun("torch_mnist.py", "--epochs", "1",
+                                 "--num-samples", "256")
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
 
@@ -85,3 +78,51 @@ def test_synthetic_benchmark_tiny():
         "--num-batches-per-iter", "1", "--num-iters", "1", timeout=600)
     assert result.returncode == 0, result.stderr
     assert "Img/sec per device" in result.stdout
+
+
+
+def _run_example_hvdrun(name, *args, np_=2, timeout=600):
+    """Per-process bindings (torch/TF/keras) run one process per rank."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["TF_CPP_MIN_LOG_LEVEL"] = "2"
+    worker = (
+        "import jax, runpy, sys; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        f"sys.argv = [{name!r}] + {list(args)!r}; "
+        f"runpy.run_path({os.path.join(EXAMPLES, name)!r}, "
+        "run_name='__main__')"
+    )
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hvdrun"),
+         "-np", str(np_), sys.executable, "-c", worker],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def test_torch_synthetic_benchmark_under_hvdrun():
+    result = _run_example_hvdrun(
+        "torch_synthetic_benchmark.py", "--batch-size", "4", "--img",
+        "32", "--num-iters", "1", "--num-batches-per-iter", "2")
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert "Img/sec per rank" in result.stdout
+
+
+def test_tf2_examples_under_hvdrun():
+    import pytest
+    pytest.importorskip("tensorflow")
+    for name, args in [
+        ("tensorflow2_mnist.py", ("--epochs", "1", "--batch-size", "64",
+                                  "--num-samples", "256")),
+        ("tensorflow2_keras_mnist.py", ("--epochs", "1",
+                                        "--batch-size", "64",
+                                        "--num-samples", "256")),
+        ("tensorflow2_synthetic_benchmark.py",
+         ("--model", "small", "--batch-size", "4", "--img", "32",
+          "--num-iters", "1", "--num-batches-per-iter", "2")),
+    ]:
+        result = _run_example_hvdrun(name, *args)
+        assert result.returncode == 0, \
+            f"{name} failed\nstdout:\n{result.stdout}\n" \
+            f"stderr:\n{result.stderr}"
