@@ -28,7 +28,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import horovod_tpu as hvd
 from horovod_tpu import callbacks
 from horovod_tpu.models import ResNet50
-from horovod_tpu.parallel import make_mesh
 from horovod_tpu.parallel._compat import shard_map
 from horovod_tpu.utils import checkpoint as ckpt
 
@@ -141,7 +140,7 @@ def main():
         t0 = time.perf_counter()
         images = 0
         loss = None
-        for x, y in iter_shards(args.train_dir, global_batch, hvd.rank(),
+        for x, y in iter_shards(args.train_dir, global_batch, hvd.cross_rank(),
                                 hvd.cross_size(), args.steps, seed=epoch):
             xd = jax.device_put(jnp.asarray(x), sharded)
             yd = jax.device_put(jnp.asarray(y), sharded)
@@ -154,7 +153,7 @@ def main():
 
         # validation (averaged across ranks like MetricAverageCallback)
         top1s, top5s = [], []
-        for x, y in iter_shards(args.val_dir, global_batch, hvd.rank(),
+        for x, y in iter_shards(args.val_dir, global_batch, hvd.cross_rank(),
                                 hvd.cross_size(), 2, seed=10_000 + epoch):
             t1, t5 = eval_jit(params, batch_stats, jnp.asarray(x),
                               jnp.asarray(y))

@@ -187,6 +187,10 @@ def broadcast_parameters(params, root_rank=0):
         tensors = []
         names = []
         for name, param in sorted(params.items()):
+            if isinstance(param, _mx.nd.NDArray):
+                tensors.append(param)   # plain name -> NDArray dict
+                names.append(name)
+                continue
             try:
                 tensors.append(param.data())
                 names.append(name)

@@ -59,10 +59,17 @@ def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
     state = basics._get_state()
     _require_rank_context(state, name)
     # rank indexes the executor's device list (global in gmesh mode, local
-    # otherwise; commit wraps for process-rank mode where size can exceed
-    # the addressable device count)
-    committed = state.executor.commit(tensor, basics.rank()) \
-        if tensor is not None else None
+    # otherwise).  The tcp plane keeps tensors as numpy: a device commit
+    # there would let jax narrow 64-bit dtypes before the exact numpy
+    # transport ever sees them.
+    if tensor is None:
+        committed = None
+    elif state.config.controller == "tcp":
+        import numpy as _np
+
+        committed = _np.asarray(tensor)
+    else:
+        committed = state.executor.commit(tensor, basics.rank())
     handle = Handle(name)
     state.controller.enqueue(EagerRequest(
         rank=basics.rank(), req_type=req_type, name=name, tensor=committed,

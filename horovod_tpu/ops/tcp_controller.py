@@ -633,8 +633,15 @@ class TcpController:
                     dtype=np.dtype(resp.dtype)).reshape(resp.shape)
                 self._timeline.end(request.name,
                                    {"bytes": out.nbytes})
-            import jax.numpy as jnp
-            result = jnp.asarray(out)
+            if out.dtype.itemsize >= 8 or out.dtype.kind == "u":
+                # jax without x64 narrows 64-bit dtypes (and flips some
+                # unsigned ints); the tcp plane promises exact transport,
+                # so hand back numpy without paying a device copy
+                result = out
+            else:
+                import jax.numpy as jnp
+
+                result = jnp.asarray(out)
             if rtype == RequestType.ALLTOALL:
                 request.handle.set_result((result, resp.recv_splits))
             else:

@@ -154,7 +154,15 @@ class XlaExecutor:
 
     def _stack(self, per_rank_bufs, shard_shape, dtype):
         """Assemble the mesh-sharded fusion buffer from this process's
-        per-rank shards (``per_rank_bufs``: list in local-rank order)."""
+        per-rank shards (``per_rank_bufs``: list in local-rank order).
+
+        Each buffer is pinned to its rank's device first: XLA constant-
+        folds programs over empty/trivial shards, and folded outputs land
+        on the DEFAULT device regardless of input placement (no-op when
+        already resident)."""
+        per_rank_bufs = [
+            jax.device_put(buf, self.devices[rank])
+            for buf, rank in zip(per_rank_bufs, self.local_ranks)]
         global_shape = (self.num_ranks,) + tuple(shard_shape[1:])
         return jax.make_array_from_single_device_arrays(
             global_shape, self._sharded, per_rank_bufs)

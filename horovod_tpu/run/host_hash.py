@@ -1,0 +1,17 @@
+"""Host identity (reference: ``horovod/run/util/host_hash.py``): ranks
+sharing a host_hash share local (fast-interconnect) topology.  The hash
+folds in an optional salt (``HVD_HOSTNAME_HASH_SALT``) so containerized
+deployments where every container reports the same hostname can force
+distinct identities."""
+
+import hashlib
+import os
+import socket
+
+
+def host_hash(salt=None) -> str:
+    hostname = socket.gethostname()
+    salt = salt if salt is not None else os.environ.get(
+        "HVD_HOSTNAME_HASH_SALT", "")
+    digest = hashlib.md5(f"{hostname}-{salt}".encode()).hexdigest()
+    return f"{hostname.split('.')[0]}-{digest[:8]}"

@@ -139,6 +139,12 @@ def run_commandline(argv=None) -> int:
         extra_env[env_util.HVD_SECRET_KEY] = base64.b64encode(
             secret.make_secret_key()).decode()
 
+    # fail fast with the full unreachable-host list before launching
+    # anything (reference: runner.py:568-643 parallel cached ssh check)
+    remote_hosts = sorted({s.hostname for s in slots})
+    from horovod_tpu.run.ssh_check import check_all_hosts_ssh_successful
+    check_all_hosts_ssh_successful(remote_hosts, ssh_port=args.ssh_port)
+
     rendezvous = RendezvousServer()
     port = rendezvous.start()
     addr = os.environ.get("HVD_RENDEZVOUS_HOST_ADDR")

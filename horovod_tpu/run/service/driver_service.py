@@ -11,9 +11,12 @@ from horovod_tpu.run.service import network
 
 # ------------------------------------------------------------------ messages
 class RegisterTaskRequest:
-    def __init__(self, index, task_addresses):
+    def __init__(self, index, task_addresses, host_hash=None):
         self.index = index
         self.task_addresses = task_addresses  # {iface: [(ip, port)]}
+        # machine identity (reference: host_hash.py) — co-located tasks
+        # skip the pairwise NIC probe, every interface is loopback-reachable
+        self.host_hash = host_hash
 
 
 class AllTaskAddressesRequest:
@@ -48,6 +51,7 @@ class DriverService(network.BasicService):
     def __init__(self, num_proc, key):
         self._num_proc = num_proc
         self._registered = {}          # index -> {iface: [(ip, port)]}
+        self._host_hashes = {}         # index -> host_hash
         self._task_to_task = {}        # index -> {iface: [(ip, port)]}
         self._cv = threading.Condition()
         super().__init__(self.NAME, key)
@@ -56,6 +60,7 @@ class DriverService(network.BasicService):
         if isinstance(req, RegisterTaskRequest):
             with self._cv:
                 self._registered[req.index] = req.task_addresses
+                self._host_hashes[req.index] = req.host_hash
                 self._cv.notify_all()
             return network.AckResponse()
         if isinstance(req, AllTaskAddressesRequest):
@@ -110,8 +115,8 @@ class DriverClient(network.BasicClient):
     def __init__(self, driver_addresses, key, timeout=10):
         super().__init__(driver_addresses, key, timeout=timeout)
 
-    def register_task(self, index, task_addresses):
-        self.send(RegisterTaskRequest(index, task_addresses))
+    def register_task(self, index, task_addresses, host_hash=None):
+        self.send(RegisterTaskRequest(index, task_addresses, host_hash))
 
     def all_task_addresses(self, index=-1):
         return self.send(AllTaskAddressesRequest(index)).all_task_addresses
@@ -133,8 +138,14 @@ def find_common_interfaces(driver, key, num_proc, timeout=60):
     driver.wait_for_initial_registration(timeout=timeout)
     for i in range(num_proc):
         nxt = (i + 1) % num_proc
-        client = TaskClient(driver.task_addresses(i), key)
-        reachable = client.probe_addresses(driver.task_addresses(nxt))
+        hh_i = driver._host_hashes.get(i)
+        if hh_i is not None and hh_i == driver._host_hashes.get(nxt):
+            # co-located tasks (same host_hash): every interface is
+            # trivially routable; skip the network probe
+            reachable = driver.task_addresses(nxt)
+        else:
+            client = TaskClient(driver.task_addresses(i), key)
+            reachable = client.probe_addresses(driver.task_addresses(nxt))
         driver._handle(
             RegisterTaskToTaskAddressesRequest(i, reachable), None)
     return driver.common_interfaces()

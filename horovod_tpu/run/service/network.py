@@ -205,6 +205,8 @@ class MuxService(BasicService):
     def __init__(self, name, key):
         self._name = name
         self._key = key
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
         service = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -220,21 +222,28 @@ class MuxService(BasicService):
                     if not (isinstance(frame, tuple) and len(frame) == 2):
                         return
                     req_id, req = frame
+                    with service._inflight_cv:
+                        service._inflight += 1
 
                     def run(req_id=req_id, req=req):
                         try:
-                            resp = service._handle(req,
-                                                   self.client_address)
-                        except Exception as exc:  # noqa: BLE001
-                            resp = exc
-                        if req_id is None:
-                            return  # fire-and-forget frame: no response
-                        try:
-                            with write_lock:
-                                write_message(sock, service._key,
-                                              (req_id, resp))
-                        except OSError:
-                            pass  # client went away
+                            try:
+                                resp = service._handle(
+                                    req, self.client_address)
+                            except Exception as exc:  # noqa: BLE001
+                                resp = exc
+                            if req_id is None:
+                                return  # fire-and-forget: no response
+                            try:
+                                with write_lock:
+                                    write_message(sock, service._key,
+                                                  (req_id, resp))
+                            except OSError:
+                                pass  # client went away
+                        finally:
+                            with service._inflight_cv:
+                                service._inflight -= 1
+                                service._inflight_cv.notify_all()
 
                     threading.Thread(target=run, daemon=True,
                                      name=f"{service._name}-req").start()
@@ -248,6 +257,21 @@ class MuxService(BasicService):
                                         daemon=True,
                                         name=f"{name}-service")
         self._thread.start()
+
+    def shutdown(self):
+        """Drain in-flight requests before closing: a coordinator whose
+        own rank finishes first must not tear down the socket while
+        response frames to other ranks are still being written."""
+        import time as _time
+
+        deadline = _time.monotonic() + 10
+        with self._inflight_cv:
+            while self._inflight > 0:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                self._inflight_cv.wait(timeout=remaining)
+        super().shutdown()
 
 
 class MuxClient:

@@ -33,8 +33,11 @@ def main():
 
     task = TaskService(index, key)
     try:
+        from horovod_tpu.run.host_hash import host_hash
+
         client = DriverClient(driver_addrs, key)
-        client.register_task(index, task.addresses())
+        client.register_task(index, task.addresses(),
+                             host_hash=host_hash())
         deadline = time.time() + timeout
         while not task.shutdown_requested.is_set():
             if time.time() > deadline:

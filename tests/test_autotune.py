@@ -187,3 +187,42 @@ def test_native_core_exposes_tuned_params():
         assert params["tuning"] is False  # autotune off by default
     finally:
         hvd.shutdown()
+
+
+def test_parameter_manager_converges_on_synthetic_bandwidth():
+    """Drive the tuner against a synthetic bandwidth model (throughput a
+    bell curve over log2(fusion threshold), peaked away from the default)
+    and check the pinned parameters beat the default configuration —
+    the oracle VERDICT r1 asked the bandwidth microbench to provide."""
+    import math as m
+
+    peak_log2 = m.log2(8 * 1024 * 1024)   # best threshold ~8MB
+    default_bytes = 64 * 1024 * 1024
+
+    def rate(threshold_bytes, cycle_ms):
+        # bytes/sec: bell over threshold, mild penalty for long cycles
+        t = m.log2(max(threshold_bytes, 1))
+        bell = m.exp(-((t - peak_log2) ** 2) / 8.0)
+        return 2e9 * bell / (1.0 + cycle_ms / 50.0)
+
+    pm = autotune.ParameterManager(
+        warmup_samples=1, steady_state_samples=3,
+        bayes_opt_max_samples=8, gp_noise=0.3,
+        fusion_threshold_bytes=default_bytes, cycle_time_ms=5.0)
+
+    now = 0.0
+    work_bytes = 256 * 1024 * 1024
+    for _ in range(8000):
+        r = rate(pm.fusion_threshold_bytes, pm.cycle_time_ms)
+        now += work_bytes / r
+        pm.record(work_bytes)
+        pm.update(now)
+        if not pm.tuning:
+            break
+
+    assert not pm.tuning, "tuner never converged"
+    tuned = rate(pm.fusion_threshold_bytes, pm.cycle_time_ms)
+    base = rate(default_bytes, 5.0)
+    assert tuned >= base, (tuned, base, pm.fusion_threshold_bytes,
+                           pm.cycle_time_ms)
+    assert pm.best_score > 0

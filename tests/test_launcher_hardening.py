@@ -1,0 +1,109 @@
+"""Launcher hardening units (reference: ``test_run.py`` — mock-level
+tests of ssh probing, on-disk cache expiry, host hashing; no cluster
+needed)."""
+
+import time
+
+import pytest
+
+from horovod_tpu.run import host_hash as hh
+from horovod_tpu.run.cache import Cache
+from horovod_tpu.run import ssh_check
+
+
+class FakeRun:
+    """Records invocations; returncode by hostname."""
+
+    def __init__(self, fail_hosts=()):
+        self.fail_hosts = set(fail_hosts)
+        self.calls = []
+
+    def __call__(self, cmd, capture_output=True, timeout=None):
+        self.calls.append(cmd)
+        host = cmd[-2]
+
+        class R:
+            returncode = 1 if host in self.fail_hosts else 0
+        return R()
+
+
+def test_cache_roundtrip_and_expiry(tmp_path):
+    path = str(tmp_path / "cache.json")
+    c = Cache(path=path, ttl_seconds=1000, parameters_hash="p1")
+    assert c.get("k") is None
+    c.put("k", True)
+    assert c.get("k") is True
+    # fresh instance reads from disk
+    assert Cache(path=path, ttl_seconds=1000,
+                 parameters_hash="p1").get("k") is True
+    # parameter change invalidates
+    assert Cache(path=path, ttl_seconds=1000,
+                 parameters_hash="p2").get("k") is None
+
+
+def test_cache_ttl(tmp_path):
+    c = Cache(path=str(tmp_path / "c.json"), ttl_seconds=0.05)
+    c.put("k", "v")
+    time.sleep(0.1)
+    assert c.get("k") is None
+
+
+def test_ssh_check_all_reachable(tmp_path):
+    fake = FakeRun()
+    cache = Cache(path=str(tmp_path / "c.json"))
+    assert ssh_check.check_all_hosts_ssh_successful(
+        ["host1", "host2", "localhost"], cache=cache, runner=fake)
+    probed = {c[-2] for c in fake.calls}
+    assert probed == {"host1", "host2"}  # local hosts skipped
+    # ssh invocation shape: BatchMode + StrictHostKeyChecking + true
+    assert any("BatchMode=yes" in " ".join(c) for c in fake.calls)
+    assert all(c[-1] == "true" for c in fake.calls)
+
+
+def test_ssh_check_reports_all_unreachable(tmp_path):
+    fake = FakeRun(fail_hosts={"bad1", "bad2"})
+    cache = Cache(path=str(tmp_path / "c.json"))
+    with pytest.raises(RuntimeError) as exc:
+        ssh_check.check_all_hosts_ssh_successful(
+            ["good", "bad1", "bad2"], cache=cache, runner=fake)
+    # the complete list, not just the first failure
+    assert "bad1" in str(exc.value) and "bad2" in str(exc.value)
+    assert "good" not in str(exc.value)
+
+
+def test_ssh_check_uses_cache(tmp_path):
+    cache = Cache(path=str(tmp_path / "c.json"))
+    first = FakeRun()
+    ssh_check.check_all_hosts_ssh_successful(["h1"], cache=cache,
+                                             runner=first)
+    assert len(first.calls) == 1
+    second = FakeRun()
+    ssh_check.check_all_hosts_ssh_successful(["h1"], cache=cache,
+                                             runner=second)
+    assert len(second.calls) == 0  # memoized success
+
+
+def test_ssh_check_does_not_cache_failures(tmp_path):
+    cache = Cache(path=str(tmp_path / "c.json"))
+    failing = FakeRun(fail_hosts={"h1"})
+    with pytest.raises(RuntimeError):
+        ssh_check.check_all_hosts_ssh_successful(["h1"], cache=cache,
+                                                 runner=failing)
+    recovered = FakeRun()
+    ssh_check.check_all_hosts_ssh_successful(["h1"], cache=cache,
+                                             runner=recovered)
+    assert len(recovered.calls) == 1  # re-probed after failure
+
+
+def test_ssh_port_in_command(tmp_path):
+    fake = FakeRun()
+    cache = Cache(path=str(tmp_path / "c.json"))
+    ssh_check.check_all_hosts_ssh_successful(["h1"], ssh_port=2222,
+                                             cache=cache, runner=fake)
+    assert "-p" in fake.calls[0] and "2222" in fake.calls[0]
+
+
+def test_host_hash_stable_and_salted():
+    a = hh.host_hash()
+    assert a == hh.host_hash()
+    assert hh.host_hash(salt="x") != hh.host_hash(salt="y")

@@ -1,0 +1,201 @@
+"""Process-mode (tcp) dtype matrix + stall/fusion/join combination tests
+(reference: the dtype x device sweep of ``test/test_torch.py`` run under
+``horovodrun --gloo``, and ``test_stall.py`` driven purely by env vars).
+
+The numpy data plane keeps 64-bit types exact here (the device-rank
+matrix in ``test_dtype_matrix.py`` covers the XLA-native types)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HVDRUN = os.path.join(REPO, "bin", "hvdrun")
+
+
+def _run_hvdrun(np_, script, extra_env=None, timeout=600):
+    path = "/tmp/hvd_tcp_matrix_worker.py"
+    with open(path, "w") as f:
+        f.write(script)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    if extra_env:
+        env.update(extra_env)
+    cmd = [sys.executable, HVDRUN, "-np", str(np_), sys.executable, path]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+DTYPE_WORKER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+ALL = ["float16", "bfloat16", "float32", "float64",
+       "int8", "int16", "int32", "int64", "uint8", "uint16",
+       "uint32", "uint64"]
+
+# -- allreduce sum, every dtype, star plane (exact accumulation) ---------
+for dtype in ALL:
+    # cast AFTER scaling: numpy promotes bf16*int to float32
+    data = ((np.arange(6) + 1) * (r + 1)).astype(dtype)
+    out = np.asarray(hvd.allreduce(data, op=hvd.Sum,
+                                   name=f"sum.{dtype}"))
+    assert str(out.dtype) == dtype, (out.dtype, dtype)
+    expect = (np.arange(6) + 1).astype(np.float64) * sum(
+        range(1, n + 1))
+    np.testing.assert_allclose(out.astype(np.float64), expect,
+                               rtol=2e-2 if "16" in dtype else 1e-9)
+
+# int64 exactness beyond float64's 2**53 (the star plane accumulates
+# integers in int64, never through floats)
+big = np.array([2**60 + r], dtype=np.int64)
+out = np.asarray(hvd.allreduce(big, op=hvd.Sum, name="i64exact"))
+assert int(out[0]) == sum(2**60 + i for i in range(n)), int(out[0])
+
+# -- broadcast every dtype ------------------------------------------------
+for dtype in ALL:
+    data = (np.arange(4) * (r + 2)).astype(dtype)
+    out = np.asarray(hvd.broadcast(data, root_rank=1,
+                                   name=f"bc.{dtype}"))
+    np.testing.assert_allclose(
+        out.astype(np.float64),
+        (np.arange(4) * 3).astype(dtype).astype(np.float64))
+
+# -- allgather with variable dims, 64-bit types ---------------------------
+for dtype in ["float64", "int64", "uint32"]:
+    data = np.full((r + 1, 2), r + 1).astype(dtype)
+    out = np.asarray(hvd.allgather(data, name=f"ag.{dtype}"))
+    expect = np.concatenate(
+        [np.full((i + 1, 2), i + 1) for i in range(n)]).astype(np.float64)
+    np.testing.assert_allclose(out.astype(np.float64), expect)
+
+# -- alltoall int64 -------------------------------------------------------
+t = (np.arange(2 * n) + 100 * r).astype(np.int64)
+out = np.asarray(hvd.alltoall(t, name="a2a.i64"))
+expect = np.concatenate(
+    [np.arange(2 * r, 2 * r + 2) + 100 * src for src in range(n)])
+np.testing.assert_allclose(out, expect)
+
+# -- ring plane sweep (threshold forced to 1KB) ---------------------------
+for dtype in ["float32", "float64", "int64"]:
+    data = np.full((70001,), 3).astype(dtype) * (r + 1)
+    out = np.asarray(hvd.allreduce(data, op=hvd.Sum,
+                                   name=f"ring.{dtype}"))
+    assert str(out.dtype) == dtype
+    np.testing.assert_allclose(
+        out.astype(np.float64),
+        np.full((70001,), 3 * sum(range(1, n + 1)), np.float64))
+
+# -- 0-d scalars over the wire -------------------------------------------
+out = hvd.allreduce(np.float64(1.5), op=hvd.Sum, name="sc64")
+assert np.asarray(out).ndim == 0
+assert float(np.asarray(out)) == 1.5 * n
+
+print(f"rank {r} TCP_DTYPES_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_tcp_dtype_matrix_2proc():
+    result = _run_hvdrun(2, DTYPE_WORKER,
+                         extra_env={"HVD_TCP_RING_THRESHOLD": "1024"})
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert result.stdout.count("TCP_DTYPES_OK") == 2
+
+
+STALL_WORKER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.common.handles import HvdError
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+assert n == 4
+
+# fusion-heavy traffic while rank 3 goes silent (neither submitting nor
+# joining — a join would legitimately complete the collective with zero
+# stand-ins): the stalled name must fail via stall shutdown WITHOUT
+# poisoning the healthy collectives or the later join barrier
+# (reference: StallInspector shutdown + Join interplay).
+import time
+handles = {}
+for i in range(6):
+    handles[i] = hvd.allreduce_async(jnp.ones((8,)) * (r + 1),
+                                     op=hvd.Sum, name=f"ok{i}")
+for i, h in handles.items():
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                               np.full((8,), 10.0))
+
+if r != 3:
+    try:
+        hvd.allreduce(jnp.ones((4,)), op=hvd.Sum, name="stalled")
+        raise SystemExit("expected stall shutdown error")
+    except HvdError as exc:
+        assert "stalled" in str(exc), str(exc)
+else:
+    time.sleep(8)  # silent through the 4s stall-shutdown window
+
+last = hvd.join()
+assert last in range(4)
+print(f"rank {r} STALL_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_tcp_stall_shutdown_with_fusion_and_join_4proc():
+    result = _run_hvdrun(4, STALL_WORKER, extra_env={
+        "HVD_STALL_CHECK_TIME_SECONDS": "1",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "4",
+    }, timeout=420)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert result.stdout.count("STALL_OK") == 4
+    assert "Stalled tensor" in (result.stdout + result.stderr)
+
+
+GROUPED_WORKER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+# grouped allreduce with mixed dtypes and mixed planes (some above the
+# 1KB ring threshold, some below)
+tensors = [
+    jnp.ones((4,), jnp.float32) * (r + 1),
+    jnp.ones((70000,), jnp.float32) * (r + 1),
+    jnp.ones((8,), jnp.int32) * (r + 1),
+    jnp.ones((70000,), jnp.float64) * (r + 1),
+]
+outs = hvd.grouped_allreduce(tensors, op=hvd.Sum, name="grp")
+for t, out in zip(tensors, outs):
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float64),
+        np.full(t.shape, float(sum(range(1, n + 1)))))
+
+print(f"rank {r} GROUPED_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_tcp_grouped_mixed_planes_4proc():
+    result = _run_hvdrun(4, GROUPED_WORKER,
+                         extra_env={"HVD_TCP_RING_THRESHOLD": "1024"})
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert result.stdout.count("GROUPED_OK") == 4
