@@ -180,9 +180,23 @@ def _bench_allreduce_bandwidth():
 
 
 def worker():
+    # watchdog: a held/unreachable TPU can make backend init BLOCK
+    # (not fail); bail out so the supervisor's retry loop stays snappy
+    import threading
+
+    ready = threading.Event()
+
+    def watchdog():
+        if not ready.wait(timeout=240):
+            sys.stderr.write("bench worker: backend init hung >240s\n")
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
     import jax
 
     devices = jax.devices()
+    ready.set()
     platform = devices[0].platform
 
     import horovod_tpu as hvd
@@ -222,7 +236,7 @@ def main():
                 [sys.executable, os.path.abspath(__file__), "--worker"],
                 env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, timeout=1800)
+                text=True, timeout=900)
         except subprocess.TimeoutExpired as exc:
             sys.stderr.write(
                 f"bench attempt {attempt + 1}/{attempts} timed out\n")

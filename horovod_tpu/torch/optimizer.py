@@ -33,6 +33,14 @@ class _DistributedOptimizerMixin:
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
+            names = [k for k, _ in named_parameters]
+            dups = {n for n in names if names.count(n) > 1}
+            if dups:
+                # duplicate names would silently pair the wrong tensors
+                # across ranks (reference: torch/__init__.py:84-90)
+                raise ValueError(
+                    f"named_parameters contains duplicate names: "
+                    f"{sorted(dups)}")
             self._parameter_names = {v: k for k, v in named_parameters}
         else:
             self._parameter_names = {
