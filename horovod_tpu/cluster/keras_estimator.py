@@ -1,0 +1,129 @@
+"""Keras estimator (reference: ``horovod/spark/keras/estimator.py:532``
+KerasEstimator): fit materializes the dataset to the Store, trains one
+worker per rank through the Keras binding (wrapped optimizer + broadcast
+callback + metric averaging), checkpoints weights to the store from
+rank 0, and returns a servable fitted model.
+
+The model travels to the workers as (serialized config, weights) —
+the same custom-serialization job the reference does for Spark task
+shipping (``keras/util.py``)."""
+
+import os
+
+import numpy as np
+
+from horovod_tpu.cluster.backend import InProcessBackend
+from horovod_tpu.cluster.store import LocalStore
+
+
+def _train_keras_rank(rank, model_config, weights, compile_kwargs,
+                      store, epochs, batch_size, learning_rate):
+    """Runs in a worker process (ProcessBackend) or rank thread."""
+    import keras
+
+    import horovod_tpu.keras as hvd_keras
+
+    model = keras.saving.deserialize_keras_object(model_config)
+    shard = store.load_shard(rank)
+    x, y = shard["x"], shard["y"]
+    if not model.built:
+        model.build((None,) + tuple(np.asarray(x).shape[1:]))
+    model.set_weights(weights)
+
+    optimizer = hvd_keras.DistributedOptimizer(
+        keras.optimizers.get({
+            "class_name": compile_kwargs.get("optimizer", "sgd"),
+            "config": {"learning_rate": learning_rate}}))
+    model.compile(optimizer=optimizer,
+                  loss=compile_kwargs.get("loss", "mse"),
+                  metrics=compile_kwargs.get("metrics"),
+                  run_eagerly=True)
+
+    callbacks = [
+        hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd_keras.callbacks.MetricAverageCallback(),
+    ]
+    history = model.fit(np.asarray(x), np.asarray(y),
+                        batch_size=batch_size, epochs=epochs,
+                        callbacks=callbacks, verbose=0)
+
+    if hvd_keras.rank() == 0:
+        path = store.checkpoint_path()
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, "keras_weights.npz"),
+                 *model.get_weights())
+    return float(history.history["loss"][-1])
+
+
+class KerasModel:
+    """Servable result of ``KerasEstimator.fit`` (reference: the fitted
+    Spark KerasModel with predict/evaluate)."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def predict(self, x):
+        return self.model.predict(np.asarray(x), verbose=0)
+
+    def evaluate(self, x, y):
+        return float(self.model.evaluate(np.asarray(x), np.asarray(y),
+                                         verbose=0))
+
+
+class KerasEstimator:
+    """Distributed trainer for a Keras model over a Store + Backend
+    (reference param subset: model, loss, optimizer, metrics, epochs,
+    batch_size, learning_rate, store, backend)."""
+
+    def __init__(self, model, loss="mse", optimizer="sgd", metrics=None,
+                 epochs=1, batch_size=32, learning_rate=0.01, store=None,
+                 backend=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.learning_rate = learning_rate
+        self.store = store
+        self.backend = backend
+
+    def fit(self, x, y):
+        import tempfile
+
+        import keras
+
+        store = self.store or LocalStore(tempfile.mkdtemp(
+            prefix="hvd_tpu_keras_estimator_"))
+        backend = self.backend or InProcessBackend(num_proc=1)
+        n = backend.num_processes()
+
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if len(x) < n:
+            raise ValueError(
+                f"need at least one sample per rank ({n}), got {len(x)}")
+        for rank, (xs, ys) in enumerate(
+                zip(np.array_split(x, n), np.array_split(y, n))):
+            store.save_shard(rank, {"x": xs, "y": ys})
+
+        if not self.model.built:
+            self.model.build((None,) + tuple(x.shape[1:]))
+        model_config = keras.saving.serialize_keras_object(self.model)
+        weights = self.model.get_weights()
+        compile_kwargs = {"loss": self.loss, "optimizer": self.optimizer,
+                          "metrics": self.metrics}
+
+        metrics = backend.run(
+            _train_keras_rank,
+            args=(model_config, weights, compile_kwargs, store,
+                  self.epochs, self.batch_size, self.learning_rate))
+
+        trained = keras.saving.deserialize_keras_object(model_config)
+        if not trained.built:
+            trained.build((None,) + tuple(x.shape[1:]))
+        with np.load(os.path.join(store.checkpoint_path(),
+                                  "keras_weights.npz")) as data:
+            trained.set_weights([data[k] for k in data.files])
+        trained.compile(loss=self.loss, metrics=self.metrics)
+        return KerasModel(trained), metrics

@@ -492,6 +492,14 @@ class GlobalMeshController(PythonController):
 
     def shutdown(self):
         super().shutdown()
+        from horovod_tpu.utils.timeline import publish_and_merge
+
+        publish_and_merge(self._pid, self._nproc,
+                          self._config.timeline_path, self._timeline,
+                          scope="timeline-gmesh")
+        if self._client_obj is not None:
+            self._client_obj.close()
+            self._client_obj = None
         if self._coordinator is not None:
             self._coordinator.shutdown()
             self._coordinator = None

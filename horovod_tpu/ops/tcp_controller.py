@@ -714,39 +714,7 @@ class TcpController:
 
     # -------------------------------------------------------------- timeline
     def _merge_timelines(self):
-        """Rank 0 merges every rank's per-process trace into the base
-        timeline path (reference: rank 0 writes one file for all)."""
-        base = self._config.timeline_path
-        addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
-        if not base or addr is None:
-            return
-        port = int(os.environ.get(env_util.HVD_RENDEZVOUS_PORT, "0"))
-        from horovod_tpu.run import http_client
-        from horovod_tpu.utils.timeline import merge_timeline_contents
+        from horovod_tpu.utils.timeline import publish_and_merge
 
-        self._timeline.close()
-        my_path = f"{base}.rank{self._rank}"
-        try:
-            with open(my_path) as f:
-                content = f.read()
-        except OSError:
-            content = "[]"
-        try:
-            http_client.put(addr, port, TIMELINE_SCOPE, str(self._rank),
-                            content.encode())
-        except OSError:
-            return
-        if self._rank == 0:
-            contents = {0: content}
-            for r in range(1, self._size):
-                try:
-                    contents[r] = http_client.get(
-                        addr, port, TIMELINE_SCOPE, str(r),
-                        timeout=20).decode()
-                except (OSError, TimeoutError, KeyError):
-                    self._log.warning(
-                        "timeline merge: rank %d trace unavailable", r)
-            try:
-                merge_timeline_contents(contents, base)
-            except (ValueError, OSError) as exc:
-                self._log.warning("timeline merge failed: %s", exc)
+        publish_and_merge(self._rank, self._size,
+                          self._config.timeline_path, self._timeline)

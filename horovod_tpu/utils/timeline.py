@@ -135,6 +135,48 @@ class Timeline:
             self._writer = None
 
 
+def publish_and_merge(rank, size, base_path, timeline, scope="timeline"):
+    """Rank-0 aggregation over the rendezvous KV: every rank uploads its
+    per-process trace; rank 0 merges them into ``base_path`` (reference:
+    rank 0 writes one timeline for all ranks, ``timeline.cc``).  Used by
+    both the tcp and global-mesh controllers at shutdown."""
+    import os
+
+    from horovod_tpu.run import http_client
+    from horovod_tpu.utils import env as env_util
+    from horovod_tpu.utils.logging import get_logger
+
+    addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
+    if not base_path or addr is None:
+        return
+    port = int(os.environ.get(env_util.HVD_RENDEZVOUS_PORT, "0"))
+
+    timeline.close()
+    my_path = f"{base_path}.rank{rank}"
+    try:
+        with open(my_path) as f:
+            content = f.read()
+    except OSError:
+        content = "[]"
+    try:
+        http_client.put(addr, port, scope, str(rank), content.encode())
+    except OSError:
+        return
+    if rank == 0:
+        contents = {0: content}
+        for r in range(1, size):
+            try:
+                contents[r] = http_client.get(addr, port, scope, str(r),
+                                              timeout=20).decode()
+            except (OSError, TimeoutError, KeyError):
+                get_logger().warning(
+                    "timeline merge: rank %d trace unavailable", r)
+        try:
+            merge_timeline_contents(contents, base_path)
+        except (ValueError, OSError) as exc:
+            get_logger().warning("timeline merge failed: %s", exc)
+
+
 def merge_timeline_contents(contents, out_path):
     """Merge per-rank chrome traces into one file (reference: rank 0
     writes a single timeline for all ranks, ``timeline.cc``).

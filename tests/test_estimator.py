@@ -103,3 +103,32 @@ def test_jax_estimator_fit_process_backend(tmp_path):
     baseline = float(np.mean((y - y.mean(0)) ** 2))
     assert fitted.evaluate(x, y) < baseline, \
         (fitted.evaluate(x, y), baseline)
+
+
+def test_keras_estimator_fit_process_backend(tmp_path):
+    """Keras estimator flavor (reference: spark/keras/estimator.py:532)
+    across 2 OS processes with the wrapped optimizer + broadcast +
+    metric-average callbacks."""
+    import pytest
+
+    pytest.importorskip("tensorflow")
+    import keras
+    import numpy as np
+    from horovod_tpu.cluster import KerasEstimator, LocalStore
+    from horovod_tpu.cluster.backend import ProcessBackend
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    w = rng.randn(8, 2).astype(np.float32)
+    y = x @ w + 0.01 * rng.randn(64, 2).astype(np.float32)
+
+    model = keras.Sequential([keras.layers.Dense(16, activation="relu"),
+                              keras.layers.Dense(2)])
+    est = KerasEstimator(model, loss="mse", optimizer="sgd", epochs=8,
+                         batch_size=8, learning_rate=0.02,
+                         store=LocalStore(str(tmp_path)),
+                         backend=ProcessBackend(2, jax_platform="cpu"))
+    fitted, metrics = est.fit(x, y)
+    assert len(metrics) == 2
+    baseline = float(np.mean((y - y.mean(0)) ** 2))
+    assert fitted.evaluate(x, y) < baseline
