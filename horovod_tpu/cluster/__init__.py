@@ -16,13 +16,10 @@ from horovod_tpu.cluster.estimator import (  # noqa: F401
     JaxEstimator,
     JaxModel,
 )
-try:  # Keras flavor activates when TF/Keras is importable
-    from horovod_tpu.cluster.keras_estimator import (  # noqa: F401
-        KerasEstimator,
-        KerasModel,
-    )
-except ImportError:  # pragma: no cover
-    pass
+from horovod_tpu.cluster.keras_estimator import (  # noqa: F401
+    KerasEstimator,
+    KerasModel,
+)
 from horovod_tpu.cluster.torch_estimator import (  # noqa: F401
     TorchEstimator,
     TorchModel,

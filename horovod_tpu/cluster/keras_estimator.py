@@ -66,8 +66,11 @@ class KerasModel:
         return self.model.predict(np.asarray(x), verbose=0)
 
     def evaluate(self, x, y):
-        return float(self.model.evaluate(np.asarray(x), np.asarray(y),
-                                         verbose=0))
+        result = self.model.evaluate(np.asarray(x), np.asarray(y),
+                                     verbose=0)
+        # keras returns [loss, *metrics] when metrics are compiled
+        return float(result[0] if isinstance(result, (list, tuple))
+                     else result)
 
 
 class KerasEstimator:
@@ -93,19 +96,13 @@ class KerasEstimator:
 
         import keras
 
+        from horovod_tpu.cluster.store import materialize_shards
+
         store = self.store or LocalStore(tempfile.mkdtemp(
             prefix="hvd_tpu_keras_estimator_"))
         backend = self.backend or InProcessBackend(num_proc=1)
         n = backend.num_processes()
-
-        x = np.asarray(x)
-        y = np.asarray(y)
-        if len(x) < n:
-            raise ValueError(
-                f"need at least one sample per rank ({n}), got {len(x)}")
-        for rank, (xs, ys) in enumerate(
-                zip(np.array_split(x, n), np.array_split(y, n))):
-            store.save_shard(rank, {"x": xs, "y": ys})
+        x, y = materialize_shards(store, x, y, n)
 
         if not self.model.built:
             self.model.build((None,) + tuple(x.shape[1:]))

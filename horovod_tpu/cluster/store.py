@@ -62,3 +62,23 @@ class LocalStore(Store):
 
     def exists(self, path):
         return os.path.exists(path)
+
+
+def materialize_shards(store, x, y, num_ranks):
+    """Split (x, y) into per-rank shards and persist them to the store
+    (the common front half of every estimator's ``fit``; reference: the
+    DataFrame->Parquet materialization in ``spark/common/store.py``).
+    Returns the arrays as numpy."""
+    import numpy as np
+
+    x = np.asarray(x)
+    y = np.asarray(y)
+    if len(x) < num_ranks:
+        raise ValueError(
+            f"need at least one sample per rank ({num_ranks}), "
+            f"got {len(x)}")
+    for rank, (xs, ys) in enumerate(
+            zip(np.array_split(x, num_ranks),
+                np.array_split(y, num_ranks))):
+        store.save_shard(rank, {"x": xs, "y": ys})
+    return x, y

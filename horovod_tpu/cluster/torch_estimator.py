@@ -107,14 +107,9 @@ class TorchEstimator:
         backend = self.backend or InProcessBackend()
         n = backend.num_processes()
 
-        x = np.asarray(x)
-        y = np.asarray(y)
-        if len(x) < n:
-            raise ValueError(
-                f"need at least one sample per rank ({n}), got {len(x)}")
-        for rank, (xs, ys) in enumerate(
-                zip(np.array_split(x, n), np.array_split(y, n))):
-            store.save_shard(rank, {"x": xs, "y": ys})
+        from horovod_tpu.cluster.store import materialize_shards
+
+        x, y = materialize_shards(store, x, y, n)
 
         metrics = backend.run(
             _train_one_rank,

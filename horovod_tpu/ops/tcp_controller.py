@@ -717,4 +717,5 @@ class TcpController:
         from horovod_tpu.utils.timeline import publish_and_merge
 
         publish_and_merge(self._rank, self._size,
-                          self._config.timeline_path, self._timeline)
+                          self._config.timeline_path, self._timeline,
+                          scope=TIMELINE_SCOPE)
