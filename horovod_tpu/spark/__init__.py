@@ -1,19 +1,21 @@
-"""Spark binding gate (reference: ``horovod/spark/__init__.py``).
+"""Spark attachment (reference: ``horovod/spark/__init__.py``).
 
-PySpark is not part of this image; the estimator framework itself —
-Store, Backend, JaxEstimator, TorchEstimator (reference §2.5 capabilities)
-— lives Spark-free in :mod:`horovod_tpu.cluster`.  A Spark deployment
-implements ``horovod_tpu.cluster.Backend.run`` over Spark tasks (the
-reference's ``backend.py:90`` shape) and reuses everything else.
+``horovod_tpu.spark.run(fn)`` executes a training fn inside Spark tasks
+(``runner.py``; requires PySpark, per-symbol import-guarded).  The
+estimator framework itself — Store, Backend, JaxEstimator,
+TorchEstimator, KerasEstimator — lives Spark-free in
+:mod:`horovod_tpu.cluster` (reference §2.5 capabilities); on a Spark
+cluster, pair those estimators with a Backend built on :func:`run`.
 """
 
-try:
-    import pyspark  # noqa: F401
-except ImportError as exc:  # pragma: no cover
-    raise ImportError(
-        "horovod_tpu.spark requires PySpark, which is not installed in "
-        "this environment. The estimator framework (Store / Backend / "
-        "JaxEstimator / TorchEstimator) is available Spark-free in "
-        "horovod_tpu.cluster; implement a Backend over Spark tasks to "
-        "attach it to a cluster."
-    ) from exc
+from horovod_tpu.spark.runner import run  # noqa: F401
+
+# estimator surface re-exported for reference-parity imports
+# (horovod.spark.keras.KerasEstimator etc. map here)
+from horovod_tpu.cluster import (  # noqa: F401
+    JaxEstimator,
+    KerasEstimator,
+    LocalStore,
+    Store,
+    TorchEstimator,
+)

@@ -132,3 +132,19 @@ def test_keras_estimator_fit_process_backend(tmp_path):
     assert len(metrics) == 2
     baseline = float(np.mean((y - y.mean(0)) ** 2))
     assert fitted.evaluate(x, y) < baseline
+
+
+def test_spark_module_imports_and_guards():
+    """The Spark attachment imports cleanly (estimator re-exports work
+    without pyspark) and run() raises with guidance when pyspark is
+    absent."""
+    import horovod_tpu.spark as hvd_spark
+
+    assert hvd_spark.JaxEstimator is not None
+    assert hvd_spark.KerasEstimator is not None
+    try:
+        import pyspark  # noqa: F401
+    except ImportError:
+        import pytest
+        with pytest.raises(ImportError, match="PySpark"):
+            hvd_spark.run(lambda: None)

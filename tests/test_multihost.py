@@ -192,3 +192,69 @@ def test_global_mesh_spmd_training_and_join():
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
     assert result.stdout.count("SPMD_TRAIN_OK") == 2
     assert result.stdout.count("GMESH_TRAIN_OK") == 2
+
+
+MATRIX_WORKER = r"""
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.common.basics import run_parallel
+
+hvd.init()
+pid = int(os.environ["HVD_RANK"])
+n = hvd.size()
+
+def per_rank(lr):
+    r = hvd.rank()
+    # dtype sweep over the compiled global-mesh plane
+    for dtype in ("float32", "bfloat16", "int32", "uint8"):
+        data = ((np.arange(6) % 3) + 1).astype(dtype)
+        out = np.asarray(hvd.allreduce(jnp.asarray(data), op=hvd.Sum,
+                                       name=f"gm.{dtype}"))
+        expect = (((np.arange(6) % 3) + 1) * n).astype(np.float64)
+        np.testing.assert_allclose(out.astype(np.float64), expect)
+
+    # grouped fusion burst across processes
+    handles = [hvd.allreduce_async(jnp.full((5,), float(r + 1)),
+                                   op=hvd.Sum, name=f"gfuse.{i}")
+               for i in range(12)]
+    for h in handles:
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                   np.full((5,), 36.0))
+
+    # 0-d scalar over the compiled plane
+    out = hvd.allreduce(jnp.float32(r), op=hvd.Sum, name="gm0d")
+    assert np.asarray(out).ndim == 0
+    assert float(np.asarray(out)) == sum(range(8))
+    return True
+
+assert all(run_parallel(per_rank))
+
+# hierarchical allreduce over the (cross, local) = (process, chip) mesh
+os.environ_backup = None
+from horovod_tpu.common import basics
+state = basics._get_state()
+assert state.executor.hier_mesh is not None, "expected 2-proc hier mesh"
+state.executor.hierarchical_allreduce = True
+
+def per_rank_hier(lr):
+    r = hvd.rank()
+    out = np.asarray(hvd.allreduce(jnp.full((33,), float(r + 1)),
+                                   op=hvd.Sum, name="gmhier"))
+    np.testing.assert_allclose(out, np.full((33,), 36.0))
+    return True
+
+assert all(run_parallel(per_rank_hier))
+print(f"proc {pid} GMESH_MATRIX_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_global_mesh_dtype_matrix_and_hierarchical():
+    result = _run_gmesh(MATRIX_WORKER)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert result.stdout.count("GMESH_MATRIX_OK") == 2
