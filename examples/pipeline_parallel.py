@@ -1,0 +1,75 @@
+"""GPipe-style pipeline parallelism over ``ppermute`` (SURVEY §2.7:
+absent from the reference; first-class here).  Stages are
+shape-preserving blocks laid out over the ``pp`` axis; microbatches
+stream through with the bubble the schedule implies.
+
+    python examples/pipeline_parallel.py --steps 10
+"""
+
+import argparse
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel import make_mesh, pipelined
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=15)
+    parser.add_argument("--d-model", type=int, default=64)
+    parser.add_argument("--microbatches", type=int, default=4)
+    args = parser.parse_args()
+
+    hvd.init()
+    n = len(jax.devices())
+    pp = 2 if n % 2 == 0 else 1
+    mesh = make_mesh({"pp": pp, "dp": n // pp})
+    d = args.d_model
+
+    def stage_fn(p, x):
+        w_up, w_down = p
+        return x + jax.nn.gelu(x @ w_up) @ w_down
+
+    rng = np.random.RandomState(0)
+    stacked = (
+        jnp.asarray(rng.randn(pp, d, 2 * d).astype(np.float32) * 0.1),
+        jnp.asarray(rng.randn(pp, 2 * d, d).astype(np.float32) * 0.1),
+    )
+    x = jnp.asarray(
+        rng.randn(args.microbatches, 2, 16, d).astype(np.float32))
+    target = jnp.tanh(x.sum(axis=-1, keepdims=True))
+
+    run = pipelined(stage_fn, mesh, axis_name="pp",
+                    stage_param_specs=P("pp"),
+                    data_spec=P(None, None, None, None))
+
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(stacked)
+
+    @jax.jit
+    def train_step(stacked, opt_state, x):
+        def loss_fn(ps):
+            out = run(ps, x)
+            return jnp.mean((out.sum(-1, keepdims=True) - target) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(stacked)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(stacked, updates), opt_state, loss
+
+    losses = []
+    for step in range(args.steps):
+        stacked, opt_state, loss = train_step(stacked, opt_state, x)
+        losses.append(float(np.asarray(jax.device_get(loss))))
+    print(f"loss {losses[0]:.4f} -> {losses[-1]:.4f}")
+    assert losses[-1] < losses[0]
+    print("PIPELINE_DONE")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Tensor-parallel transformer over a (dp, tp) mesh — Megatron-style
+weight sharding the reference never had (SURVEY §2.7: data parallelism
+only).  Sharding rules live in
+``horovod_tpu.parallel.tensor_parallel.transformer_sharding_rules``; XLA
+inserts the tp collectives.
+
+    python examples/tensor_parallel_transformer.py --steps 10
+"""
+
+import argparse
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import Transformer, TransformerConfig
+from horovod_tpu.parallel import make_mesh
+from horovod_tpu.parallel.tensor_parallel import shard_params
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--d-model", type=int, default=128)
+    parser.add_argument("--n-layers", type=int, default=2)
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--tp", type=int, default=2)
+    args = parser.parse_args()
+
+    hvd.init()
+    n = len(jax.devices())
+    tp = args.tp if n % args.tp == 0 else 1
+    mesh = make_mesh({"dp": n // tp, "tp": tp})
+
+    cfg = TransformerConfig(
+        vocab_size=512, n_layers=args.n_layers, d_model=args.d_model,
+        n_heads=4, d_ff=args.d_model * 4, max_len=args.seq_len,
+        dtype=jnp.float32)
+    model = Transformer(cfg)
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(
+        rng.randint(0, 512, (2 * (n // tp), args.seq_len)))
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    # qkv/up sharded column-wise over tp, out/down row-wise
+    params = shard_params(params, mesh)
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            labels = jnp.roll(tokens, -1, axis=-1)
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for step in range(args.steps):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss "
+                  f"{float(np.asarray(jax.device_get(loss))):.4f}")
+    print("TP_TRANSFORMER_DONE")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Timeline profiling demo (reference: the Horovod Timeline workflow —
+``HOROVOD_TIMELINE=file horovodrun ...`` then chrome://tracing).
+
+    HVD_TIMELINE=/tmp/trace.json python examples/timeline_profiling.py
+    hvdrun -np 2 python examples/timeline_profiling.py   # rank-0 merge
+"""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+
+
+def main():
+    path = os.environ.get("HVD_TIMELINE")
+    if not path:
+        path = os.path.join(tempfile.mkdtemp(), "trace.json")
+        os.environ["HVD_TIMELINE"] = path
+
+    hvd.init()
+
+    def per_rank(r):
+        for step in range(3):
+            for i, size in enumerate((1024, 4096, 65536)):
+                hvd.allreduce(jnp.ones((size,)) * (r + 1), op=hvd.Sum,
+                              name=f"grad.{i}.step{step}")
+        hvd.broadcast(jnp.ones((128,)), root_rank=0, name="sync")
+        return True
+
+    if basics._get_state().topology.local_size > 1:
+        basics.run_parallel(per_rank)
+    else:
+        per_rank(hvd.rank())
+
+    hvd.shutdown()
+
+    if os.path.exists(path):
+        with open(path) as f:
+            events = json.load(f)
+        phases = sorted({e.get("name") for e in events
+                         if e.get("ph") == "B"})
+        print(f"timeline: {path}")
+        print(f"events: {len(events)}, phases: {phases}")
+        print("open in chrome://tracing or ui.perfetto.dev")
+    print("TIMELINE_DEMO_DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,10 @@ def _run_example(name, *args, timeout=420):
                                 "--seq-len", "32")),
     ("ulysses_long_context.py", ("--seq-len", "256", "--head-dim", "16")),
     ("cluster_estimator.py", ("--epochs", "3",)),
+    ("tensor_parallel_transformer.py", ("--steps", "4", "--d-model",
+                                        "64", "--seq-len", "32")),
+    ("pipeline_parallel.py", ("--steps", "5",)),
+    ("timeline_profiling.py", ()),
 ])
 def test_example_runs(name, args):
     result = _run_example(name, *args)
