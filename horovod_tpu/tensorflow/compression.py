@@ -1,8 +1,8 @@
 """Gradient compression for the TensorFlow binding (reference:
 ``horovod/tensorflow/compression.py``): fp16-on-the-wire with
-decompression back to the source dtype.  On TPU the natural wire type is
-bfloat16 (no precision cliff on the MXU), so ``fp16`` here maps to
-bf16 — same redesign as the torch binding's compression."""
+decompression back to the source dtype, plus a TPU-native ``bf16``
+compressor (no precision cliff on the MXU) matching the common and
+torch compression surfaces."""
 
 import tensorflow as tf
 
@@ -21,13 +21,13 @@ class NoneCompressor(Compressor):
     pass
 
 
-class FP16Compressor(Compressor):
-    """Casts floating tensors to bfloat16 for transport."""
+class _CastCompressor(Compressor):
+    WIRE_DTYPE = None
 
-    @staticmethod
-    def compress(tensor):
-        if tensor.dtype.is_floating and tensor.dtype != tf.bfloat16:
-            return tf.cast(tensor, tf.bfloat16), tensor.dtype
+    @classmethod
+    def compress(cls, tensor):
+        if tensor.dtype.is_floating and tensor.dtype != cls.WIRE_DTYPE:
+            return tf.cast(tensor, cls.WIRE_DTYPE), tensor.dtype
         return tensor, None
 
     @staticmethod
@@ -37,9 +37,18 @@ class FP16Compressor(Compressor):
         return tensor
 
 
+class FP16Compressor(_CastCompressor):
+    WIRE_DTYPE = tf.float16
+
+
+class BF16Compressor(_CastCompressor):
+    WIRE_DTYPE = tf.bfloat16
+
+
 class Compression:
     """Namespace matching the reference API (``Compression.none`` /
-    ``Compression.fp16``)."""
+    ``Compression.fp16``) plus the TPU-native ``bf16``."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
+    bf16 = BF16Compressor

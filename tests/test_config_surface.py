@@ -1,0 +1,131 @@
+"""Config tri-surface precedence + compression round-trips (reference:
+the env/CLI/YAML tri-surface kept in sync manually, ``runner.py:285-459``
++ ``config_parser.py``; compression: ``torch/compression.py:45``)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.run import config_parser
+from horovod_tpu.run.runner import make_parser
+from horovod_tpu.utils import env as env_util
+
+
+def _parse(argv):
+    return make_parser().parse_args(argv + ["python", "x.py"])
+
+
+def test_cli_flag_maps_to_env():
+    args = _parse(["-np", "2", "--fusion-threshold-mb", "16",
+                   "--cycle-time-ms", "2.5", "--cache-capacity", "99"])
+    env = config_parser.env_from_args(args)
+    assert env[env_util.HVD_FUSION_THRESHOLD] == str(16 * 1024 * 1024)
+    assert env[env_util.HVD_CYCLE_TIME] == "2.5"
+    assert env[env_util.HVD_CACHE_CAPACITY] == "99"
+
+
+def test_yaml_fills_unset_cli_flags(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        "params:\n"
+        "  fusion_threshold_mb: 8\n"
+        "  cycle_time_ms: 7.0\n"
+        "autotune:\n"
+        "  enabled: true\n"
+        "timeline:\n"
+        "  filename: /tmp/t.json\n")
+    args = _parse(["-np", "2", "--cycle-time-ms", "1.5"])
+    config_parser.apply_config_to_args(
+        args, config_parser.load_config_file(str(cfg)))
+    env = config_parser.env_from_args(args)
+    # CLI wins over YAML; YAML fills the rest
+    assert env[env_util.HVD_CYCLE_TIME] == "1.5"
+    assert env[env_util.HVD_FUSION_THRESHOLD] == str(8 * 1024 * 1024)
+    assert env[env_util.HVD_AUTOTUNE] == "1"
+    assert env[env_util.HVD_TIMELINE] == "/tmp/t.json"
+
+
+def test_stall_and_log_flags_map():
+    args = _parse(["-np", "2", "--no-stall-check",
+                   "--stall-check-warning-time-seconds", "11",
+                   "--stall-check-shutdown-time-seconds", "22",
+                   "--log-level", "debug"])
+    env = config_parser.env_from_args(args)
+    assert env[env_util.HVD_STALL_CHECK_DISABLE] == "1"
+    assert env[env_util.HVD_STALL_CHECK_TIME_SECONDS] == "11.0"
+    assert env[env_util.HVD_STALL_SHUTDOWN_TIME_SECONDS] == "22.0"
+    assert env[env_util.HVD_LOG_LEVEL] == "debug"
+
+
+def test_config_from_env_roundtrip(monkeypatch):
+    from horovod_tpu.common.config import Config
+
+    monkeypatch.setenv(env_util.HVD_FUSION_THRESHOLD, "1048576")
+    monkeypatch.setenv(env_util.HVD_CYCLE_TIME, "3.0")
+    monkeypatch.setenv(env_util.HVD_STALL_CHECK_TIME_SECONDS, "9")
+    cfg = Config.from_env()
+    assert cfg.fusion_threshold_bytes == 1048576
+    assert cfg.cycle_time_ms == 3.0
+    assert cfg.stall_warning_seconds == 9
+
+
+# ------------------------------------------------------------- compression --
+def test_jax_compression_roundtrip(hvd):
+    from horovod_tpu.common.compression import Compression
+
+    import jax.numpy as jnp
+
+    x = jnp.linspace(-3, 3, 64, dtype=jnp.float32)
+    comp, ctx = Compression.fp16.compress(x)
+    assert str(np.asarray(comp).dtype) == "float16"
+    out = Compression.fp16.decompress(comp, ctx)
+    assert str(np.asarray(out).dtype) == "float32"
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=0.05)
+
+    comp, ctx = Compression.bf16.compress(x)
+    assert str(np.asarray(comp).dtype) == "bfloat16"
+    out = Compression.bf16.decompress(comp, ctx)
+    assert str(np.asarray(out).dtype) == "float32"
+
+    comp, ctx = Compression.none.compress(x)
+    np.testing.assert_array_equal(
+        np.asarray(Compression.none.decompress(comp, ctx)), x)
+
+
+def test_torch_compression_roundtrip():
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.torch.compression import Compression
+
+    x = torch.linspace(-3, 3, 64)
+    comp, ctx = Compression.fp16.compress(x)
+    assert comp.dtype == torch.float16
+    out = Compression.fp16.decompress(comp, ctx)
+    assert out.dtype == torch.float32
+    assert torch.allclose(out, x, atol=0.05)
+    comp, ctx = Compression.bf16.compress(x)
+    assert comp.dtype == torch.bfloat16
+
+
+def test_tf_compression_roundtrip():
+    tf = pytest.importorskip("tensorflow")
+    from horovod_tpu.tensorflow.compression import Compression
+
+    x = tf.linspace(-3.0, 3.0, 64)
+    comp, ctx = Compression.fp16.compress(x)
+    assert comp.dtype == tf.float16
+    out = Compression.fp16.decompress(comp, ctx)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), x.numpy(), atol=0.05)
+    comp, ctx = Compression.bf16.compress(x)
+    assert comp.dtype == tf.bfloat16
+
+
+def test_int_tensors_pass_compression_untouched():
+    from horovod_tpu.common.compression import Compression
+
+    import jax.numpy as jnp
+
+    x = jnp.arange(10, dtype=jnp.int32)
+    comp, ctx = Compression.fp16.compress(x)
+    out = Compression.fp16.decompress(comp, ctx)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    assert np.asarray(out).dtype == np.int32
