@@ -1,0 +1,77 @@
+"""Data-parallel MNIST (MXNet binding).
+
+Mirrors the reference's ``examples/mxnet_mnist.py``: gluon model,
+``DistributedTrainer``, parameter broadcast, per-rank shard.  Synthetic
+data keeps it offline-runnable.  Exits cleanly with a notice when MXNet
+is not installed (it is EOL and absent from most modern images).
+
+    hvdrun -np 2 python examples/mxnet_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+
+try:
+    import mxnet as mx
+    from mxnet import autograd, gluon
+except ImportError:
+    mx = None
+
+
+def parse_args():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--num-samples", type=int, default=1024)
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    if mx is None:
+        print("MXNet is not installed; this example requires the "
+              "(EOL) mxnet package. Skipping.")
+        return
+
+    import horovod_tpu.mxnet as hvd
+
+    hvd.init()
+    mx.random.seed(42)
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+
+    # identical start everywhere, LR scaled by world size
+    params = net.collect_params()
+    net(mx.nd.zeros((1, 784)))  # materialize before broadcast
+    hvd.broadcast_parameters(params, root_rank=0)
+    trainer = hvd.DistributedTrainer(
+        params, "sgd", {"learning_rate": args.lr * hvd.size()})
+
+    rng = np.random.RandomState(hvd.rank())
+    x = mx.nd.array(rng.rand(args.num_samples, 784))
+    y = mx.nd.array(rng.randint(0, 10, (args.num_samples,)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total = 0.0
+        for i in range(0, args.num_samples, args.batch_size):
+            xb, yb = x[i:i + args.batch_size], y[i:i + args.batch_size]
+            with autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(args.batch_size)
+            total += float(loss.mean().asscalar())
+        avg = hvd.allreduce(mx.nd.array([total]), name=f"el.{epoch}")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {float(avg.asscalar()):.4f}")
+    print("MXNET MNIST DONE")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Shared steady-state signature cache (reference:
+``horovod/common/response_cache.cc`` — LRU of validated tensor
+signatures; a name whose every rank resubmits the signature of the last
+validated round skips re-validation).
+
+One implementation shared by the python and tcp controllers so
+HIT/MISS/eviction semantics cannot drift between them.  Signatures are
+opaque hashables: the python controller uses parameter tuples, the tcp
+controller wire-digest bytes.  The gmesh controller deliberately has no
+signature cache: its coordinator round-trip is already a long-polled
+append-only log (O(names) metadata, amortized across cycles) and its
+steady-state fast path is the executor's compiled-program cache.
+"""
+
+from collections import OrderedDict
+
+
+class SignatureCache:
+    """name -> last validated signature, LRU-bounded.
+
+    States map onto the reference's MISS/HIT/INVALID:
+    - ``check`` True  == HIT (skip validation),
+    - ``check`` False == MISS (validate, then ``store``),
+    - ``evict``       == INVALID (stalled or signature changed).
+    """
+
+    def __init__(self, capacity=1024):
+        self._entries = OrderedDict()
+        self._capacity = capacity
+        self.hits = 0
+
+    def check(self, name, sigs) -> bool:
+        """True iff every rank's signature agrees and matches the cached
+        one.  ``sigs`` is the set (or iterable) of per-rank signatures;
+        ``None`` (signature unavailable) never matches."""
+        sigs = set(sigs)
+        if len(sigs) != 1 or None in sigs:
+            return False
+        cached = self._entries.get(name)
+        if cached is not None and cached == next(iter(sigs)):
+            self._entries.move_to_end(name)
+            self.hits += 1
+            return True
+        return False
+
+    def store(self, name, sigs):
+        """Record a validated round's signature; only when all ranks
+        agreed (a mixed set means validation rejected or per-rank shapes
+        legitimately differ, e.g. variable-dim0 allgather)."""
+        sigs = set(sigs)
+        if len(sigs) != 1 or None in sigs:
+            return
+        self._entries[name] = next(iter(sigs))
+        self._entries.move_to_end(name)
+        while len(self._entries) > self._capacity:
+            self._entries.popitem(last=False)
+
+    def evict(self, name):
+        self._entries.pop(name, None)
+
+    def __len__(self):
+        return len(self._entries)

@@ -17,6 +17,10 @@ Also hosts the reference's auxiliary semantics:
 - **StallInspector** (``stall_inspector.cc``): warn when some ranks submitted
   a tensor and others didn't for longer than the stall window; optionally
   shut down.
+- **ResponseCache** (``response_cache.cc``): steady-state tensors whose
+  signature (type/dtype/shape/op/root/scales) is unchanged since the last
+  cycle skip cross-rank validation entirely; stalled names are evicted
+  (reference: ``stall_inspector.cc`` InvalidateStalledCachedTensors).
 - **Timeline** phases NEGOTIATE_* / op activities.
 """
 
@@ -27,6 +31,7 @@ import time
 import numpy as np
 
 from horovod_tpu.common.ops_enum import ReduceOp, RequestType
+from horovod_tpu.common.response_cache import SignatureCache
 from horovod_tpu.utils.logging import get_logger
 
 
@@ -42,6 +47,17 @@ class EagerRequest:
     prescale_factor: float = 1.0
     postscale_factor: float = 1.0
     splits: list | None = None
+
+    def signature(self):
+        """Everything validation checks, flattened into a hashable key
+        (reference: ``response_cache.h:45`` — cache key is tensor name +
+        params)."""
+        tensor = self.tensor
+        shape = tuple(tensor.shape) if tensor is not None else None
+        dtype = np.dtype(tensor.dtype).name if tensor is not None else None
+        return (self.req_type, dtype, shape, self.op, self.root_rank,
+                self.prescale_factor, self.postscale_factor,
+                tuple(self.splits) if self.splits is not None else None)
 
 
 class _NameEntry:
@@ -96,6 +112,12 @@ class PythonController:
         self._shutdown_error = None
         self._thread = None
         self._log = get_logger()
+        self._sig_cache = SignatureCache(
+            getattr(config, "cache_capacity", 1024))
+
+    @property
+    def cache_hits(self):
+        return self._sig_cache.hits
 
     # ----------------------------------------------------------- producer API
     def start(self):
@@ -202,7 +224,12 @@ class PythonController:
         for name in ready_names:
             entry = self._table.pop(name)
             self._timeline.end(name)
-            group = self._construct_response(name, entry)
+            if self._cache_check(name, entry):
+                group = self._build_group(name, entry)
+            else:
+                group = self._construct_response(name, entry)
+                if group is not None:
+                    self._cache_store(name, entry)
             if group is not None:
                 responses.append((entry.req_type, group))
 
@@ -219,6 +246,38 @@ class PythonController:
                     handle.set_result(last)
                 self._join_handles.clear()
                 self._joined.clear()
+
+    # ---------------------------------------------------------- response cache
+    def _cache_check(self, name, entry) -> bool:
+        """Fast path (reference: ``response_cache.cc`` HIT): every rank's
+        request carries the same signature as the last validated cycle for
+        this name — skip validation.  Never taken while ranks have joined
+        (zero stand-ins change response construction)."""
+        if self._joined_view:
+            return False
+        return self._sig_cache.check(
+            name, (r.signature() for r in entry.requests.values()))
+
+    def _cache_store(self, name, entry):
+        self._sig_cache.store(
+            name, (r.signature() for r in entry.requests.values()))
+
+    def _build_group(self, name, entry):
+        """Build the executor GroupEntry from an already-validated (or
+        cache-hit) table entry."""
+        requests = entry.requests
+        any_req = next(iter(requests.values()))
+        tensors = {rank: r.tensor for rank, r in requests.items()}
+        for joined_rank in self._joined_view:
+            tensors.setdefault(joined_rank, None)
+        return GroupEntry(
+            name=name, shape=tuple(any_req.tensor.shape),
+            dtype=any_req.tensor.dtype, tensors=tensors,
+            handles={rank: r.handle for rank, r in requests.items()},
+            root_rank=any_req.root_rank,
+            splits={rank: r.splits for rank, r in requests.items()},
+            op=any_req.op, prescale_factor=any_req.prescale_factor,
+            postscale_factor=any_req.postscale_factor)
 
     # ------------------------------------------------------------- validation
     def _construct_response(self, name, entry):
@@ -249,10 +308,6 @@ class PythonController:
         if len(dtypes) > 1:
             return error(
                 f"mismatched dtypes for tensor '{name}': {sorted(dtypes)}")
-
-        any_req = next(iter(requests.values()))
-        shape = tuple(any_req.tensor.shape)
-        dtype = any_req.tensor.dtype
 
         if req_type in (RequestType.ALLREDUCE, RequestType.ADASUM):
             ops = {r.op for r in requests.values()}
@@ -301,16 +356,7 @@ class PythonController:
                         f"{sum(r.splits)} != first dimension "
                         f"{r.tensor.shape[0]}")
 
-        tensors = {rank: r.tensor for rank, r in requests.items()}
-        for joined_rank in self._joined_view:
-            tensors.setdefault(joined_rank, None)
-        handles = {rank: r.handle for rank, r in requests.items()}
-        return GroupEntry(
-            name=name, shape=shape, dtype=dtype, tensors=tensors,
-            handles=handles, root_rank=any_req.root_rank,
-            splits={rank: r.splits for rank, r in requests.items()},
-            op=any_req.op, prescale_factor=any_req.prescale_factor,
-            postscale_factor=any_req.postscale_factor)
+        return self._build_group(name, entry)
 
     # ----------------------------------------------------------------- fusion
     def _dispatch(self, responses):
@@ -401,6 +447,8 @@ class PythonController:
                     "Stalled tensor: %s ready ranks: %s, waiting on: %s",
                     int(warn_after), name, ready, missing)
                 entry.stall_warned = True
+                # reference: stall_inspector.cc InvalidateStalledCachedTensors
+                self._sig_cache.evict(name)
             if shutdown_after > 0 and age > shutdown_after:
                 message = (f"stalled tensor '{name}' exceeded shutdown "
                            f"threshold of {shutdown_after}s")

@@ -38,12 +38,12 @@ import hashlib
 import os
 import threading
 import time
-from collections import OrderedDict
 
 import numpy as np
 
 from horovod_tpu.common.ops_enum import (ReduceOp, RequestType,
                                          is_float_dtype)
+from horovod_tpu.common.response_cache import SignatureCache
 from horovod_tpu.ops.tcp_dataplane import (DEFAULT_RING_THRESHOLD,
                                            PeerService, RingPlane)
 from horovod_tpu.run.service import network
@@ -169,10 +169,8 @@ class CoordinatorService(network.MuxService):
         self._forming = {}          # name -> _Entry
         self._joined = set()
         self._join_waiters = []     # (rank, Event, [last_rank])
-        self._sig_cache = OrderedDict()  # name -> signature (LRU)
+        self._sig_cache = SignatureCache(cache_capacity)
         self._ring_seq = 0               # unique id per ring round
-        self._cache_capacity = cache_capacity
-        self.cache_hits = 0
         self._log = get_logger()
         super().__init__(self.NAME, key)
 
@@ -216,6 +214,8 @@ class CoordinatorService(network.MuxService):
                                if r not in entry.requests
                                and r not in self._joined]
                     entry.stall_warned = True
+                    # reference: InvalidateStalledCachedTensors
+                    self._sig_cache.evict(req.name)
                 self._log.warning(
                     "Stalled tensor: %s ready ranks: %s, waiting on: %s "
                     "for more than %ds", req.name,
@@ -279,27 +279,20 @@ class CoordinatorService(network.MuxService):
         entry.results = results
         entry.done.set()
 
+    @property
+    def cache_hits(self):
+        return self._sig_cache.hits
+
     def _cache_check(self, name, entry) -> bool:
         """Response-cache fast path (reference: response_cache.cc) — a
         steady-state name whose every rank resubmits the exact signature
         of the last validated round skips re-validation."""
-        sigs = {r.sig for r in entry.requests.values()}
-        if len(sigs) != 1 or None in sigs:
-            return False
-        cached = self._sig_cache.get(name)
-        if cached is not None and cached == next(iter(sigs)):
-            self._sig_cache.move_to_end(name)
-            self.cache_hits += 1
-            return True
-        return False
+        return self._sig_cache.check(
+            name, (r.sig for r in entry.requests.values()))
 
     def _cache_store(self, name, entry):
-        sigs = {r.sig for r in entry.requests.values()}
-        if len(sigs) == 1 and None not in sigs:
-            self._sig_cache[name] = next(iter(sigs))
-            self._sig_cache.move_to_end(name)
-            while len(self._sig_cache) > self._cache_capacity:
-                self._sig_cache.popitem(last=False)
+        self._sig_cache.store(
+            name, (r.sig for r in entry.requests.values()))
 
     def _execute(self, name, entry):
         reqs = entry.requests

@@ -10,6 +10,8 @@ deltas instead of gradients (reference: ``_DistributedAdasumOptimizer``,
 torch/__init__.py:225).
 """
 
+import contextlib
+
 import torch
 
 from horovod_tpu.common.ops_enum import Adasum, Average, ReduceOp
@@ -78,6 +80,23 @@ class _DistributedOptimizerMixin:
             mpi_ops.synchronize(handle)
             self._allreduce_delay[p] = self._backward_passes_per_step
         self._handles.clear()
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """Use after an explicit ``synchronize()`` (e.g. to clip the
+        averaged gradients) so ``step()`` doesn't wait a second time
+        (reference: torch/__init__.py:185-202)::
+
+            optimizer.synchronize()
+            torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+            with optimizer.skip_synchronize():
+                optimizer.step()
+        """
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
 
     def step(self, closure=None):
         if self._should_synchronize:

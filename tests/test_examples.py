@@ -51,6 +51,11 @@ def _run_example(name, *args, timeout=420):
                                         "64", "--seq-len", "32")),
     ("pipeline_parallel.py", ("--steps", "5",)),
     ("timeline_profiling.py", ()),
+    ("jax_word2vec.py", ("--corpus-len", "4000", "--epochs", "1",
+                         "--batch-size", "512", "--vocab-size", "500")),
+    ("adasum_bench.py", ("--steps", "10", "--lrs", "0.05", "0.2",
+                         "--tp-bytes", "65536")),
+    ("mxnet_mnist.py", ()),  # prints a clean notice when mxnet absent
 ])
 def test_example_runs(name, args):
     result = _run_example(name, *args)
@@ -113,6 +118,15 @@ def test_torch_synthetic_benchmark_under_hvdrun():
     assert "Img/sec per rank" in result.stdout
 
 
+def test_torch_imagenet_resnet50_under_hvdrun():
+    result = _run_example_hvdrun(
+        "torch_imagenet_resnet50.py", "--epochs", "1", "--batch-size",
+        "2", "--num-samples", "4", "--img", "64", "--num-classes", "10")
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert result.stdout.count("RESNET50 DONE") == 2
+
+
 def test_tf2_examples_under_hvdrun():
     import pytest
     pytest.importorskip("tensorflow")
@@ -122,6 +136,9 @@ def test_tf2_examples_under_hvdrun():
         ("tensorflow2_keras_mnist.py", ("--epochs", "1",
                                         "--batch-size", "64",
                                         "--num-samples", "256")),
+        ("keras_mnist_advanced.py", ("--epochs", "2", "--batch-size",
+                                     "64", "--num-samples", "256",
+                                     "--warmup-epochs", "1")),
         ("tensorflow2_synthetic_benchmark.py",
          ("--model", "small", "--batch-size", "4", "--img", "32",
           "--num-iters", "1", "--num-batches-per-iter", "2")),

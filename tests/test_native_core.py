@@ -88,3 +88,69 @@ def test_controller_parity(controller):
     expected = ("NativeController" if controller == "native"
                 else "PythonController")
     assert f"OK {expected}" in result.stdout
+
+
+PY_CACHE_SCRIPT = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.common.handles import HvdError
+
+hvd.init()
+controller = basics._get_state().controller
+
+# steady state: same name + signature 3x -> first cycle validates (MISS),
+# the next two take the cache fast path (HIT)
+def fn(r):
+    for i in range(3):
+        out = np.asarray(hvd.allreduce(jnp.full((4,), float(r)),
+                                       op=hvd.Sum, name="steady"))
+        assert np.allclose(out, 28.0), out
+basics.run_parallel(fn)
+assert controller.cache_hits == 2, controller.cache_hits
+
+# signature change (shape) invalidates: next call re-validates, no new hit
+def fn2(r):
+    out = np.asarray(hvd.allreduce(jnp.full((8,), float(r)),
+                                   op=hvd.Sum, name="steady"))
+    assert np.allclose(out, 28.0), out
+basics.run_parallel(fn2)
+assert controller.cache_hits == 2, controller.cache_hits
+
+# a cached name must still error on cross-rank mismatch (slow path
+# re-engages because signatures differ between ranks)
+def fn3(r):
+    shape = (2,) if r == 0 else (3,)
+    try:
+        hvd.allreduce(jnp.ones(shape), op=hvd.Sum, name="steady")
+    except HvdError:
+        return "raised"
+    return "no-error"
+results = basics.run_parallel(fn3)
+assert all(x == "raised" for x in results), results
+assert controller.cache_hits == 2, controller.cache_hits
+
+hvd.shutdown()
+print("PY-CACHE OK")
+"""
+
+
+def test_python_controller_response_cache():
+    """The eager device-rank python controller has the reference's
+    steady-state fast path (response_cache.cc): repeat submissions with an
+    unchanged signature skip validation; signature changes or cross-rank
+    mismatches re-engage it."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "HVD_CONTROLLER": "python",
+    })
+    result = subprocess.run([sys.executable, "-c", PY_CACHE_SCRIPT], env=env,
+                            capture_output=True, text=True, timeout=300,
+                            cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert result.returncode == 0, result.stderr
+    assert "PY-CACHE OK" in result.stdout

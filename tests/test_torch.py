@@ -494,3 +494,102 @@ def test_sync_batch_norm_training_updates_running_stats():
     expected = 0.9 * torch.zeros(3) + 0.1 * full.mean(dim=(0, 2))
     for mean in means:
         assert torch.allclose(mean, expected, atol=1e-5)
+
+
+def test_skip_synchronize_gradient_clipping_pattern():
+    """The reference's documented clipping recipe (torch/__init__.py:
+    185-202): synchronize() -> clip the *averaged* grads ->
+    step() under skip_synchronize().  Replicas must stay identical and
+    the clip must bite the averaged gradient."""
+    torch.manual_seed(0)
+    base = torch.nn.Linear(6, 3)
+    state = {k: v.clone() for k, v in base.state_dict().items()}
+
+    def fn(r):
+        model = torch.nn.Linear(6, 3)
+        model.load_state_dict(state)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters())
+
+        rng = np.random.RandomState(r)
+        x = torch.tensor(rng.randn(4, 6), dtype=torch.float32)
+        y = torch.tensor(rng.randn(4, 3), dtype=torch.float32)
+
+        opt.zero_grad()
+        ((model(x) - y) ** 2).mean().backward()
+        opt.synchronize()
+        norm = torch.nn.utils.clip_grad_norm_(model.parameters(), 1e-4)
+        with opt.skip_synchronize():
+            opt.step()
+        # post-clip gradient norm respected
+        total = torch.sqrt(sum((p.grad ** 2).sum()
+                               for p in model.parameters()))
+        digest = float(sum(p.double().sum() for p in model.parameters()))
+        return float(total), digest
+
+    results = _per_rank(fn)
+    for total, _ in results:
+        assert total <= 1.1e-4
+    digests = [d for _, d in results]
+    assert all(abs(d - digests[0]) < 1e-9 for d in digests), digests
+
+
+def test_distributed_optimizer_fp16_compression_end_to_end():
+    """Wire compression through the optimizer hot path: grads go over
+    float16 (torch Compression.fp16) and come back f32; replicas
+    converge identically."""
+    from horovod_tpu.torch.compression import Compression
+
+    torch.manual_seed(1)
+    base = torch.nn.Linear(5, 2)
+    state = {k: v.clone() for k, v in base.state_dict().items()}
+
+    def fn(r):
+        model = torch.nn.Linear(5, 2)
+        model.load_state_dict(state)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters(),
+            compression=Compression.fp16)
+        rng = np.random.RandomState(r + 10)
+        for _ in range(3):
+            x = torch.tensor(rng.randn(8, 5), dtype=torch.float32)
+            y = torch.tensor(rng.randn(8, 2), dtype=torch.float32)
+            opt.zero_grad()
+            ((model(x) - y) ** 2).mean().backward()
+            opt.step()
+        for p in model.parameters():
+            assert p.dtype == torch.float32
+        return float(sum(p.double().sum() for p in model.parameters()))
+
+    digests = _per_rank(fn)
+    assert all(abs(d - digests[0]) < 1e-9 for d in digests), digests
+
+
+def test_distributed_optimizer_sum_op_scales_like_reference():
+    """op=Sum: the applied gradient is the sum over ranks (reference
+    translates Average as Sum+div; Sum applies no divisor)."""
+    base = torch.nn.Linear(1, 1, bias=False)
+    with torch.no_grad():
+        base.weight.fill_(0.0)
+    state = {k: v.clone() for k, v in base.state_dict().items()}
+
+    def fn(r):
+        model = torch.nn.Linear(1, 1, bias=False)
+        model.load_state_dict(state)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=1.0),
+            named_parameters=model.named_parameters(), op=hvd.Sum)
+        # d/dw of (w * 1 - target)^2 = 2(w - target); per rank target
+        # chosen so grad_r = r + 1 at w=0
+        target = -(r + 1) / 2.0
+        x = torch.ones(1, 1)
+        opt.zero_grad()
+        ((model(x) - target) ** 2).sum().backward()
+        opt.step()
+        return float(model.weight)
+
+    expected = -float(sum(range(1, N + 1)))  # w = 0 - lr * sum(grad_r)
+    for w in _per_rank(fn):
+        assert abs(w - expected) < 1e-5, (w, expected)

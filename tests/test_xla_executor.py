@@ -1,0 +1,129 @@
+"""XLA executor internals: one-executable steady state (the ResponseCache
+idea mapped onto XLA's compilation model — ``xla_executor.py`` module
+doc), compiled alltoall (VERDICT r1 item 5), and fusion-bucket numerics
+at alignment edges (reference: 64-elem alignment,
+``controller.cc:358-376``)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from horovod_tpu.common import basics
+
+N = 8
+
+
+def _per_rank(fn):
+    return basics.run_parallel(fn)
+
+
+def _executor(hvd):
+    return basics._get_state().executor
+
+
+def test_alltoall_is_one_compiled_program_reused(hvd):
+    """Steady-state alltoall compiles once (pad/exchange/unpack cached by
+    splits signature) and the cache does not grow on reuse."""
+    executor = _executor(hvd)
+    splits = [2] * N
+
+    def fn(r):
+        data = jnp.asarray(
+            np.arange(2 * N * 3, dtype=np.float32).reshape(2 * N, 3)
+            + 1000 * r)
+        outs = []
+        for i in range(3):
+            out = hvd.alltoall(data, splits=splits, name="exec.a2a")
+            outs.append(np.asarray(out))
+        return outs
+
+    before = len(executor._alltoall_cache)
+    results = _per_rank(fn)
+    after = len(executor._alltoall_cache)
+    # one new signature -> exactly one cache entry for all three calls
+    assert after - before == 1
+    # correctness: rank r's block from each source, stacked in source order
+    for r, outs in enumerate(results):
+        expected = np.concatenate([
+            np.arange(2 * N * 3, dtype=np.float32).reshape(2 * N, 3)[
+                2 * r:2 * r + 2] + 1000 * s
+            for s in range(N)])
+        for out in outs:
+            np.testing.assert_allclose(out, expected)
+
+
+def test_allreduce_executable_cache_stable_across_steps(hvd):
+    """The training steady state — same bucket signature every step —
+    must not recompile: the executor's program cache stays flat."""
+    executor = _executor(hvd)
+
+    # A single named tensor per step has a deterministic bucket signature
+    # (multi-tensor bursts can legitimately split differently across
+    # cycles depending on arrival timing, as in the reference).
+    def step(r, s):
+        return np.asarray(hvd.allreduce(
+            jnp.full((1023,), float(r + s)), op=hvd.Sum, name="steady"))
+
+    _per_rank(lambda r: step(r, 0))
+    size_after_first = len(executor._allreduce_cache)
+    for s in range(1, 5):
+        outs = _per_rank(lambda r, s=s: step(r, s))
+        expected = float(sum(r + s for r in range(N)))
+        np.testing.assert_allclose(outs[0], np.full((1023,), expected))
+    assert len(executor._allreduce_cache) == size_after_first
+
+
+def test_fusion_alignment_edge_sizes(hvd):
+    """Tensor sizes straddling the 64-element alignment boundary fuse and
+    un-fuse exactly (off-by-one slicing here corrupts neighbors)."""
+    sizes = [1, 63, 64, 65, 127, 128, 129]
+
+    def fn(r):
+        hs = [hvd.allreduce_async(
+                  jnp.arange(n, dtype=jnp.float32) + 1000.0 * r,
+                  op=hvd.Sum, name=f"edge.{n}")
+              for n in sizes]
+        return [np.asarray(hvd.synchronize(h)) for h in hs]
+
+    total_rank = 1000.0 * sum(range(N))
+    for outs in _per_rank(fn):
+        for n, out in zip(sizes, outs):
+            expected = N * np.arange(n, dtype=np.float32) + total_rank
+            np.testing.assert_allclose(out, expected)
+
+
+def test_single_tensor_larger_than_fusion_threshold(hvd):
+    """A tensor bigger than the fusion threshold must still go through
+    (its own bucket), not be dropped or split incorrectly."""
+    threshold = basics._get_state().config.fusion_threshold_bytes
+    n = threshold // 4 + 1024  # floats, comfortably over
+
+    def fn(r):
+        out = hvd.allreduce(jnp.ones((n,), jnp.float32) * (r + 1),
+                            op=hvd.Sum, name="oversize")
+        arr = np.asarray(out)
+        return float(arr[0]), float(arr[-1]), arr.shape
+
+    expected = float(sum(range(1, N + 1)))
+    for first, last, shape in _per_rank(fn):
+        assert shape == (n,)
+        assert first == expected and last == expected
+
+
+def test_dtype_flip_mid_burst_splits_buckets_correctly(hvd):
+    """f32, then i32, then f32 again in one burst: buckets split on the
+    dtype flips, every tensor still lands (reference FuseResponses only
+    fuses dtype-homogeneous runs)."""
+    def fn(r):
+        specs = [("f1", jnp.float32), ("i1", jnp.int32),
+                 ("f2", jnp.float32), ("i2", jnp.int32),
+                 ("f3", jnp.float32)]
+        hs = [hvd.allreduce_async(
+                  jnp.full((9,), r + 1, dtype=dt), op=hvd.Sum, name=nm)
+              for nm, dt in specs]
+        return [np.asarray(hvd.synchronize(h)) for h in hs]
+
+    expected = float(sum(range(1, N + 1)))
+    for outs in _per_rank(fn):
+        for out in outs:
+            np.testing.assert_allclose(
+                out.astype(np.float64), np.full((9,), expected))
