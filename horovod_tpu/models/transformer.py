@@ -103,6 +103,30 @@ class MoeMlp(nn.Module):
         return out
 
 
+class FusedLayerNorm(nn.Module):
+    """LayerNorm through the fused Pallas kernel on TPU
+    (``ops/pallas/layer_norm.py``: one HBM pass per direction); the
+    XLA reference path elsewhere.  Parameter names/shapes match
+    ``nn.LayerNorm`` so checkpoints and the TP sharding rules are
+    unaffected."""
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        import jax as _jax
+
+        d = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (d,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (d,),
+                          jnp.float32)
+        if _jax.default_backend() == "tpu":
+            from horovod_tpu.ops.pallas.layer_norm import layer_norm
+            return layer_norm(x, scale, bias, self.eps)
+        from horovod_tpu.ops.pallas.layer_norm import layer_norm_reference
+        return layer_norm_reference(x, scale, bias, self.eps)
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
     use_moe: bool = False
@@ -110,9 +134,9 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        y = FusedLayerNorm(name="ln1")(x)
         x = x + Attention(cfg, name="attn")(y.astype(cfg.dtype))
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        y = FusedLayerNorm(name="ln2")(x)
         ff = MoeMlp(cfg, name="moe") if self.use_moe else \
             Mlp(cfg, name="mlp")
         return x + ff(y.astype(cfg.dtype))
@@ -155,6 +179,6 @@ class Transformer(nn.Module):
         for i in range(cfg.n_layers):
             use_moe = cfg.moe_every and (i + 1) % cfg.moe_every == 0
             x = Block(cfg, use_moe=bool(use_moe), name=f"block_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = FusedLayerNorm(name="ln_f")(x)
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                         name="lm_head")(x.astype(cfg.dtype))

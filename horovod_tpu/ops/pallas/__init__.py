@@ -1,1 +1,3 @@
 from horovod_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401
+from horovod_tpu.ops.pallas.layer_norm import (layer_norm,  # noqa: F401
+                                               layer_norm_reference)
