@@ -28,10 +28,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from horovod_tpu.ops.pallas.flash_attention import (_default_interpret,
-                                                    _vmem_spec)
+                                                    _sds, _vmem_spec)
 
 
-def _fwd_kernel(x_ref, g_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
+def _fwd_kernel(x_ref, g_ref, b_ref, o_ref, mean_ref=None, rstd_ref=None,
+                *, eps):
     x = x_ref[...].astype(jnp.float32)          # [block_n, d]
     mean = jnp.mean(x, axis=-1, keepdims=True)
     centered = x - mean
@@ -41,9 +42,10 @@ def _fwd_kernel(x_ref, g_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
     out = xhat * g_ref[...].astype(jnp.float32) + b_ref[...].astype(
         jnp.float32)
     o_ref[...] = out.astype(o_ref.dtype)
-    # broadcast across the 128-lane minor dim so the save is tileable
-    mean_ref[...] = jnp.broadcast_to(mean, mean_ref.shape)
-    rstd_ref[...] = jnp.broadcast_to(rstd, rstd_ref.shape)
+    if mean_ref is not None:
+        # broadcast across the 128-lane minor dim so the save is tileable
+        mean_ref[...] = jnp.broadcast_to(mean, mean_ref.shape)
+        rstd_ref[...] = jnp.broadcast_to(rstd, rstd_ref.shape)
 
 
 def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
@@ -77,14 +79,53 @@ def _pick_block_n(n):
     for cand in (256, 128, 64, 32, 16, 8):
         if n % cand == 0:
             return cand
-    return 1
+    return 8  # callers pad the row count to a multiple of 8 first
+
+
+def _rows(x):
+    """Flatten to [n, d], padding n up to a multiple of 8 so block
+    shapes stay sublane-tileable (padded rows are normalized garbage
+    that is sliced off; each row is independent)."""
+    d = x.shape[-1]
+    n = 1
+    for s in x.shape[:-1]:
+        n *= s
+    x2 = x.reshape(n, d)
+    pad = (-n) % 8
+    if pad:
+        x2 = jnp.concatenate(
+            [x2, jnp.ones((pad, d), x2.dtype)], axis=0)
+    return x2, n
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def layer_norm(x, gamma, beta, eps=1e-6, interpret=None):
-    """Fused LayerNorm over the last axis of ``x``."""
-    out, _ = _ln_fwd(x, gamma, beta, eps, interpret)
-    return out
+    """Fused LayerNorm over the last axis of ``x``.
+
+    The primal (inference) path runs a stats-free kernel — no
+    mean/rstd residual writes; differentiation swaps in the
+    residual-saving forward via the custom VJP."""
+    if interpret is None:
+        interpret = _default_interpret()
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    x2, n = _rows(x)
+    block_n = _pick_block_n(x2.shape[0])
+    grid = (x2.shape[0] // block_n,)
+
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((block_n, d), lambda i: (i, 0)),
+            _vmem_spec((1, d), lambda i: (0, 0)),
+            _vmem_spec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=[_vmem_spec((block_n, d), lambda i: (i, 0))],
+        out_shape=[_sds((x2.shape[0], d), x.dtype, x2)],
+        interpret=interpret,
+    )(x2, gamma.reshape(1, d), beta.reshape(1, d))[0]
+    return out[:n].reshape(orig_shape)
 
 
 def _ln_fwd(x, gamma, beta, eps, interpret):
@@ -92,12 +133,10 @@ def _ln_fwd(x, gamma, beta, eps, interpret):
         interpret = _default_interpret()
     orig_shape = x.shape
     d = orig_shape[-1]
-    n = 1
-    for s in orig_shape[:-1]:
-        n *= s
-    x2 = x.reshape(n, d)
-    block_n = _pick_block_n(n)
-    grid = (n // block_n,)
+    x2, n = _rows(x)
+    np_ = x2.shape[0]
+    block_n = _pick_block_n(np_)
+    grid = (np_ // block_n,)
 
     out, mean, rstd = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
@@ -113,24 +152,31 @@ def _ln_fwd(x, gamma, beta, eps, interpret):
             _vmem_spec((block_n, 128), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, d), x.dtype),
-            jax.ShapeDtypeStruct((n, 128), jnp.float32),
-            jax.ShapeDtypeStruct((n, 128), jnp.float32),
+            _sds((np_, d), x.dtype, x2),
+            _sds((np_, 128), jnp.float32, x2),
+            _sds((np_, 128), jnp.float32, x2),
         ],
         interpret=interpret,
     )(x2, gamma.reshape(1, d), beta.reshape(1, d))
-    out = out.reshape(orig_shape)
-    return out, (x2, gamma, mean, rstd, orig_shape)
+    return out[:n].reshape(orig_shape), (x2, gamma, mean, rstd, orig_shape)
 
 
 def _ln_bwd(eps, interpret, residuals, dout):
     if interpret is None:
         interpret = _default_interpret()
     x2, gamma, mean, rstd, orig_shape = residuals
-    n, d = x2.shape
+    np_, d = x2.shape
+    n = 1
+    for s in orig_shape[:-1]:
+        n *= s
     dy2 = dout.reshape(n, d)
-    block_n = _pick_block_n(n)
-    grid = (n // block_n,)
+    if np_ != n:
+        # zero cotangents for the padded rows: they drop out of the
+        # dgamma/dbeta accumulation and their dx is sliced off below
+        dy2 = jnp.concatenate(
+            [dy2, jnp.zeros((np_ - n, d), dy2.dtype)], axis=0)
+    block_n = _pick_block_n(np_)
+    grid = (np_ // block_n,)
 
     dx, dg, db = pl.pallas_call(
         _bwd_kernel,
@@ -148,14 +194,14 @@ def _ln_bwd(eps, interpret, residuals, dout):
             _vmem_spec((1, d), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, d), x2.dtype),
-            jax.ShapeDtypeStruct((1, d), jnp.float32),
-            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            _sds((np_, d), x2.dtype, x2),
+            _sds((1, d), jnp.float32, x2),
+            _sds((1, d), jnp.float32, x2),
         ],
         interpret=interpret,
     )(x2, gamma.reshape(1, d), mean, rstd, dy2)
 
-    return (dx.reshape(orig_shape),
+    return (dx[:n].reshape(orig_shape),
             dg.reshape(gamma.shape).astype(gamma.dtype),
             db.reshape(gamma.shape).astype(gamma.dtype))
 

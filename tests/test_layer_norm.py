@@ -43,13 +43,46 @@ def test_backward_matches_oracle():
             err_msg=f"d{nm} mismatch")
 
 
-def test_odd_row_count_falls_back_to_small_blocks():
-    # 7 rows: no block size divides it except 1 — must still be exact
+def test_backward_multi_grid_step_accumulation():
+    """Row count forcing grid > 1 (n=24 -> block_n=8, 3 steps): the
+    cross-step dgamma/dbeta accumulation (pl.when init + '+=') must
+    produce the same parameter grads as the oracle."""
+    x, g, b = _data((3, 8, 128), seed=5)
+
+    def loss_p(x, g, b):
+        return jnp.mean(layer_norm(x, g, b, 1e-6, True) ** 2)
+
+    def loss_r(x, g, b):
+        return jnp.mean(layer_norm_reference(x, g, b) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, g, b)
+    for a, c, nm in zip(gp, gr, "xgb"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(c), rtol=1e-4, atol=1e-5,
+            err_msg=f"d{nm} mismatch")
+
+
+def test_odd_row_count_pads_and_slices():
+    # 7 rows: padded to 8 internally; fwd AND bwd must stay exact
     x, g, b = _data((7, 128), seed=2)
     out = layer_norm(x, g, b, 1e-6, True)
     ref = layer_norm_reference(x, g, b)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+    def loss_p(x, g, b):
+        return jnp.mean(layer_norm(x, g, b, 1e-6, True) ** 2)
+
+    def loss_r(x, g, b):
+        return jnp.mean(layer_norm_reference(x, g, b) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, g, b)
+    for a, c, nm in zip(gp, gr, "xgb"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(c), rtol=1e-4, atol=1e-5,
+            err_msg=f"d{nm} mismatch (padded rows)")
 
 
 def test_bf16_activations_fp32_stats():
