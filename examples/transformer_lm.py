@@ -16,7 +16,8 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
-from horovod_tpu.models import Transformer, TransformerConfig, apply_with_aux
+from horovod_tpu.models import (Transformer, TransformerConfig,
+                                apply_with_aux, lm_loss)
 from horovod_tpu.parallel import make_mesh, shard_params
 
 
@@ -54,11 +55,8 @@ def main():
     def step(params, opt_state, tokens):
         def loss_fn(p):
             logits, aux = apply_with_aux(model, p, tokens)
-            labels = jnp.roll(tokens, -1, axis=-1)
-            xent = jnp.mean(
-                optax.softmax_cross_entropy_with_integer_labels(
-                    logits, labels))
-            return xent + 0.01 * aux
+            # fused Pallas softmax-xent kernel on TPU
+            return lm_loss(logits, tokens) + 0.01 * aux
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = opt.update(grads, opt_state, params)

@@ -13,4 +13,5 @@ from horovod_tpu.models.transformer import (  # noqa: F401
     Transformer,
     TransformerConfig,
     apply_with_aux,
+    lm_loss,
 )

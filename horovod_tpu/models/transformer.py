@@ -142,6 +142,23 @@ class Block(nn.Module):
         return x + ff(y.astype(cfg.dtype))
 
 
+def lm_loss(logits, tokens):
+    """Mean next-token cross-entropy — the LM training loss.
+
+    On TPU this is the fused Pallas kernel
+    (``ops/pallas/softmax_xent.py``: vocab streamed in VMEM chunks, no
+    materialized ``[rows, vocab]`` log-softmax); the XLA/optax lowering
+    elsewhere."""
+    import jax as _jax
+
+    labels = jnp.roll(tokens, -1, axis=-1)
+    if _jax.default_backend() == "tpu":
+        from horovod_tpu.ops.pallas.softmax_xent import softmax_xent
+        return jnp.mean(softmax_xent(logits, labels))
+    from horovod_tpu.ops.pallas.softmax_xent import softmax_xent_reference
+    return jnp.mean(softmax_xent_reference(logits, labels))
+
+
 def apply_with_aux(model, params, tokens):
     """Forward pass returning ``(logits, moe_aux_loss)``.
 

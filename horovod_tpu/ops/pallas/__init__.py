@@ -1,3 +1,5 @@
 from horovod_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401
 from horovod_tpu.ops.pallas.layer_norm import (layer_norm,  # noqa: F401
                                                layer_norm_reference)
+from horovod_tpu.ops.pallas.softmax_xent import (softmax_xent,  # noqa: F401
+                                                 softmax_xent_reference)

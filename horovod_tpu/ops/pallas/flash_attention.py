@@ -50,6 +50,23 @@ def _default_interpret():
     return jax.default_backend() != "tpu"
 
 
+def _flatten_rows(x, fill=0.0, pad_multiple=8):
+    """``[..., d] -> ([n_padded, d], n)``: flatten the leading axes and
+    pad the row count up to a sublane multiple with ``fill`` rows (the
+    padded rows are kernel garbage the caller slices off).  Shared by
+    the row-blocked kernels (layer_norm, softmax_xent)."""
+    d = x.shape[-1]
+    n = 1
+    for s in x.shape[:-1]:
+        n *= s
+    x2 = x.reshape(n, d)
+    pad = (-n) % pad_multiple
+    if pad:
+        x2 = jnp.concatenate(
+            [x2, jnp.full((pad, d), fill, x2.dtype)], axis=0)
+    return x2, n
+
+
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying the varying-manual-axes of ``like`` so the
     kernel composes with new-style shard_map (check_vma=True)."""

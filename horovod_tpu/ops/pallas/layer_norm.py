@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from horovod_tpu.ops.pallas.flash_attention import (_default_interpret,
-                                                    _sds, _vmem_spec)
+                                                    _flatten_rows, _sds,
+                                                    _vmem_spec)
 
 
 def _fwd_kernel(x_ref, g_ref, b_ref, o_ref, mean_ref=None, rstd_ref=None,
@@ -82,20 +83,30 @@ def _pick_block_n(n):
     return 8  # callers pad the row count to a multiple of 8 first
 
 
-def _rows(x):
-    """Flatten to [n, d], padding n up to a multiple of 8 so block
-    shapes stay sublane-tileable (padded rows are normalized garbage
-    that is sliced off; each row is independent)."""
-    d = x.shape[-1]
-    n = 1
-    for s in x.shape[:-1]:
-        n *= s
-    x2 = x.reshape(n, d)
-    pad = (-n) % 8
-    if pad:
-        x2 = jnp.concatenate(
-            [x2, jnp.ones((pad, d), x2.dtype)], axis=0)
-    return x2, n
+def _call_fwd(x2, gamma, beta, eps, interpret, with_stats):
+    """One pallas_call builder for both forwards; ``with_stats`` adds
+    the mean/rstd residual outputs the VJP needs."""
+    np_, d = x2.shape
+    block_n = _pick_block_n(np_)
+    grid = (np_ // block_n,)
+    out_specs = [_vmem_spec((block_n, d), lambda i: (i, 0))]
+    out_shape = [_sds((np_, d), x2.dtype, x2)]
+    if with_stats:
+        for _ in range(2):
+            out_specs.append(_vmem_spec((block_n, 128), lambda i: (i, 0)))
+            out_shape.append(_sds((np_, 128), jnp.float32, x2))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((block_n, d), lambda i: (i, 0)),
+            _vmem_spec((1, d), lambda i: (0, 0)),
+            _vmem_spec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(x2, gamma.reshape(1, d), beta.reshape(1, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -107,58 +118,20 @@ def layer_norm(x, gamma, beta, eps=1e-6, interpret=None):
     residual-saving forward via the custom VJP."""
     if interpret is None:
         interpret = _default_interpret()
-    orig_shape = x.shape
-    d = orig_shape[-1]
-    x2, n = _rows(x)
-    block_n = _pick_block_n(x2.shape[0])
-    grid = (x2.shape[0] // block_n,)
-
-    out = pl.pallas_call(
-        functools.partial(_fwd_kernel, eps=eps),
-        grid=grid,
-        in_specs=[
-            _vmem_spec((block_n, d), lambda i: (i, 0)),
-            _vmem_spec((1, d), lambda i: (0, 0)),
-            _vmem_spec((1, d), lambda i: (0, 0)),
-        ],
-        out_specs=[_vmem_spec((block_n, d), lambda i: (i, 0))],
-        out_shape=[_sds((x2.shape[0], d), x.dtype, x2)],
-        interpret=interpret,
-    )(x2, gamma.reshape(1, d), beta.reshape(1, d))[0]
-    return out[:n].reshape(orig_shape)
+    # fill=1.0: padded rows have zero variance, which rsqrt(0+eps)
+    # handles; any finite fill works since the rows are sliced off
+    x2, n = _flatten_rows(x, fill=1.0)
+    out = _call_fwd(x2, gamma, beta, eps, interpret, with_stats=False)[0]
+    return out[:n].reshape(x.shape)
 
 
 def _ln_fwd(x, gamma, beta, eps, interpret):
     if interpret is None:
         interpret = _default_interpret()
-    orig_shape = x.shape
-    d = orig_shape[-1]
-    x2, n = _rows(x)
-    np_ = x2.shape[0]
-    block_n = _pick_block_n(np_)
-    grid = (np_ // block_n,)
-
-    out, mean, rstd = pl.pallas_call(
-        functools.partial(_fwd_kernel, eps=eps),
-        grid=grid,
-        in_specs=[
-            _vmem_spec((block_n, d), lambda i: (i, 0)),
-            _vmem_spec((1, d), lambda i: (0, 0)),
-            _vmem_spec((1, d), lambda i: (0, 0)),
-        ],
-        out_specs=[
-            _vmem_spec((block_n, d), lambda i: (i, 0)),
-            _vmem_spec((block_n, 128), lambda i: (i, 0)),
-            _vmem_spec((block_n, 128), lambda i: (i, 0)),
-        ],
-        out_shape=[
-            _sds((np_, d), x.dtype, x2),
-            _sds((np_, 128), jnp.float32, x2),
-            _sds((np_, 128), jnp.float32, x2),
-        ],
-        interpret=interpret,
-    )(x2, gamma.reshape(1, d), beta.reshape(1, d))
-    return out[:n].reshape(orig_shape), (x2, gamma, mean, rstd, orig_shape)
+    x2, n = _flatten_rows(x, fill=1.0)
+    out, mean, rstd = _call_fwd(x2, gamma, beta, eps, interpret,
+                                with_stats=True)
+    return out[:n].reshape(x.shape), (x2, gamma, mean, rstd, x.shape)
 
 
 def _ln_bwd(eps, interpret, residuals, dout):

@@ -1,0 +1,193 @@
+"""Pallas TPU fused softmax cross-entropy (forward + custom-VJP backward).
+
+The LM-loss hot op.  The stock lowering materializes ``log_softmax``
+over the full ``[rows, vocab]`` logits twice (forward + backward); at
+vocab 32k that array dominates HBM traffic of the loss.  The fused
+kernels stream the vocab axis in VMEM-resident chunks:
+
+- forward: one pass per row block — running max / sum-exp (online
+  logsumexp, same trick as flash attention's softmax) and the label
+  logit picked up via an iota==label mask in the same pass; saves
+  ``lse`` ([rows, 1] broadcast to the 128-lane tile) for the backward;
+- backward: ``dlogits = (exp(x - lse) - onehot(label)) * dloss`` — one
+  read of the logits, no recomputed reduction;
+- labels ride as int32 ``[rows, 1]`` blocks; rows pad to the sublane
+  multiple exactly like ``layer_norm.py`` (padded rows get label 0 and
+  zero cotangent, then slice off).
+
+API: ``softmax_xent(logits, labels)`` -> per-row loss ``[...,]`` in
+fp32; logits may be bf16 (accumulation is fp32).  Interpret mode
+off-TPU; `softmax_xent_reference` is the XLA oracle.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from horovod_tpu.ops.pallas.flash_attention import (_default_interpret,
+                                                    _flatten_rows, _sds,
+                                                    _vmem_spec)
+
+_VCHUNK = 2048  # vocab streamed in chunks of this many columns
+
+
+def _fwd_kernel(x_ref, lab_ref, loss_ref, lse_ref=None, *, vchunk):
+    # x_ref: [block_n, V]; lab_ref: [block_n, 1] int32
+    bn, v = x_ref.shape
+    nchunk = v // vchunk
+    lab = lab_ref[...]                                  # [bn, 1]
+
+    def body(c, carry):
+        m, s, picked = carry
+        x = x_ref[:, pl.ds(c * vchunk, vchunk)].astype(jnp.float32)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bn, vchunk), 1) \
+            + c * vchunk
+        picked = picked + jnp.sum(
+            jnp.where(cols == lab, x, 0.0), axis=1, keepdims=True)
+        m_new = jnp.maximum(m, jnp.max(x, axis=1, keepdims=True))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(x - m_new), axis=1, keepdims=True)
+        return m_new, s, picked
+
+    m0 = jnp.full((bn, 1), -1e30, jnp.float32)
+    z0 = jnp.zeros((bn, 1), jnp.float32)
+    m, s, picked = jax.lax.fori_loop(0, nchunk, body, (m0, z0, z0))
+    lse = m + jnp.log(s)
+    loss_ref[...] = jnp.broadcast_to(lse - picked, loss_ref.shape)
+    if lse_ref is not None:
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+
+def _bwd_kernel(x_ref, lab_ref, lse_ref, dy_ref, dx_ref, *, vchunk):
+    bn, v = x_ref.shape
+    nchunk = v // vchunk
+    lab = lab_ref[...]
+    lse = lse_ref[...][:, :1]
+    dy = dy_ref[...][:, :1]
+
+    def body(c, _):
+        x = x_ref[:, pl.ds(c * vchunk, vchunk)].astype(jnp.float32)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bn, vchunk), 1) \
+            + c * vchunk
+        p = jnp.exp(x - lse)
+        dx = (p - jnp.where(cols == lab, 1.0, 0.0)) * dy
+        dx_ref[:, pl.ds(c * vchunk, vchunk)] = dx.astype(dx_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, nchunk, body, 0)
+
+
+def _pick_vchunk(v):
+    if v % _VCHUNK == 0:
+        return _VCHUNK
+    for cand in (1024, 512, 256, 128):
+        if v % cand == 0:
+            return cand
+    return v  # small/odd vocab: single chunk
+
+
+def _pick_block_n(n, v, slabs=1):
+    # keep the kernel's [block_n, v] fp32 slabs well under VMEM;
+    # ``slabs`` counts how many the kernel holds (bwd: x + dx = 2)
+    budget = max((4 << 20) // (v * 4 * slabs), 8)
+    for cand in (256, 128, 64, 32, 16, 8):
+        if cand <= budget and n % cand == 0:
+            return cand
+    return 8
+
+
+def _rows(logits, labels):
+    x2, n = _flatten_rows(logits)
+    l2, _ = _flatten_rows(labels[..., None].astype(jnp.int32))
+    return x2, l2, n
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def softmax_xent(logits, labels, interpret=None):
+    """Per-row softmax cross-entropy over the last axis (fp32).
+
+    The primal (non-differentiated) call skips the lse residual
+    output; differentiation swaps in the residual-saving forward."""
+    if interpret is None:
+        interpret = _default_interpret()
+    x2, l2, n = _rows(logits, labels)
+    loss = _call_fwd(x2, l2, interpret, with_lse=False)[0]
+    return loss[:n, 0].reshape(logits.shape[:-1])
+
+
+def _call_fwd(x2, l2, interpret, with_lse):
+    np_, v = x2.shape
+    block_n = _pick_block_n(np_, v)
+    vchunk = _pick_vchunk(v)
+    grid = (np_ // block_n,)
+    out_specs = [_vmem_spec((block_n, 128), lambda i: (i, 0))]
+    out_shape = [_sds((np_, 128), jnp.float32, x2)]
+    if with_lse:
+        out_specs.append(_vmem_spec((block_n, 128), lambda i: (i, 0)))
+        out_shape.append(_sds((np_, 128), jnp.float32, x2))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, vchunk=vchunk),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((block_n, v), lambda i: (i, 0)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(x2, l2)
+
+
+def _sx_fwd(logits, labels, interpret):
+    if interpret is None:
+        interpret = _default_interpret()
+    x2, l2, n = _rows(logits, labels)
+    out = _call_fwd(x2, l2, interpret, with_lse=True)
+    loss, lse = out
+    return (loss[:n, 0].reshape(logits.shape[:-1]),
+            (x2, l2, lse, logits.shape))
+
+
+def _sx_bwd(interpret, residuals, dloss):
+    if interpret is None:
+        interpret = _default_interpret()
+    x2, l2, lse, logits_shape = residuals
+    np_, v = x2.shape
+    n = 1
+    for s in logits_shape[:-1]:
+        n *= s
+    dy = dloss.reshape(n, 1).astype(jnp.float32)
+    if np_ != n:
+        dy = jnp.concatenate(
+            [dy, jnp.zeros((np_ - n, 1), jnp.float32)], axis=0)
+    dy = jnp.broadcast_to(dy, (np_, 128))
+
+    block_n = _pick_block_n(np_, v, slabs=2)
+    vchunk = _pick_vchunk(v)
+    grid = (np_ // block_n,)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_kernel, vchunk=vchunk),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((block_n, v), lambda i: (i, 0)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
+            _vmem_spec((block_n, 128), lambda i: (i, 0)),
+            _vmem_spec((block_n, 128), lambda i: (i, 0)),
+        ],
+        out_specs=[_vmem_spec((block_n, v), lambda i: (i, 0))],
+        out_shape=[_sds((np_, v), x2.dtype, x2)],
+        interpret=interpret,
+    )(x2, l2, lse, dy)[0]
+    return dx[:n].reshape(logits_shape), None
+
+
+softmax_xent.defvjp(_sx_fwd, _sx_bwd)
+
+
+def softmax_xent_reference(logits, labels):
+    """XLA oracle (optax-equivalent) for tests and non-Pallas paths."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(
+        logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
