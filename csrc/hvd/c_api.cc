@@ -22,6 +22,8 @@ void hvd_core_start(void* core) { static_cast<Core*>(core)->Start(); }
 
 void hvd_core_shutdown(void* core) { static_cast<Core*>(core)->Shutdown(); }
 
+void hvd_core_finalize(void* core) { static_cast<Core*>(core)->Finalize(); }
+
 void hvd_core_destroy(void* core) { delete static_cast<Core*>(core); }
 
 // Returns 0 on success; -1 with the error copied into err_buf otherwise.

@@ -82,7 +82,10 @@ Core::Core(const CoreConfig& config)
       params_(PmOptions(config)),
       epoch_(Clock::now()) {}
 
-Core::~Core() { Shutdown(); }
+Core::~Core() {
+  Shutdown();
+  Finalize();
+}
 
 void Core::Start() {
   {
@@ -111,8 +114,9 @@ void Core::Shutdown() {
     out_queue_.push_back(batch.Encode());
   }
   out_cv_.notify_all();
-  timeline_.Close();
 }
+
+void Core::Finalize() { timeline_.Close(); }
 
 bool Core::Enqueue(const uint8_t* data, size_t len, std::string* error) {
   Reader r(data, len);

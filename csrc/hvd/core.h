@@ -119,6 +119,11 @@ class Core {
 
   void Start();
   void Shutdown();
+  // Closes the timeline.  Separate from Shutdown(): the dispatcher
+  // thread may still deliver MarkDone (timeline End events) after the
+  // bg loop stops; callers invoke Finalize once the dispatcher has
+  // drained.  Idempotent.
+  void Finalize();
 
   // Producer API (rank threads, via the C boundary).  Returns false with
   // *error set if the core is shut down or in a stall-shutdown state.

@@ -1,9 +1,14 @@
-"""Synthetic CNN training benchmark (reference:
+"""Synthetic training benchmark (reference:
 ``examples/pytorch_synthetic_benchmark.py:107-120`` — timed training loop
 over random data, prints img/sec mean over iterations).
 
+``--model transformer`` benches the LM path instead (tokens/sec): flash
+attention, fused LayerNorm and fused softmax-xent Pallas kernels are all
+on that hot path when running on TPU.
+
     python examples/jax_synthetic_benchmark.py --model resnet50
     python examples/jax_synthetic_benchmark.py --model vgg16 --batch-size 32
+    python examples/jax_synthetic_benchmark.py --model transformer --seq-len 2048
 """
 
 import argparse
@@ -28,17 +33,87 @@ MODELS = {
 }
 
 
+def _bench_transformer(args):
+    """tokens/sec LM benchmark over the hvd mesh; Pallas kernels
+    (flash attention, fused LayerNorm, fused softmax-xent) carry the
+    hot path on TPU."""
+    from horovod_tpu.models import Transformer, TransformerConfig, lm_loss
+
+    n = len(jax.devices())
+    mesh = make_mesh({"hvd": n})
+    batch = args.batch_size * n  # --batch-size is per device, as documented
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab_size, n_layers=args.n_layers,
+        d_model=args.d_model, n_heads=max(args.d_model // 128, 1),
+        d_ff=4 * args.d_model, max_len=args.seq_len,
+        dtype=jnp.bfloat16)
+    model = Transformer(cfg)
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, args.seq_len))
+
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, args.seq_len), jnp.int32))
+    params = params["params"]
+    opt = hvd.DistributedOptimizer(optax.adamw(1e-4), named_axes=("hvd",))
+    opt_state = opt.init(params)
+
+    def per_shard(params, opt_state, tokens):
+        def loss_fn(p):
+            return lm_loss(model.apply({"params": p}, tokens), tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, \
+            jax.lax.pmean(loss, "hvd")
+
+    step = jax.jit(shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(), P(), P("hvd")),
+        out_specs=(P(), P(), P())), donate_argnums=(0, 1))
+
+    td = jax.device_put(tokens, NamedSharding(mesh, P("hvd")))
+    for _ in range(args.num_warmup_batches):
+        params, opt_state, loss = step(params, opt_state, td)
+    jax.block_until_ready(params)
+
+    tok_secs = []
+    for i in range(args.num_iters):
+        start = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, opt_state, loss = step(params, opt_state, td)
+        jax.block_until_ready(loss)
+        elapsed = time.perf_counter() - start
+        rate = batch * args.seq_len * args.num_batches_per_iter / elapsed
+        tok_secs.append(rate)
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {rate:.0f} tokens/sec total")
+    if hvd.rank() == 0:
+        mean, conf = np.mean(tok_secs), 1.96 * np.std(tok_secs)
+        print(f"Tokens/sec per device: {mean / n:.0f} +- {conf / n:.0f}")
+        print(f"Total tokens/sec on {n} device(s): {mean:.0f} "
+              f"+- {conf:.0f}")
+    hvd.shutdown()
+
+
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--model", default="resnet50", choices=MODELS)
+    parser.add_argument("--model", default="resnet50",
+                        choices=list(MODELS) + ["transformer"])
     parser.add_argument("--batch-size", type=int, default=32,
                         help="per-device batch size")
     parser.add_argument("--num-warmup-batches", type=int, default=3)
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-iters", type=int, default=3)
+    parser.add_argument("--seq-len", type=int, default=1024)
+    parser.add_argument("--d-model", type=int, default=512)
+    parser.add_argument("--n-layers", type=int, default=8)
+    parser.add_argument("--vocab-size", type=int, default=32768)
     args = parser.parse_args()
 
     hvd.init()
+    if args.model == "transformer":
+        return _bench_transformer(args)
     cls, img = MODELS[args.model]
     n = len(jax.devices())
     mesh = make_mesh({"hvd": n})
@@ -81,7 +156,7 @@ def main():
 
     for _ in range(args.num_warmup_batches):
         params, opt_state, loss = step(params, opt_state, xd, yd)
-    jax.block_until_ready(loss)
+    jax.block_until_ready(params)
 
     img_secs = []
     for i in range(args.num_iters):

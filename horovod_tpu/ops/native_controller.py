@@ -91,6 +91,7 @@ def _load_lib():
     lib.hvd_core_create.argtypes = [ctypes.c_int]
     lib.hvd_core_start.argtypes = [ctypes.c_void_p]
     lib.hvd_core_shutdown.argtypes = [ctypes.c_void_p]
+    lib.hvd_core_finalize.argtypes = [ctypes.c_void_p]
     lib.hvd_core_destroy.argtypes = [ctypes.c_void_p]
     lib.hvd_core_enqueue.restype = ctypes.c_int
     lib.hvd_core_enqueue.argtypes = [
@@ -222,8 +223,10 @@ class NativeController:
             return
         self._running = False
         self._lib.hvd_core_shutdown(self._core)
+        drained = True
         if self._thread is not None:
             self._thread.join(timeout=10)
+            drained = not self._thread.is_alive()
         with self._lock:
             pending = list(self._pending.values())
             self._pending.clear()
@@ -233,7 +236,18 @@ class NativeController:
             request.handle.set_error("horovod_tpu has been shut down")
         for handle in joins:
             handle.set_error("horovod_tpu has been shut down")
-        self._lib.hvd_core_destroy(self._core)
+        if drained:
+            # close the timeline only after the dispatcher drained its
+            # last MarkDone (op End events) — closing inside Shutdown
+            # raced it
+            self._lib.hvd_core_finalize(self._core)
+            self._lib.hvd_core_destroy(self._core)
+        else:
+            # a stuck dispatcher may still touch the core; leaking it
+            # (and the open timeline file) beats a use-after-free
+            self._log.warning(
+                "dispatcher did not drain within 10s; leaking the core "
+                "and leaving the timeline file unfinalized")
         self._core = None
 
     # ------------------------------------------------------------- statistics

@@ -89,6 +89,17 @@ def test_synthetic_benchmark_tiny():
     assert "Img/sec per device" in result.stdout
 
 
+def test_synthetic_benchmark_transformer_tiny():
+    result = _run_example(
+        "jax_synthetic_benchmark.py", "--model", "transformer",
+        "--seq-len", "64", "--d-model", "128", "--n-layers", "2",
+        "--vocab-size", "512", "--batch-size", "8",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1", timeout=600)
+    assert result.returncode == 0, result.stderr
+    assert "Tokens/sec per device" in result.stdout
+
+
 
 def _run_example_hvdrun(name, *args, np_=2, timeout=600):
     """Per-process bindings (torch/TF/keras) run one process per rank."""
