@@ -163,12 +163,14 @@ hvd.shutdown()
 """
 
 
-def _run_gmesh(script, np_=2, devices_per_proc=4, timeout=600):
+def _run_gmesh(script, np_=2, devices_per_proc=4, timeout=600,
+               extra_env=None):
     path = "/tmp/hvd_multihost_worker.py"
     with open(path, "w") as f:
         f.write(script)
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("AXON_", "PALLAS_", "TPU_", "JAX_"))}
+    env.update(extra_env or {})
     env["PYTHONPATH"] = REPO
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
@@ -258,3 +260,56 @@ def test_global_mesh_dtype_matrix_and_hierarchical():
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
     assert result.stdout.count("GMESH_MATRIX_OK") == 2
+
+
+STALL_WORKER = r"""
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.common.basics import run_parallel
+from horovod_tpu.common.handles import HvdError
+
+hvd.init()
+pid = int(os.environ["HVD_RANK"])
+
+def per_rank(lr):
+    r = hvd.rank()
+    # a healthy collective first: the stall must poison only the
+    # stalled name, and only after the shutdown threshold
+    out = np.asarray(hvd.allreduce(jnp.full((3,), float(r)), op=hvd.Sum,
+                                   name="healthy"))
+    np.testing.assert_allclose(out, np.full((3,), 28.0))
+
+    if pid == 1:
+        # process 1 never submits the stalled tensor
+        return "skipped"
+    try:
+        hvd.allreduce(jnp.ones((4,)), op=hvd.Sum, name="stalled")
+        return "no-error"
+    except HvdError as exc:
+        assert "stall" in str(exc).lower(), exc
+        return "raised"
+
+results = run_parallel(per_rank)
+expected = "raised" if pid == 0 else "skipped"
+assert all(x == expected for x in results), (pid, results)
+print(f"proc {pid} GMESH_STALL_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_global_mesh_stall_shutdown():
+    """A process that never submits a tensor trips the coordinator's
+    stall shutdown; the waiting process gets a per-name HvdError while
+    healthy collectives complete (reference: StallInspector +
+    Response::ERROR semantics, on the pod control plane)."""
+    result = _run_gmesh(STALL_WORKER, timeout=300, extra_env={
+        "HVD_STALL_CHECK_TIME_SECONDS": "1",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "4",
+    })
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert result.stdout.count("GMESH_STALL_OK") == 2
