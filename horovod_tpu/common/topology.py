@@ -41,10 +41,42 @@ class Topology:
         return self.size == self.local_size * self.cross_size
 
 
-def from_env() -> "Topology | None":
-    """Build topology from the hvdrun env contract, if present."""
-    if os.environ.get(env_util.HVD_RANK) is None:
+def _mpi_placed() -> "Topology | None":
+    """Fallback contract for mpirun/jsrun placement (``hvdrun
+    --launcher mpirun``): the per-rank variables come from the MPI
+    runtime (OpenMPI's OMPI_COMM_WORLD_* or the PMI set) because a
+    single mpirun command line cannot export per-rank values.
+
+    Gated on the delegation contract (rendezvous address exported by
+    ``hvdrun --launcher mpirun/jsrun``): a script launched under plain
+    mpirun/srun WITHOUT hvdrun keeps the default device-rank mode
+    instead of being hijacked into process mode it can't complete."""
+    if os.environ.get(env_util.HVD_RENDEZVOUS_ADDR) is None:
         return None
+    rank = os.environ.get("OMPI_COMM_WORLD_RANK",
+                          os.environ.get("PMI_RANK"))
+    size = os.environ.get("OMPI_COMM_WORLD_SIZE",
+                          os.environ.get("PMI_SIZE"))
+    if rank is None or size is None:
+        return None
+    rank, size = int(rank), int(size)
+    local_rank = int(os.environ.get("OMPI_COMM_WORLD_LOCAL_RANK",
+                                    os.environ.get("MPI_LOCALRANKID", 0)))
+    local_size = int(os.environ.get("OMPI_COMM_WORLD_LOCAL_SIZE",
+                                    os.environ.get("MPI_LOCALNRANKS", 1)))
+    # uniform-slots assumption for the derived cross axis (the ssh path
+    # computes exact values; heterogeneous MPI jobs should set HVD_*)
+    cross_size = max(size // max(local_size, 1), 1)
+    return Topology(rank, size, local_rank, local_size,
+                    cross_rank=rank // max(local_size, 1),
+                    cross_size=cross_size, mode="process")
+
+
+def from_env() -> "Topology | None":
+    """Build topology from the hvdrun env contract, if present; fall
+    back to MPI-runtime placement variables (mpirun/jsrun delegation)."""
+    if os.environ.get(env_util.HVD_RANK) is None:
+        return _mpi_placed()
     rank = env_util.get_int(env_util.HVD_RANK, 0)
     size = env_util.get_int(env_util.HVD_SIZE, 1)
     local_rank = env_util.get_int(env_util.HVD_LOCAL_RANK, rank)

@@ -32,6 +32,12 @@ def make_parser():
     parser.add_argument("--hostfile", default=None,
                         help="File with one 'hostname slots=N' per line.")
     parser.add_argument("--ssh-port", type=int, default=None)
+    parser.add_argument("--launcher", choices=["ssh", "mpirun", "jsrun"],
+                        default="ssh",
+                        help="Process placement: built-in ssh fan-out "
+                             "(default), one mpirun invocation, or jsrun "
+                             "on LSF (workers derive ranks from the MPI "
+                             "runtime env).")
     parser.add_argument("--tpu", action="store_true",
                         help="TPU pod mode: one process per host; ranks map "
                              "onto pod-slice coordinates and in-process "
@@ -101,7 +107,16 @@ def build_slots(args):
     elif args.hosts:
         hosts = allocate_mod.parse_hosts(args.hosts)
     else:
-        hosts = [allocate_mod.HostInfo("localhost", args.num_proc)]
+        from horovod_tpu.run import lsf
+        spec = lsf.host_spec() if lsf.using_lsf() else None
+        if spec:
+            # inside an LSF job the allocation is the host list
+            # (reference: runner.py LSF auto-discovery via util/lsf.py)
+            hosts = allocate_mod.parse_hosts(spec)
+            if args.num_proc is None:
+                args.num_proc = lsf.get_num_processes()
+        else:
+            hosts = [allocate_mod.HostInfo("localhost", args.num_proc)]
     if args.tpu:
         # one process per host; each process drives that host's chips as its
         # local ranks (device-rank mode under the hood)
@@ -119,7 +134,12 @@ def run_commandline(argv=None) -> int:
     if not args.command:
         parser.error("no training command given")
     if args.num_proc is None and not args.tpu:
-        parser.error("-np is required (or use --tpu)")
+        from horovod_tpu.run import lsf
+        if lsf.using_lsf():
+            args.num_proc = lsf.get_num_processes()
+        if args.num_proc is None:
+            parser.error("-np is required (or use --tpu, or run inside "
+                         "an LSF allocation)")
 
     if args.config_file:
         config_parser.apply_config_to_args(
@@ -138,6 +158,9 @@ def run_commandline(argv=None) -> int:
         from horovod_tpu.run.service import secret
         extra_env[env_util.HVD_SECRET_KEY] = base64.b64encode(
             secret.make_secret_key()).decode()
+
+    if args.launcher != "ssh":
+        return _delegate_launch(args, slots, extra_env)
 
     # fail fast with the full unreachable-host list before launching
     # anything (reference: runner.py:568-643 parallel cached ssh check)
@@ -165,6 +188,40 @@ def run_commandline(argv=None) -> int:
                           ssh_port=args.ssh_port, verbose=args.verbose)
     finally:
         rendezvous.stop()
+
+
+def _delegate_launch(args, slots, extra_env):
+    """mpirun / jsrun placement: start the rendezvous here, export the
+    constant env contract (per-rank values come from the MPI runtime —
+    ``common/topology._mpi_placed``), run ONE placement command."""
+    rendezvous = RendezvousServer()
+    port = rendezvous.start()
+    addr = os.environ.get("HVD_RENDEZVOUS_HOST_ADDR") \
+        or _routable_addr(slots)
+    env = dict(os.environ)
+    env.update(extra_env)
+    env[env_util.HVD_SIZE] = str(len(slots))
+    env[env_util.HVD_RENDEZVOUS_ADDR] = addr
+    env[env_util.HVD_RENDEZVOUS_PORT] = str(port)
+    hosts_spec = ",".join(
+        f"{h}:{n}" for h, n in
+        _slots_by_host(slots).items())
+    try:
+        if args.launcher == "mpirun":
+            from horovod_tpu.run import mpi_run
+            return mpi_run.mpi_run(len(slots), hosts_spec, args.command,
+                                   env=env)
+        from horovod_tpu.run import js_run
+        return js_run.js_run(len(slots), args.command, env=env)
+    finally:
+        rendezvous.stop()
+
+
+def _slots_by_host(slots):
+    out = {}
+    for s in slots:
+        out[s.hostname] = out.get(s.hostname, 0) + 1
+    return out
 
 
 def _routable_addr(slots):

@@ -1,0 +1,239 @@
+"""Alternate process placement: mpirun / jsrun delegation + LSF
+discovery (reference: ``test/test_run.py`` — no cluster needed, the
+launcher command strings and env parsing are asserted directly)."""
+
+import os
+import subprocess
+from unittest import mock
+
+import pytest
+
+from horovod_tpu.run import js_run, lsf, mpi_run
+
+
+class _FakeProc:
+    def __init__(self, stdout="", stderr=""):
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def _runner(version_text):
+    def run(argv, **kwargs):
+        assert argv == ["mpirun", "--version"]
+        return _FakeProc(stdout=version_text)
+    return run
+
+
+# ------------------------------------------------------------------ mpirun
+def test_detect_openmpi(monkeypatch):
+    monkeypatch.setattr("shutil.which", lambda _: "/usr/bin/mpirun")
+    assert mpi_run.detect_impl(_runner(
+        "mpirun (Open MPI) 4.1.4")) == mpi_run.OPENMPI
+
+
+def test_detect_spectrum_and_mpich(monkeypatch):
+    monkeypatch.setattr("shutil.which", lambda _: "/usr/bin/mpirun")
+    assert mpi_run.detect_impl(_runner(
+        "IBM Spectrum MPI 10.3")) == mpi_run.SPECTRUM
+    assert mpi_run.detect_impl(_runner(
+        "HYDRA build details:")) == mpi_run.MPICH
+
+
+def test_detect_missing(monkeypatch):
+    monkeypatch.setattr("shutil.which", lambda _: None)
+    assert mpi_run.detect_impl() == mpi_run.MISSING
+    assert not mpi_run.mpi_available()
+
+
+def test_build_mpirun_command_openmpi():
+    env = {"HVD_SIZE": "4", "PATH": "/usr/bin", "HOME": "/root",
+           "JAX_PLATFORMS": "cpu"}
+    argv = mpi_run.build_mpirun_command(
+        4, "h1:2,h2:2", ["python", "train.py"], env=env,
+        impl=mpi_run.OPENMPI)
+    s = " ".join(argv)
+    assert s.startswith("mpirun --allow-run-as-root -np 4 -H h1:2,h2:2")
+    assert "--bind-to none" in s and "--map-by slot" in s
+    # env passthrough covers the contract prefixes, not everything
+    assert "-x HVD_SIZE" in s and "-x JAX_PLATFORMS" in s
+    assert "-x PATH" in s
+    assert "-x HOME" not in s
+    assert s.endswith("python train.py")
+    # small cluster: no tree-spawn tuning
+    assert "plm_rsh_no_tree_spawn" not in s
+
+
+def test_build_mpirun_command_large_cluster():
+    hosts = ",".join(f"h{i}:1" for i in range(70))
+    argv = mpi_run.build_mpirun_command(
+        70, hosts, ["python", "t.py"], env={}, impl=mpi_run.OPENMPI)
+    s = " ".join(argv)
+    assert "plm_rsh_no_tree_spawn true" in s
+
+
+def test_build_mpirun_command_requires_mpi():
+    with pytest.raises(RuntimeError, match="no usable MPI"):
+        mpi_run.build_mpirun_command(2, "h1:2", ["x"], env={},
+                                     impl=mpi_run.MISSING)
+
+
+# --------------------------------------------------------------------- LSF
+def test_lsf_discovery_mcpu(monkeypatch):
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeA 4 nodeB 2")
+    assert lsf.using_lsf()
+    assert lsf.get_compute_hosts() == ["nodeA", "nodeB"]
+    assert lsf.get_slots_per_host() == {"nodeA": 4, "nodeB": 2}
+    assert lsf.get_num_processes() == 6
+    assert lsf.host_spec() == "nodeA:4,nodeB:2"
+
+
+def test_lsf_discovery_lsb_hosts(monkeypatch):
+    monkeypatch.delenv("LSB_MCPU_HOSTS", raising=False)
+    monkeypatch.setenv("LSB_HOSTS", "n1 n1 n2")
+    assert lsf.get_compute_hosts() == ["n1", "n2"]
+    assert lsf.get_slots_per_host() == {"n1": 2, "n2": 1}
+
+
+def test_lsf_absent(monkeypatch):
+    for var in ("LSB_JOBID", "LSB_MCPU_HOSTS", "LSB_HOSTS"):
+        monkeypatch.delenv(var, raising=False)
+    assert not lsf.using_lsf()
+    assert lsf.host_spec() is None
+    assert lsf.get_num_processes() is None
+
+
+# ------------------------------------------------------------------- jsrun
+def test_jsrun_rankfile(tmp_path):
+    path = js_run.generate_rankfile({"nodeA": 2, "nodeB": 1},
+                                    path=str(tmp_path / "rf.erf"))
+    text = open(path).read()
+    assert "rank: 0: { hostname: nodeA" in text
+    assert "rank: 1: { hostname: nodeA" in text
+    assert "rank: 2: { hostname: nodeB" in text
+
+
+def test_jsrun_command_with_rankfile():
+    argv = js_run.build_jsrun_command(3, ["python", "t.py"],
+                                      rankfile="/tmp/rf.erf")
+    s = " ".join(argv)
+    assert s.startswith("jsrun --erf_input /tmp/rf.erf")
+    assert s.endswith("python t.py")
+
+
+def test_jsrun_requires_lsf(monkeypatch):
+    monkeypatch.delenv("LSB_JOBID", raising=False)
+    with pytest.raises(RuntimeError, match="LSF"):
+        js_run.js_run(2, ["x"])
+
+
+# -------------------------------------------------- MPI-placed topology
+def test_topology_from_mpi_env(monkeypatch):
+    from horovod_tpu.common import topology
+
+    for var in ("HVD_RANK",):
+        monkeypatch.delenv(var, raising=False)
+    # the delegation contract gates the fallback
+    monkeypatch.setenv("HVD_RENDEZVOUS_ADDR", "10.0.0.1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "5")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "8")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "4")
+    topo = topology.from_env()
+    assert (topo.rank, topo.size) == (5, 8)
+    assert (topo.local_rank, topo.local_size) == (1, 4)
+    assert (topo.cross_rank, topo.cross_size) == (1, 2)
+
+
+def test_topology_hvd_contract_wins(monkeypatch):
+    from horovod_tpu.common import topology
+
+    monkeypatch.setenv("HVD_RANK", "2")
+    monkeypatch.setenv("HVD_SIZE", "4")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "7")  # stale; ignored
+    topo = topology.from_env()
+    assert topo.rank == 2 and topo.size == 4
+
+
+# -------------------------------------------------- runner flag plumbing
+def test_runner_launcher_flag_delegates(monkeypatch):
+    from horovod_tpu.run import runner
+
+    called = {}
+
+    def fake_mpi_run(np_, hosts, command, env=None, extra_args=None):
+        called.update(np=np_, hosts=hosts, command=command,
+                      env=dict(env or {}))
+        return 0
+
+    monkeypatch.setattr("horovod_tpu.run.mpi_run.mpi_run", fake_mpi_run)
+    rc = runner.run_commandline(
+        ["--launcher", "mpirun", "-np", "2", "-H", "hostX:2",
+         "python", "train.py"])
+    assert rc == 0
+    assert called["np"] == 2
+    assert called["hosts"] == "hostX:2"
+    assert called["command"] == ["python", "train.py"]
+    assert called["env"]["HVD_SIZE"] == "2"
+    assert "HVD_RENDEZVOUS_ADDR" in called["env"]
+
+
+def test_build_slots_lsf_auto_discovery(monkeypatch):
+    from horovod_tpu.run import runner
+
+    monkeypatch.setenv("LSB_JOBID", "9")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nA 2 nB 2")
+    args = runner.make_parser().parse_args(["python", "t.py"])
+    slots = runner.build_slots(args)
+    assert len(slots) == 4
+    assert sorted({s.hostname for s in slots}) == ["nA", "nB"]
+
+
+def test_build_mpirun_command_mpich_hydra_syntax():
+    env = {"HVD_SIZE": "2", "PATH": "/usr/bin", "HOME": "/root"}
+    argv = mpi_run.build_mpirun_command(
+        2, "h1:1,h2:1", ["python", "t.py"], env=env, impl=mpi_run.MPICH)
+    s = " ".join(argv)
+    assert "--allow-run-as-root" not in s and "-x " not in f"{s} "
+    assert "-hosts h1,h2" in s
+    assert "-envlist HVD_SIZE,PATH" in s
+    assert s.endswith("python t.py")
+
+
+def test_jsrun_trims_allocation_to_num_proc():
+    trimmed = js_run._trim_allocation({"nA": 4, "nB": 2}, 5)
+    assert trimmed == {"nA": 4, "nB": 1}
+    with pytest.raises(RuntimeError, match="only 6 slots"):
+        js_run._trim_allocation({"nA": 4, "nB": 2}, 7)
+
+
+def test_topology_mpi_fallback_requires_delegation_contract(monkeypatch):
+    """Plain `mpirun python train.py` WITHOUT hvdrun must keep
+    device-rank mode — the fallback engages only with the rendezvous
+    contract exported by the delegating launcher."""
+    from horovod_tpu.common import topology
+
+    monkeypatch.delenv("HVD_RANK", raising=False)
+    monkeypatch.delenv("HVD_RENDEZVOUS_ADDR", raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    assert topology.from_env() is None
+
+
+def test_runner_lsf_fills_num_proc(monkeypatch):
+    from horovod_tpu.run import runner
+
+    called = {}
+
+    def fake_mpi_run(np_, hosts, command, env=None, extra_args=None):
+        called.update(np=np_, hosts=hosts)
+        return 0
+
+    monkeypatch.setattr("horovod_tpu.run.mpi_run.mpi_run", fake_mpi_run)
+    monkeypatch.setenv("LSB_JOBID", "3")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nA 2 nB 2")
+    rc = runner.run_commandline(
+        ["--launcher", "mpirun", "python", "t.py"])  # no -np
+    assert rc == 0
+    assert called["np"] == 4
+    assert called["hosts"] == "nA:2,nB:2"
