@@ -56,6 +56,7 @@ from horovod_tpu.ops.eager import (  # noqa: F401
 )
 from horovod_tpu.jax_api import (  # noqa: F401
     DistributedOptimizer,
+    ShardedDistributedOptimizer,
     broadcast_parameters,
     allreduce_gradients,
 )

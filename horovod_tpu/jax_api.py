@@ -90,6 +90,82 @@ def DistributedOptimizer(optimizer, named_axes=("hvd",), op=Average,
     return chained
 
 
+def ShardedDistributedOptimizer(optimizer, axis_name="hvd", op=Average,
+                                compression=Compression.none):
+    """Cross-replica sharded weight update — ZeRO-1 on the data-parallel
+    axis (the technique is TPU-native in origin: arXiv:2004.13336,
+    "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+    Training"; the reference framework has no analog).
+
+    Instead of every replica reducing the FULL gradient and holding the
+    FULL optimizer state, each replica:
+
+    1. ``psum_scatter``s the flattened gradient — one 1/N shard arrives
+       reduced (half the ICI traffic of a full allreduce),
+    2. applies the inner optimizer to its shard only (optimizer state is
+       1/N per replica — Adam on a P-param model stores 2P/N here),
+    3. ``all_gather``s the update shards back to apply everywhere.
+
+    Both ``init`` and ``update`` must run INSIDE ``shard_map`` over
+    ``axis_name`` (init the state in a jitted sharded step — see
+    ``tests/test_spmd.py``).  Use
+    ``horovod_tpu.parallel._compat.shard_map_unchecked``: the gathered
+    updates ARE replicated, but jax's varying-manual-axes checker cannot
+    infer replication through ``all_gather`` (no public un-vary
+    annotation exists), so the check must be off for the step.  Average
+    divides by the axis size; Adasum is not supported (its combination
+    needs full vectors).
+    """
+    from jax.flatten_util import ravel_pytree
+
+    import jax.numpy as jnp
+
+    op_ = ReduceOp(op)
+    if op_ == Adasum:
+        raise ValueError(
+            "ShardedDistributedOptimizer does not support Adasum; use "
+            "DistributedOptimizer(op=Adasum)")
+
+    def _layout(flat):
+        n = jax.lax.psum(1, axis_name)  # concrete inside shard_map
+        chunk = -(-flat.size // n)
+        return n, chunk
+
+    def _my_shard(flat):
+        n, chunk = _layout(flat)
+        padded = jnp.pad(flat, (0, n * chunk - flat.size))
+        return jax.lax.dynamic_slice(
+            padded, (jax.lax.axis_index(axis_name) * chunk,), (chunk,))
+
+    def init_fn(params):
+        flat, _ = ravel_pytree(params)
+        return optimizer.init(_my_shard(flat))
+
+    def update_fn(grads, state, params=None):
+        flat_g, unravel = ravel_pytree(grads)
+        n, chunk = _layout(flat_g)
+
+        compressed, ctx = compression.compress(flat_g)
+        padded = jnp.pad(compressed, (0, n * chunk - flat_g.size))
+        g_shard = jax.lax.psum_scatter(
+            padded.reshape(n, chunk), axis_name, scatter_dimension=0)
+        g_shard = compression.decompress(g_shard, ctx)
+        if op_ == Average:
+            g_shard = g_shard / n
+
+        p_shard = None
+        if params is not None:
+            flat_p, _ = ravel_pytree(params)
+            p_shard = _my_shard(flat_p)
+        upd_shard, new_state = optimizer.update(g_shard, state, p_shard)
+
+        full = jax.lax.all_gather(upd_shard, axis_name,
+                                  tiled=True)[:flat_g.size]
+        return unravel(full), new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def broadcast_parameters(params, root_rank=0):
     """Broadcast a parameter pytree from ``root_rank`` to all ranks via the
     eager collective path (reference: ``horovod/torch/__init__.py:452``).

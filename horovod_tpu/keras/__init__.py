@@ -36,7 +36,8 @@ def _require_keras():
 
 
 def DistributedOptimizer(optimizer, name=None, op=Average,
-                         compression=None, backward_passes_per_step=1):
+                         compression=None, backward_passes_per_step=1,
+                         sparse_as_dense=False):
     """Keras flavor of the TF binding's optimizer wrapper (reference:
     ``keras/__init__.py`` delegating to ``_keras/__init__.py:48``)."""
     _require_keras()
@@ -44,7 +45,8 @@ def DistributedOptimizer(optimizer, name=None, op=Average,
 
     return hvd_tf.DistributedOptimizer(
         optimizer, name=name, op=op, compression=compression,
-        backward_passes_per_step=backward_passes_per_step)
+        backward_passes_per_step=backward_passes_per_step,
+        sparse_as_dense=sparse_as_dense)
 
 
 def broadcast_global_variables(model_or_variables, root_rank=0):
@@ -58,13 +60,16 @@ def broadcast_global_variables(model_or_variables, root_rank=0):
     hvd_tf.broadcast_variables(variables, root_rank)
 
 
-def load_model(filepath, custom_objects=None, compression=None):
+def load_model(filepath, custom_objects=None, compression=None,
+               sparse_as_dense=False):
     """Load a Keras model and wrap its optimizer (reference:
     ``keras/__init__.py:117`` load_model with optimizer rehydration).
 
     Models saved with a wrapped optimizer serialize the dynamic
     ``Distributed<Base>`` class name; wrappers for every standard keras
-    optimizer are pre-registered here so such saves round-trip."""
+    optimizer are pre-registered here so such saves round-trip.  Like
+    ``compression``, ``sparse_as_dense`` is not serialized — pass it
+    again when reloading a model that trained with it."""
     _require_keras()
     from horovod_tpu.tensorflow import _make_distributed_class
 
@@ -74,13 +79,15 @@ def load_model(filepath, custom_objects=None, compression=None):
         if isinstance(obj, type) \
                 and issubclass(obj, _keras.optimizers.Optimizer) \
                 and obj is not _keras.optimizers.Optimizer:
-            cls = _make_distributed_class(obj, compression=compression)
+            cls = _make_distributed_class(obj, compression=compression,
+                                          sparse_as_dense=sparse_as_dense)
             custom.setdefault(cls.__name__, cls)
     model = _keras.models.load_model(filepath, custom_objects=custom)
     if getattr(model, "optimizer", None) is not None and not getattr(
             model.optimizer, "_hvd_wrapped", False):
-        model.optimizer = DistributedOptimizer(model.optimizer,
-                                               compression=compression)
+        model.optimizer = DistributedOptimizer(
+            model.optimizer, compression=compression,
+            sparse_as_dense=sparse_as_dense)
     return model
 
 

@@ -266,7 +266,7 @@ def DistributedGradientTape(gradtape, op=Average, compression=None,
 
 def _allreduce_grads(gradients, op=Average, compression=None,
                      prescale_factor=1.0, postscale_factor=1.0,
-                     name_prefix="grad"):
+                     name_prefix="grad", sparse_as_dense=False):
     flat_is_list = isinstance(gradients, (list, tuple))
     grads = list(gradients) if flat_is_list else [gradients]
     out = []
@@ -274,6 +274,10 @@ def _allreduce_grads(gradients, op=Average, compression=None,
         if grad is None:
             out.append(None)
         else:
+            if sparse_as_dense and isinstance(grad, _tf.IndexedSlices):
+                # reference: convert_to_tensor before the dense
+                # allreduce (tensorflow/__init__.py:240)
+                grad = _tf.convert_to_tensor(grad)
             out.append(allreduce(
                 grad, op=op, name=f"{name_prefix}.{i}",
                 prescale_factor=prescale_factor,
@@ -287,7 +291,8 @@ def _allreduce_grads(gradients, op=Average, compression=None,
 # -------------------------------------------------------------- optimizer
 def _make_distributed_class(base_cls, name=None, op=Average,
                             compression=None, backward_passes_per_step=1,
-                            prescale_factor=1.0, postscale_factor=1.0):
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            sparse_as_dense=False):
     """Build the dynamic ``Distributed<Base>`` optimizer class.  Exposed
     separately so ``keras.load_model`` can reconstruct serialized
     instances (the class name lands in saved model configs)."""
@@ -332,7 +337,8 @@ def _make_distributed_class(base_cls, name=None, op=Average,
                 grads, op=op, compression=compression,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
-                name_prefix=f"opt.{name or 'grad'}.{state['rounds']}")
+                name_prefix=f"opt.{name or 'grad'}.{state['rounds']}",
+                sparse_as_dense=sparse_as_dense)
             return super().apply_gradients(
                 zip(reduced, hvariables), *args, **kwargs)
 
@@ -352,11 +358,12 @@ def DistributedOptimizer(optimizer, name=None, op=Average,
     and exchanges every N-th call (reference:
     ``gradient_aggregation_eager.py`` semantics)."""
     _require_tf()
-    del device_dense, device_sparse, sparse_as_dense
+    del device_dense, device_sparse
     cls = _make_distributed_class(
         optimizer.__class__, name=name, op=op, compression=compression,
         backward_passes_per_step=backward_passes_per_step,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        sparse_as_dense=sparse_as_dense)
     return cls.from_config(optimizer.get_config())
 
 

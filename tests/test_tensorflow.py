@@ -258,3 +258,52 @@ def test_keras_fit_with_callbacks_2proc():
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-4000:]}"
     assert result.stdout.count("KERAS_OK") == 2
+
+
+SPARSE_AS_DENSE_WORKER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import tensorflow as tf
+import keras
+import horovod_tpu.tensorflow as hvd
+
+hvd.init()
+r = hvd.rank()
+
+# an embedding layer produces IndexedSlices gradients; with
+# sparse_as_dense=True they are converted and dense-allreduced
+model = keras.Sequential([
+    keras.layers.Embedding(16, 4),
+    keras.layers.Flatten(),
+    keras.layers.Dense(1),
+])
+model.build((None, 3))
+hvd.broadcast_variables(model.variables, root_rank=0)
+
+opt = hvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.1),
+                               sparse_as_dense=True)
+x = tf.constant([[r, 2, 5]], dtype=tf.int32)
+y = tf.constant([[1.0]])
+with tf.GradientTape() as tape:
+    loss = tf.reduce_mean((model(x) - y) ** 2)
+grads = tape.gradient(loss, model.trainable_variables)
+assert any(isinstance(g, tf.IndexedSlices) for g in grads), \
+    [type(g) for g in grads]
+opt.apply_gradients(zip(grads, model.trainable_variables))
+
+# replicas identical after the sparse->dense exchange
+digest = float(sum(np.sum(v.numpy().astype(np.float64))
+                   for v in model.variables))
+digests = hvd.allgather(tf.constant([digest]), name="sd").numpy()
+np.testing.assert_allclose(digests[0], digests[1], rtol=1e-10)
+print(f"rank {r} SPARSE_AS_DENSE_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_sparse_as_dense_2proc():
+    result = _run_hvdrun(2, SPARSE_AS_DENSE_WORKER)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-4000:]}"
+    assert result.stdout.count("SPARSE_AS_DENSE_OK") == 2
