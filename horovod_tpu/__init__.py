@@ -59,5 +59,8 @@ from horovod_tpu.jax_api import (  # noqa: F401
     ShardedDistributedOptimizer,
     broadcast_parameters,
     allreduce_gradients,
+    shard_chunk_size,
+    sharded_state_wrap,
+    sharded_state_unwrap,
 )
 from horovod_tpu.common.compression import Compression  # noqa: F401

@@ -128,8 +128,7 @@ def ShardedDistributedOptimizer(optimizer, axis_name="hvd", op=Average,
 
     def _layout(flat):
         n = jax.lax.psum(1, axis_name)  # concrete inside shard_map
-        chunk = -(-flat.size // n)
-        return n, chunk
+        return n, shard_chunk_size(flat.size, n)
 
     def _my_shard(flat):
         n, chunk = _layout(flat)
@@ -164,6 +163,28 @@ def ShardedDistributedOptimizer(optimizer, axis_name="hvd", op=Average,
         return unravel(full), new_state
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def shard_chunk_size(n_params, axis_size):
+    """Per-replica flat-shard length the sharded optimizer uses
+    (ceil-divided so the last shard is zero-padded)."""
+    return -(-n_params // axis_size)
+
+
+def sharded_state_wrap(state):
+    """Prepare a ShardedDistributedOptimizer state to LEAVE a
+    ``shard_map`` region: every leaf (including scalar counters) gains a
+    leading length-1 per-rank axis so ``out_specs=P(axis)`` can
+    concatenate the per-replica shards."""
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda a: jnp.asarray(a)[None], state)
+
+
+def sharded_state_unwrap(state):
+    """Inverse of :func:`sharded_state_wrap` on ENTRY to the region
+    (``in_specs=P(axis)`` hands each replica its own length-1 slice)."""
+    return jax.tree.map(lambda a: a[0], state)
 
 
 def broadcast_parameters(params, root_rank=0):

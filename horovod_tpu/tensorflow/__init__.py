@@ -222,12 +222,14 @@ class _DistributedGradientTape:
     (reference: ``tensorflow/__init__.py:515`` _DistributedGradientTape)."""
 
     def __init__(self, tape, op=Average, compression=None,
-                 prescale_factor=1.0, postscale_factor=1.0):
+                 prescale_factor=1.0, postscale_factor=1.0,
+                 sparse_as_dense=False):
         self.__dict__["_tape"] = tape
         self.__dict__["_op"] = op
         self.__dict__["_compression"] = compression
         self.__dict__["_prescale"] = prescale_factor
         self.__dict__["_postscale"] = postscale_factor
+        self.__dict__["_sparse_as_dense"] = sparse_as_dense
         self.__dict__["_counter"] = 0
 
     def __enter__(self):
@@ -247,13 +249,14 @@ class _DistributedGradientTape:
             gradients, op=self._op, compression=self._compression,
             prescale_factor=self._prescale,
             postscale_factor=self._postscale,
-            name_prefix=f"tape{self._counter}")
+            name_prefix=f"tape{self._counter}",
+            sparse_as_dense=self._sparse_as_dense)
 
 
 def DistributedGradientTape(gradtape, op=Average, compression=None,
                             prescale_factor=1.0, postscale_factor=1.0,
                             device_dense="", device_sparse="",
-                            persistent=False):
+                            persistent=False, sparse_as_dense=False):
     """Factory matching the reference signature
     (``tensorflow/__init__.py:535``); device args accepted for API
     compatibility (placement is the data plane's concern here)."""
@@ -261,7 +264,8 @@ def DistributedGradientTape(gradtape, op=Average, compression=None,
     del device_dense, device_sparse, persistent
     return _DistributedGradientTape(
         gradtape, op=op, compression=compression,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        sparse_as_dense=sparse_as_dense)
 
 
 def _allreduce_grads(gradients, op=Average, compression=None,

@@ -56,6 +56,8 @@ def _run_example(name, *args, timeout=420):
     ("adasum_bench.py", ("--steps", "10", "--lrs", "0.05", "0.2",
                          "--tp-bytes", "65536")),
     ("mxnet_mnist.py", ()),  # prints a clean notice when mxnet absent
+    ("zero1_sharded_optimizer.py", ("--steps", "12", "--batch-size",
+                                    "64", "--hidden", "32")),
 ])
 def test_example_runs(name, args):
     result = _run_example(name, *args)

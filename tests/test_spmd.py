@@ -261,7 +261,7 @@ def test_sharded_optimizer_matches_unsharded(hvd_init, mesh):
                                    rtol=1e-6, atol=1e-7)
 
     # each replica's Adam mu is the padded 1/8 chunk, not the full vector
-    chunk = -(-n_params // 8)
+    chunk = hvd.shard_chunk_size(n_params, 8)
     assert mu_gathered.size == 8 * chunk
     assert chunk < n_params
 
@@ -279,21 +279,16 @@ def test_sharded_optimizer_trains(hvd_init, mesh):
     # the sharded state crosses the shard_map boundary as a per-rank
     # value: every leaf (including Adam's scalar count) gets a leading
     # length-1 axis inside so out_specs=P("hvd") can concatenate it
-    def _wrap(state):
-        return jax.tree.map(lambda s: jnp.asarray(s)[None], state)
-
-    def _unwrap(state):
-        return jax.tree.map(lambda s: s[0], state)
-
     def init_state(params):
-        return _wrap(opt.init(params))
+        return hvd.sharded_state_wrap(opt.init(params))
 
     def step(params, state, x, y):
         loss, grads = jax.value_and_grad(
             lambda p: _loss_fn(model, p, x, y))(params)
-        updates, state = opt.update(grads, _unwrap(state), params)
-        return optax.apply_updates(params, updates), _wrap(state), \
-            jax.lax.pmean(loss, "hvd")
+        updates, state = opt.update(
+            grads, hvd.sharded_state_unwrap(state), params)
+        return optax.apply_updates(params, updates), \
+            hvd.sharded_state_wrap(state), jax.lax.pmean(loss, "hvd")
 
     init_fn = jax.jit(shard_map_unchecked(
         init_state, mesh=mesh, in_specs=P(), out_specs=P("hvd")))
