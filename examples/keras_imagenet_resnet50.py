@@ -1,0 +1,97 @@
+"""ImageNet ResNet-50 training (Keras binding).
+
+Completes the reference's ResNet-50 trio (keras / pytorch / mxnet
+flavors — ``examples/keras_imagenet_resnet50.py``): LR scaled by world
+size with warmup callbacks, rank-0 checkpointing and verbosity, resume
+from the latest checkpoint via a broadcast epoch.  Uses
+``keras.applications.ResNet50`` (weights=None); synthetic
+ImageNet-shaped data unless a loader is wired in.
+
+    hvdrun -np 8 python examples/keras_imagenet_resnet50.py
+"""
+
+import argparse
+import os
+
+
+def parse_args():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--base-lr", type=float, default=0.0125)
+    parser.add_argument("--warmup-epochs", type=int, default=1)
+    parser.add_argument("--num-samples", type=int, default=64)
+    parser.add_argument("--img", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--checkpoint-dir", default="/tmp/keras-rn50")
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    import numpy as np
+    import keras
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+
+    # resume: rank 0 looks for the newest checkpoint; its epoch is
+    # broadcast so every rank starts together (reference pattern:
+    # resume_from_epoch broadcast with name='resume_from_epoch')
+    resume_epoch = 0
+    ckpt_tmpl = os.path.join(args.checkpoint_dir,
+                             "checkpoint-{epoch}.keras")
+    if hvd.rank() == 0:
+        os.makedirs(args.checkpoint_dir, exist_ok=True)
+        for epoch in range(args.epochs, 0, -1):
+            if os.path.exists(ckpt_tmpl.format(epoch=epoch)):
+                resume_epoch = epoch
+                break
+    resume_epoch = hvd.broadcast_object(resume_epoch, root_rank=0,
+                                        name="resume_from_epoch")
+
+    if resume_epoch > 0 and hvd.rank() == 0:
+        # only rank 0 has the checkpoint file; the broadcast callback
+        # below syncs its weights to every other rank at train begin
+        # (reference: keras_imagenet_resnet50.py resume pattern)
+        model = hvd.load_model(ckpt_tmpl.format(epoch=resume_epoch))
+    else:
+        # without warmup the scaled LR applies from step 0; with it the
+        # warmup callback ramps base_lr -> base_lr * size
+        lr = args.base_lr * (hvd.size() if args.warmup_epochs == 0 else 1)
+        model = keras.applications.ResNet50(
+            weights=None, classes=args.num_classes,
+            input_shape=(args.img, args.img, 3))
+        opt = hvd.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=lr, momentum=0.9))
+        model.compile(optimizer=opt,
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"], run_eagerly=True)
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ]
+    if args.warmup_epochs > 0:
+        callbacks.append(hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=args.base_lr,
+            warmup_epochs=args.warmup_epochs,
+            steps_per_epoch=max(args.num_samples // args.batch_size, 1)))
+    if hvd.rank() == 0:
+        callbacks.append(keras.callbacks.ModelCheckpoint(ckpt_tmpl))
+
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(args.num_samples, args.img, args.img, 3) \
+        .astype(np.float32)
+    y = rng.randint(0, args.num_classes, (args.num_samples,))
+
+    model.fit(x, y, batch_size=args.batch_size,
+              initial_epoch=resume_epoch, epochs=args.epochs,
+              callbacks=callbacks,
+              verbose=1 if hvd.rank() == 0 else 0)
+    print("KERAS RESNET50 DONE")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,13 @@ local_rank = _basics.local_rank
 local_size = _basics.local_size
 
 
+def broadcast_object(obj, root_rank=0, name=None):
+    """Pickle-based object broadcast (delegates to the TF binding)."""
+    from horovod_tpu import tensorflow as hvd_tf
+
+    return hvd_tf.broadcast_object(obj, root_rank=root_rank, name=name)
+
+
 def _require_keras():
     if _keras is None:  # pragma: no cover
         raise ImportError(

@@ -1,0 +1,7 @@
+"""Import-path parity with ``horovod.spark.keras`` (reference:
+``horovod/spark/keras/__init__.py`` — KerasEstimator lives under the
+spark namespace there).  The estimator itself is Spark-free
+(:mod:`horovod_tpu.cluster`); pair it with a Backend built on
+:func:`horovod_tpu.spark.run` on a real Spark cluster."""
+
+from horovod_tpu.cluster import KerasEstimator, LocalStore, Store  # noqa: F401

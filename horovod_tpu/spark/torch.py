@@ -1,0 +1,4 @@
+"""Import-path parity with ``horovod.spark.torch`` (reference:
+``horovod/spark/torch/__init__.py``)."""
+
+from horovod_tpu.cluster import LocalStore, Store, TorchEstimator  # noqa: F401

@@ -148,3 +148,14 @@ def test_spark_module_imports_and_guards():
         import pytest
         with pytest.raises(ImportError, match="PySpark"):
             hvd_spark.run(lambda: None)
+
+
+def test_spark_submodule_import_path_parity():
+    """``horovod.spark.keras`` / ``horovod.spark.torch`` import paths
+    resolve here too (reference namespace layout)."""
+    from horovod_tpu.spark import keras as spark_keras
+    from horovod_tpu.spark import torch as spark_torch
+
+    assert spark_keras.KerasEstimator is not None
+    assert spark_keras.Store is not None
+    assert spark_torch.TorchEstimator is not None

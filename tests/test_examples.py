@@ -160,3 +160,23 @@ def test_tf2_examples_under_hvdrun():
         assert result.returncode == 0, \
             f"{name} failed\nstdout:\n{result.stdout}\n" \
             f"stderr:\n{result.stderr}"
+
+
+def test_keras_imagenet_resnet50_train_and_resume(tmp_path):
+    import pytest
+    pytest.importorskip("tensorflow")
+    ckpt_dir = str(tmp_path / "krn50")
+    args = ("--epochs", "1", "--batch-size", "2", "--num-samples", "4",
+            "--img", "32", "--num-classes", "4",
+            "--checkpoint-dir", ckpt_dir)
+    first = _run_example_hvdrun("keras_imagenet_resnet50.py", *args)
+    assert first.returncode == 0, \
+        f"stdout:\n{first.stdout}\nstderr:\n{first.stderr[-3000:]}"
+    assert first.stdout.count("KERAS RESNET50 DONE") == 2
+    assert os.path.exists(os.path.join(ckpt_dir, "checkpoint-1.keras"))
+
+    # second run resumes from the rank-0 checkpoint (0 epochs left)
+    second = _run_example_hvdrun("keras_imagenet_resnet50.py", *args)
+    assert second.returncode == 0, \
+        f"stdout:\n{second.stdout}\nstderr:\n{second.stderr[-3000:]}"
+    assert second.stdout.count("KERAS RESNET50 DONE") == 2
