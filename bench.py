@@ -68,7 +68,7 @@ def _bench_resnet(devices):
     n = len(devices)
     mesh = make_mesh({"hvd": n}, devices=devices)
 
-    per_device_batch = 64
+    per_device_batch = int(os.environ.get("BENCH_BATCH", 64))
     batch = per_device_batch * n
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
 
@@ -131,12 +131,12 @@ def _bench_resnet(devices):
     # device_get of the loss is the synchronization point: it cannot
     # complete before the step's program has finished on-device.
     # (block_until_ready alone can return early on relayed backends.)
-    for _ in range(3):
+    for _ in range(int(os.environ.get("BENCH_WARMUP", 3))):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, x, y)
     float(jax.device_get(loss))
 
-    iters = 20
+    iters = int(os.environ.get("BENCH_ITERS", 20))
     start = time.perf_counter()
     for _ in range(iters):
         params, batch_stats, opt_state, loss = step(
@@ -159,24 +159,35 @@ def _bench_allreduce_bandwidth():
     """Eager hvd.allreduce algorithmic bandwidth over a size sweep."""
     import numpy as np
     import horovod_tpu as hvd
+    from horovod_tpu.common import basics
 
-    out = {}
     sizes = [1 << 10, 1 << 14, 1 << 18, 1 << 22, 1 << 24, 1 << 26,
              1 << 28]  # 1KB .. 256MB
-    for nbytes in sizes:
-        n_elem = nbytes // 4
-        x = np.ones((n_elem,), np.float32)
-        # warmup; np.asarray forces the full eager round trip.
-        np.asarray(hvd.allreduce(x, name=f"bw_{nbytes}"))
-        iters = 10 if nbytes <= (1 << 22) else 3
-        start = time.perf_counter()
-        for _ in range(iters):
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        sizes = sizes[:4]  # the one-core fallback skips the big sweep
+
+    def sweep(rank=0):
+        out = {}
+        for nbytes in sizes:
+            n_elem = nbytes // 4
+            x = np.ones((n_elem,), np.float32)
+            # warmup; np.asarray forces the full eager round trip.
             np.asarray(hvd.allreduce(x, name=f"bw_{nbytes}"))
-        elapsed = time.perf_counter() - start
-        label = (f"{nbytes // (1 << 20)}MB" if nbytes >= (1 << 20)
-                 else f"{nbytes // (1 << 10)}KB")
-        out[label] = round(nbytes * iters / elapsed / 1e9, 3)
-    return out
+            iters = 10 if nbytes <= (1 << 22) else 3
+            start = time.perf_counter()
+            for _ in range(iters):
+                np.asarray(hvd.allreduce(x, name=f"bw_{nbytes}"))
+            elapsed = time.perf_counter() - start
+            label = (f"{nbytes // (1 << 20)}MB" if nbytes >= (1 << 20)
+                     else f"{nbytes // (1 << 10)}KB")
+            out[label] = round(nbytes * iters / elapsed / 1e9, 3)
+        return out
+
+    if hvd.local_size() > 1:
+        # multi-device (e.g. the CPU fallback): every logical rank needs
+        # its own thread context; rank 0's timings are reported
+        return basics.run_parallel(sweep)[0]
+    return sweep()
 
 
 def worker():
@@ -194,6 +205,10 @@ def worker():
     threading.Thread(target=watchdog, daemon=True).start()
 
     import jax
+
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        # the axon plugin ignores JAX_PLATFORMS; pin programmatically
+        jax.config.update("jax_platforms", "cpu")
 
     devices = jax.devices()
     ready.set()
@@ -220,42 +235,101 @@ def worker():
     }))
 
 
+def _run_worker_once(extra_env=None, timeout=900):
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(
+                       os.path.abspath(__file__)), ".jax_cache"))
+    env.update(extra_env or {})
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as exc:
+        out = (exc.stdout or b"").decode("utf-8", "replace") \
+            if isinstance(exc.stdout, bytes) else (exc.stdout or "")
+        return None, out, "timeout"
+    if proc.returncode == 0:
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                return line, proc.stdout, None
+    return None, proc.stdout, f"rc={proc.returncode}"
+
+
+# Most recent successful real-TPU measurement (update when a new
+# on-chip run lands; history in BENCH_NOTES.md).
+_LAST_TPU_MEASUREMENT = {
+    "date": "2026-07-29",
+    "resnet50_synthetic_img_sec_per_chip": 2185.9,
+    "vs_baseline": 21.107,
+    "mfu": 0.265,
+}
+_CPU_FALLBACK_BATCH = 2
+
+
+def _cpu_fallback():
+    """All TPU attempts failed (observed failure mode: the axon relay
+    blocks backend init for hours — see BENCH_NOTES.md).  Emit an
+    HONEST, clearly-labeled measurement on the 8-device virtual CPU
+    mesh rather than nothing: the methodology is identical, the number
+    is a CPU number, and the extra block says so and carries the last
+    real-TPU measurement for context."""
+    line, out, err = _run_worker_once(
+        extra_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            "BENCH_CPU_FALLBACK": "1",
+            "BENCH_BATCH": str(_CPU_FALLBACK_BATCH),
+            "BENCH_ITERS": "2",
+            "BENCH_WARMUP": "1",
+        }, timeout=1800)
+    if line is None:
+        sys.stderr.write(f"cpu fallback also failed ({err}); "
+                         f"tail:\n{out[-2000:]}\n")
+        return None
+    record = json.loads(line)
+    record.setdefault("extra", {})
+    record["extra"]["platform"] = "cpu-fallback"
+    record["extra"]["cpu_fallback_batch_per_device"] = _CPU_FALLBACK_BATCH
+    m = _LAST_TPU_MEASUREMENT
+    record["extra"]["note"] = (
+        "TPU relay unreachable after all retry attempts; this is a "
+        "virtual 8-device CPU-mesh run of the same benchmark. Last "
+        f"real-TPU measurement ({m['date']}, see BENCH_NOTES.md): "
+        f"{m['resnet50_synthetic_img_sec_per_chip']} img/sec/chip, "
+        f"{m['vs_baseline']:.1f}x baseline, MFU {m['mfu']}.")
+    record["extra"]["last_tpu_measurement"] = dict(m)
+    return json.dumps(record)
+
+
 def main():
     """Supervisor: run the worker in fresh subprocesses with retries, so
-    a transiently-unavailable TPU backend doesn't fail the bench."""
+    a transiently-unavailable TPU backend doesn't fail the bench; if
+    every TPU attempt fails, fall back to a labeled CPU-mesh run so the
+    round always records SOME measurement."""
     attempts = 6
     delay = 30
     last_out = ""
     for attempt in range(attempts):
-        env = dict(os.environ)
-        env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                       os.path.join(os.path.dirname(
-                           os.path.abspath(__file__)), ".jax_cache"))
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--worker"],
-                env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, timeout=900)
-        except subprocess.TimeoutExpired as exc:
-            sys.stderr.write(
-                f"bench attempt {attempt + 1}/{attempts} timed out\n")
-            last_out = (exc.stdout or b"").decode("utf-8", "replace") \
-                if isinstance(exc.stdout, bytes) else (exc.stdout or "")
-            continue
-        last_out = proc.stdout
-        if proc.returncode == 0:
-            for line in reversed(proc.stdout.strip().splitlines()):
-                line = line.strip()
-                if line.startswith("{") and line.endswith("}"):
-                    print(line)
-                    return 0
+        line, out, err = _run_worker_once()
+        last_out = out
+        if line is not None:
+            print(line)
+            return 0
         sys.stderr.write(
-            f"bench attempt {attempt + 1}/{attempts} failed "
-            f"(rc={proc.returncode}); tail:\n{proc.stdout[-1500:]}\n")
+            f"bench attempt {attempt + 1}/{attempts} failed ({err}); "
+            f"tail:\n{out[-1500:]}\n")
         if attempt < attempts - 1:
             time.sleep(delay)
-    sys.stderr.write("bench: all attempts failed\n")
+    sys.stderr.write("bench: all TPU attempts failed; "
+                     "running labeled CPU fallback\n")
+    line = _cpu_fallback()
+    if line is not None:
+        print(line)
+        return 0
     sys.stderr.write(last_out[-3000:] + "\n")
     return 1
 
