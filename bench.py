@@ -255,6 +255,10 @@ def _run_worker_once(extra_env=None, timeout=900):
         for line in reversed(proc.stdout.strip().splitlines()):
             line = line.strip()
             if line.startswith("{") and line.endswith("}"):
+                try:
+                    json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # brace-delimited log noise, keep looking
                 return line, proc.stdout, None
     return None, proc.stdout, f"rc={proc.returncode}"
 

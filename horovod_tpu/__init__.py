@@ -54,6 +54,7 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     poll,
     join,
 )
+from horovod_tpu.common.objects import broadcast_object  # noqa: F401
 from horovod_tpu.jax_api import (  # noqa: F401
     DistributedOptimizer,
     ShardedDistributedOptimizer,

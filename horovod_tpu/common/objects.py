@@ -1,0 +1,33 @@
+"""Pickled-object collectives over the eager byte plane (reference:
+``horovod/torch/__init__.py:608`` broadcast_object — pickle to a byte
+tensor, broadcast the length then the payload)."""
+
+import pickle
+
+import numpy as np
+
+from horovod_tpu.common import basics
+from horovod_tpu.ops import eager
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Broadcast an arbitrary picklable object from ``root_rank``.
+
+    Two eager broadcasts: an int64 length, then the uint8 payload —
+    every rank must call this collectively (same contract as the
+    reference's torch/TF flavors, which this single implementation
+    backs)."""
+    name = name or "bcast_object"
+    if basics.rank() == root_rank:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        length = np.array([payload.size], dtype=np.int64)
+    else:
+        payload = None
+        length = np.zeros((1,), dtype=np.int64)
+    length = np.asarray(eager.synchronize(eager.broadcast_async(
+        length, root_rank, name=f"{name}.len")))
+    if payload is None:
+        payload = np.zeros((int(length[0]),), dtype=np.uint8)
+    out = np.asarray(eager.synchronize(eager.broadcast_async(
+        payload, root_rank, name=f"{name}.data")))
+    return pickle.loads(out.tobytes())

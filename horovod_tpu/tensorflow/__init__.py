@@ -198,22 +198,9 @@ def broadcast_variables(variables, root_rank):
 def broadcast_object(obj, root_rank=0, name=None):
     """Pickle-based object broadcast (reference:
     ``tensorflow/functions.py`` broadcast_object)."""
-    import pickle
+    from horovod_tpu.common.objects import broadcast_object as _bo
 
-    name = name or "tf_bcast_object"
-    if _basics.rank() == root_rank:
-        payload = _np.frombuffer(pickle.dumps(obj), dtype=_np.uint8)
-        length = _np.array([payload.size], dtype=_np.int64)
-    else:
-        payload = None
-        length = _np.zeros((1,), dtype=_np.int64)
-    length = _np.asarray(_eager.broadcast(length, root_rank,
-                                          name=f"{name}.len"))
-    if payload is None:
-        payload = _np.zeros((int(length[0]),), dtype=_np.uint8)
-    out = _np.asarray(_eager.broadcast(payload, root_rank,
-                                       name=f"{name}.data"))
-    return pickle.loads(out.tobytes())
+    return _bo(obj, root_rank=root_rank, name=name or "tf_bcast_object")
 
 
 # ------------------------------------------------------------ gradient tape

@@ -50,5 +50,6 @@ from horovod_tpu.torch.optimizer import (  # noqa: F401
     DistributedOptimizer,
     broadcast_parameters,
     broadcast_optimizer_state,
+    broadcast_object,
 )
 from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401

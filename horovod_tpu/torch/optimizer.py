@@ -236,3 +236,11 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
                     value, root_rank, name=f"opt_group.{gi}.{key}")
 
     optimizer.load_state_dict(state_dict)
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Broadcast an arbitrary picklable object from ``root_rank``
+    (reference: ``torch/__init__.py:608``)."""
+    from horovod_tpu.common.objects import broadcast_object as _bo
+
+    return _bo(obj, root_rank=root_rank, name=name or "torch_bcast_object")

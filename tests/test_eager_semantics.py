@@ -218,3 +218,15 @@ def test_alltoall_variable_splits_roundtrip(hvd):
             np.full((r + 1, 2), 100 * s + r, dtype=np.float32)
             for s in range(N)])
         np.testing.assert_allclose(out, expected)
+
+
+def test_broadcast_object_core_surface(hvd):
+    """hvd.broadcast_object on the core namespace (reference parity:
+    torch/__init__.py:608) — picklable python objects from root."""
+    def fn(r):
+        payload = {"cfg": [1, 2, 3], "root": r} if r == 5 else None
+        return hvd.broadcast_object(payload, root_rank=5,
+                                    name="core.obj")
+
+    for out in _per_rank(fn):
+        assert out == {"cfg": [1, 2, 3], "root": 5}

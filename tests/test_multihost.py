@@ -313,3 +313,44 @@ def test_global_mesh_stall_shutdown():
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
     assert result.stdout.count("GMESH_STALL_OK") == 2
+
+
+FOURPROC_WORKER = r"""
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.common.basics import run_parallel
+
+hvd.init()
+pid = int(os.environ["HVD_RANK"])
+assert hvd.size() == 8 and hvd.local_size() == 2 and hvd.cross_size() == 4
+
+def per_rank(lr):
+    r = hvd.rank()
+    out = np.asarray(hvd.allreduce(jnp.full((5,), float(r + 1)),
+                                   op=hvd.Sum, name="f.ar"))
+    np.testing.assert_allclose(out, np.full((5,), 36.0))
+    g = np.asarray(hvd.allgather(jnp.full((1, 2), float(r)), name="f.ag"))
+    np.testing.assert_allclose(
+        g, np.arange(8, dtype=np.float32)[:, None] * np.ones((1, 2)))
+    return r
+
+ranks = run_parallel(per_rank)
+assert ranks == [pid * 2, pid * 2 + 1], ranks
+print(f"proc {pid} GMESH_4P_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_global_mesh_four_processes():
+    """A different pod shape: 4 processes x 2 devices forming the same
+    8-rank global mesh (the coordinator's per-process bookkeeping must
+    not assume 2 hosts)."""
+    result = _run_gmesh(FOURPROC_WORKER, np_=4, devices_per_proc=2,
+                        timeout=600)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert result.stdout.count("GMESH_4P_OK") == 4

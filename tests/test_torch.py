@@ -593,3 +593,17 @@ def test_distributed_optimizer_sum_op_scales_like_reference():
     expected = -float(sum(range(1, N + 1)))  # w = 0 - lr * sum(grad_r)
     for w in _per_rank(fn):
         assert abs(w - expected) < 1e-5, (w, expected)
+
+
+def test_torch_broadcast_object():
+    """Arbitrary picklable state travels from the root (reference:
+    torch/__init__.py:608 broadcast_object — the documented way to ship
+    a LR-scheduler state_dict)."""
+    def fn(r):
+        state = {"epoch": 7, "sched": [0.1, 0.01], "rank": r} \
+            if r == 3 else None
+        out = hvd.broadcast_object(state, root_rank=3)
+        return out
+
+    for out in _per_rank(fn):
+        assert out == {"epoch": 7, "sched": [0.1, 0.01], "rank": 3}
