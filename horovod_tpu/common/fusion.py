@@ -1,0 +1,29 @@
+"""Shared fusion-bucket planning (reference: ``controller.cc:640``
+FuseResponses — greedy run of compatible allreduces up to the threshold).
+
+One implementation used by the in-process controllers (bucketing
+GroupEntries) and the gmesh coordinator (bucketing metadata), so the
+bucket-compatibility rules cannot drift between single-host and pod
+modes."""
+
+
+def plan_buckets(items, *, key_fn, nbytes_fn, threshold):
+    """Greedy in-order bucketing.
+
+    Yields lists of consecutive ``items`` sharing ``key_fn(item)`` whose
+    cumulative ``nbytes_fn(item)`` stays within ``threshold``.  A new
+    key or a full bucket starts the next one (an oversize single item
+    still gets its own bucket)."""
+    bucket, bucket_key, bucket_bytes = [], None, 0
+    for item in items:
+        key = key_fn(item)
+        nbytes = nbytes_fn(item)
+        if bucket and (key != bucket_key
+                       or bucket_bytes + nbytes > threshold):
+            yield bucket
+            bucket, bucket_bytes = [], 0
+        bucket.append(item)
+        bucket_key = key
+        bucket_bytes += nbytes
+    if bucket:
+        yield bucket

@@ -35,10 +35,16 @@ import time
 import numpy as np
 
 from horovod_tpu.common.ops_enum import ReduceOp, RequestType
+from horovod_tpu.common.fusion import plan_buckets
 from horovod_tpu.ops.python_controller import GroupEntry, PythonController
 from horovod_tpu.run.service import network
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils.logging import get_logger
+
+# consecutive coordinator send failures tolerated before the job is
+# failed (the launcher kills on nonzero exit; this is the in-process
+# analog for a dead rank-0)
+_SEND_FAIL_LIMIT_S = 60.0
 
 GMESH_SCOPE = "gmesh"
 GMESH_KEY = "addr"
@@ -50,10 +56,12 @@ class MetaReq:
     """One name's metadata from one process (payload-free)."""
 
     __slots__ = ("name", "req_type", "op", "dtype", "shape", "dims0",
-                 "splits", "root_rank", "prescale", "postscale", "ranks")
+                 "splits", "root_rank", "prescale", "postscale", "ranks",
+                 "error")
 
     def __init__(self, name, req_type, op, dtype, shape, dims0, splits,
-                 root_rank, prescale, postscale, ranks):
+                 root_rank, prescale, postscale, ranks, error=None):
+        self.error = error  # intra-process validation failure, if any
         self.name = name
         self.req_type = int(req_type)
         self.op = int(op)
@@ -68,13 +76,16 @@ class MetaReq:
 
 
 class CycleMsg:
-    __slots__ = ("pid", "reqs", "joined", "last_seq")
+    __slots__ = ("pid", "reqs", "joined", "last_seq", "join_epoch")
 
-    def __init__(self, pid, reqs, joined, last_seq):
+    def __init__(self, pid, reqs, joined, last_seq, join_epoch=0):
         self.pid = pid
         self.reqs = reqs
         self.joined = tuple(joined)
         self.last_seq = last_seq
+        # the client's count of join_done rounds observed; a stale epoch
+        # marks a replayed joined-report from before the last join_done
+        self.join_epoch = join_epoch
 
 
 class LogEntry:
@@ -150,6 +161,7 @@ class MetaCoordinatorService(network.MuxService):
         self._log_entries = []
         self._acked = {}                 # pid -> highest seq acknowledged
         self._seq = 0
+        self._join_epoch = 0  # completed join_done rounds
         self._log = get_logger()
         super().__init__(self.NAME, key)
 
@@ -174,11 +186,20 @@ class MetaCoordinatorService(network.MuxService):
             self._acked[msg.pid] = max(self._acked.get(msg.pid, 0),
                                        msg.last_seq)
             self._trim_log()
-            for r in msg.joined:
-                if r not in self._joined:
-                    self._joined.add(r)
-                    self._join_order.append(r)
+            if msg.join_epoch == self._join_epoch:
+                for r in msg.joined:
+                    if r not in self._joined:
+                        self._joined.add(r)
+                        self._join_order.append(r)
+            # else: a replay from before the last join_done (lost
+            # response); honoring it would poison the cleared join set
+            # names already emitted but not yet acked by this pid: a
+            # re-report is the lost-response replay, not a new request
+            inflight = {n for e in self._log_entries
+                        if e.seq > msg.last_seq for n in e.names}
             for req in msg.reqs:
+                if req.name in inflight:
+                    continue
                 entry = self._table.get(req.name)
                 if entry is None:
                     entry = _GlobalName()
@@ -213,58 +234,59 @@ class MetaCoordinatorService(network.MuxService):
         if not ready and not self._join_done_ready():
             return
 
-        bucket = []          # (name, MetaReq-first) accumulated allreduces
-        bucket_bytes = 0
-        bucket_key = None
-
-        def flush():
-            nonlocal bucket, bucket_bytes, bucket_key
-            if bucket:
-                first = bucket[0][1]
-                self._emit(LogEntry(
-                    self._next_seq(), "group",
-                    req_type=int(RequestType.ALLREDUCE),
-                    names=[n for n, _ in bucket],
-                    shapes=[m.shape for _, m in bucket],
-                    dtype=first.dtype, op=first.op,
-                    prescale=first.prescale, postscale=first.postscale,
-                    joined=sorted(self._joined)))
-                bucket, bucket_bytes, bucket_key = [], 0, None
-
+        # validate first; bucket the valid ones with the SAME planner and
+        # compatibility key the in-process controllers use
+        validated = []  # (name, meta) | error LogEntries emitted inline
         for name, entry in ready:
             del self._table[name]
             err, meta = self._validate(name, entry)
             if err is not None:
-                flush()
                 self._emit(LogEntry(self._next_seq(), "error",
                                     names=[name], error=err))
                 continue
+            validated.append((name, meta))
+
+        def key(item):
+            _, meta = item
             rtype = RequestType(meta["req_type"])
+            if rtype != RequestType.ALLREDUCE:
+                return ("single", item[0])
+            return PythonController.allreduce_bucket_key(
+                meta["dtype"], meta["op"], meta["prescale"],
+                meta["postscale"])
+
+        def nbytes(item):
+            _, meta = item
+            return (np.dtype(meta["dtype"]).itemsize *
+                    int(np.prod(meta["shape"] or (1,))))
+
+        for bucket in plan_buckets(validated, key_fn=key,
+                                   nbytes_fn=nbytes,
+                                   threshold=self._fusion_threshold):
+            first_meta = bucket[0][1]
+            rtype = RequestType(first_meta["req_type"])
             if rtype == RequestType.ALLREDUCE:
-                nbytes = (np.dtype(meta["dtype"]).itemsize *
-                          int(np.prod(meta["shape"] or (1,))))
-                key = (meta["dtype"], meta["op"], meta["prescale"],
-                       meta["postscale"])
-                if bucket and (key != bucket_key or
-                               bucket_bytes + nbytes
-                               > self._fusion_threshold):
-                    flush()
-                first = next(iter(entry.reqs.values()))
-                bucket.append((name, first))
-                bucket_key = key
-                bucket_bytes += nbytes
+                self._emit(LogEntry(
+                    self._next_seq(), "group",
+                    req_type=int(RequestType.ALLREDUCE),
+                    names=[n for n, _ in bucket],
+                    shapes=[m["shape"] for _, m in bucket],
+                    dtype=first_meta["dtype"], op=first_meta["op"],
+                    prescale=first_meta["prescale"],
+                    postscale=first_meta["postscale"],
+                    joined=sorted(self._joined)))
             else:
-                flush()
+                name, meta = bucket[0]
                 self._emit(LogEntry(
                     self._next_seq(), "group", req_type=int(rtype),
                     names=[name], shapes=[meta["shape"]],
                     dtype=meta["dtype"], op=meta["op"],
-                    prescale=meta["prescale"], postscale=meta["postscale"],
+                    prescale=meta["prescale"],
+                    postscale=meta["postscale"],
                     root_rank=meta["root_rank"],
                     all_dims0=meta.get("all_dims0"),
                     splits_matrix=meta.get("splits_matrix"),
                     joined=sorted(self._joined)))
-        flush()
         self._maybe_emit_join_done()
 
     def _join_done_ready(self):
@@ -281,6 +303,7 @@ class MetaCoordinatorService(network.MuxService):
                                 last_rank=last))
             self._joined.clear()
             self._join_order.clear()
+            self._join_epoch += 1
 
     def _next_seq(self):
         self._seq += 1
@@ -305,6 +328,12 @@ class MetaCoordinatorService(network.MuxService):
         reqs = list(entry.reqs.values())
         first = reqs[0]
 
+        for r in reqs:
+            # a process that failed intra-process validation reports the
+            # error so every other process's ranks fail too, instead of
+            # executing a misaligned collective
+            if getattr(r, "error", None):
+                return (r.error, None)
         if any(r.req_type != first.req_type for r in reqs):
             return (f"mismatched collective types for tensor '{name}'",
                     None)
@@ -423,6 +452,8 @@ class GlobalMeshController(PythonController):
         self._local_rank_set = set(range(base, base + self._local_size))
         self._reported = set()
         self._joined_reported = set()
+        self._join_epoch = 0  # join_done rounds observed
+        self._send_fail_since = None
         self._last_seq = 0
         self._coordinator = None
         self._client_addrs = None
@@ -435,8 +466,19 @@ class GlobalMeshController(PythonController):
         if key_b64:
             self._key = base64.b64decode(key_b64)
         else:
+            # No shared secret: only acceptable for single-machine runs.
+            # A key derived from the (public) rendezvous address would
+            # let anyone who can reach the port forge HMACs and drive
+            # pickle deserialization — refuse instead of degrading.
+            addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
+            if addr not in (None, "localhost", "127.0.0.1", "::1"):
+                raise RuntimeError(
+                    "global-mesh mode on a non-loopback rendezvous "
+                    "requires HVD_SECRET_KEY (hvdrun sets it "
+                    "automatically); refusing to derive an HMAC key "
+                    "from public values")
             import hashlib
-            seed = (os.environ.get(env_util.HVD_RENDEZVOUS_ADDR, "local") +
+            seed = ((addr or "local") +
                     os.environ.get(env_util.HVD_RENDEZVOUS_PORT, "0"))
             self._key = hashlib.sha256(seed.encode()).digest()
 
@@ -523,7 +565,6 @@ class GlobalMeshController(PythonController):
                     entry.requests.keys()):
                 continue
             new_reqs.append(self._meta_for(name, entry))
-            self._reported.add(name)
 
         newly_joined = sorted(self._joined_view - self._joined_reported)
 
@@ -533,8 +574,37 @@ class GlobalMeshController(PythonController):
                 or join_outstanding):
             return
 
-        msg = CycleMsg(self._pid, new_reqs, newly_joined, self._last_seq)
-        resp = self._client().send(msg)
+        msg = CycleMsg(self._pid, new_reqs, newly_joined, self._last_seq,
+                       join_epoch=self._join_epoch)
+        try:
+            resp = self._client().send(msg)
+        except Exception as exc:  # noqa: BLE001 — transient wire failure
+            # nothing was marked reported, so every request resends next
+            # cycle; nuking local state on the FIRST failure would orphan
+            # the coordinator's view of this process — but a dead
+            # coordinator must still fail the job, not hang it
+            if self._send_fail_since is None:
+                self._send_fail_since = time.monotonic()
+            self._log.warning(
+                "coordinator cycle send failed (will retry): %s", exc)
+            if self._client_obj is not None:
+                try:
+                    self._client_obj.close()
+                except Exception:  # noqa: BLE001 — already broken
+                    pass
+                self._client_obj = None
+            outage = time.monotonic() - self._send_fail_since
+            if outage > _SEND_FAIL_LIMIT_S:
+                raise RuntimeError(
+                    f"coordinator unreachable for {int(outage)}s: "
+                    f"{exc}") from exc  # _loop fails all handles
+            time.sleep(min(0.05 * 2 ** min(
+                int(outage), 6), 2.0))  # backoff, then retry
+            self._wakeup.set()
+            return
+        self._send_fail_since = None
+        # reported only once the coordinator actually received them
+        self._reported.update(r.name for r in new_reqs)
         self._joined_reported.update(newly_joined)
 
         for entry in resp.entries:
@@ -549,6 +619,11 @@ class GlobalMeshController(PythonController):
 
     def _meta_for(self, name, entry):
         reqs = entry.requests
+        # intra-process agreement first (the coordinator only compares
+        # ACROSS processes); a local mismatch is reported as an error so
+        # every process's ranks fail consistently
+        error = PythonController.validate_requests(
+            name, reqs, size=self._size, joined=bool(self._joined_view))
         first = next(iter(reqs.values()))
         shape = tuple(first.tensor.shape) if first.tensor is not None else ()
         dtype = (np.dtype(first.tensor.dtype).name
@@ -562,7 +637,8 @@ class GlobalMeshController(PythonController):
             name=name, req_type=first.req_type, op=first.op, dtype=dtype,
             shape=shape, dims0=dims0, splits=splits,
             root_rank=first.root_rank, prescale=first.prescale_factor,
-            postscale=first.postscale_factor, ranks=sorted(reqs.keys()))
+            postscale=first.postscale_factor, ranks=sorted(reqs.keys()),
+            error=error)
 
     # ------------------------------------------------------------- execution
     def _apply(self, entry):
@@ -583,6 +659,7 @@ class GlobalMeshController(PythonController):
                 self._joined.clear()
             self._joined_reported.clear()
             self._joined_view = set()
+            self._join_epoch += 1  # stale joined-replays now ignored
             return
 
         rtype = RequestType(entry.req_type)
@@ -608,25 +685,20 @@ class GlobalMeshController(PythonController):
                 all_dims0=entry.all_dims0))
             self._timeline.end(name)
 
-        def fail(exc):
-            self._log.error("collective execution failed: %s", exc)
-            for g in groups:
-                for handle in g.handles.values():
-                    handle.set_error(f"collective execution failed: {exc}")
-
+        # execution + error surfacing shared with the in-process
+        # controller (PythonController._execute_allreduce_bucket /
+        # _execute_single)
         try:
             if rtype == RequestType.ALLREDUCE:
-                first = groups[0]
-                self._timeline_begin_groups(groups, "ALLREDUCE")
-                self._executor.allreduce_fused(
-                    groups, op=first.op,
-                    prescale_factor=first.prescale_factor,
-                    postscale_factor=first.postscale_factor)
-                self._timeline_end_groups(groups)
+                self._execute_allreduce_bucket(groups)
             else:
                 self._execute_single(rtype, groups[0])
         except Exception as exc:  # noqa: BLE001 — surface on handles
-            fail(exc)
+            self._log.error("collective execution failed: %s", exc)
+            for g in groups:
+                for handle in g.handles.values():
+                    handle.set_error(
+                        f"collective execution failed: {exc}")
 
     # ------------------------------------------------------------------ stall
     def _check_local_stalls(self):

@@ -280,92 +280,95 @@ class PythonController:
             postscale_factor=any_req.postscale_factor)
 
     # ------------------------------------------------------------- validation
-    def _construct_response(self, name, entry):
-        """Validate cross-rank agreement (reference: controller.cc:378
-        ConstructResponse) and build a GroupEntry, or error every handle."""
-        requests = entry.requests
-
-        def error(message):
-            for request in requests.values():
-                request.handle.set_error(message)
-            return None
-
+    @staticmethod
+    def validate_requests(name, requests, *, size, joined):
+        """Cross-rank agreement rules (reference: controller.cc:378
+        ConstructResponse), shared by the in-process controllers and the
+        gmesh controller's local (intra-process) pre-check.  Returns an
+        error string or None."""
         types = {r.req_type for r in requests.values()}
         if len(types) > 1:
-            return error(
-                f"mismatched collective types for tensor '{name}': "
-                f"{sorted(t.name for t in types)}")
-        req_type = entry.req_type
+            return (f"mismatched collective types for tensor '{name}': "
+                    f"{sorted(t.name for t in types)}")
+        req_type = next(iter(types))
 
-        if self._joined_view and req_type in (RequestType.ALLGATHER,
-                                              RequestType.BROADCAST,
-                                              RequestType.ALLTOALL):
-            return error(
-                f"{req_type.name} is not supported while ranks have joined")
+        if joined and req_type in (RequestType.ALLGATHER,
+                                   RequestType.BROADCAST,
+                                   RequestType.ALLTOALL):
+            return (f"{req_type.name} is not supported while ranks have "
+                    f"joined")
 
         dtypes = {np.dtype(r.tensor.dtype).name for r in requests.values()
                   if r.tensor is not None}
         if len(dtypes) > 1:
-            return error(
-                f"mismatched dtypes for tensor '{name}': {sorted(dtypes)}")
+            return f"mismatched dtypes for tensor '{name}': {sorted(dtypes)}"
 
         if req_type in (RequestType.ALLREDUCE, RequestType.ADASUM):
             ops = {r.op for r in requests.values()}
             if len(ops) > 1:
-                return error(f"mismatched reduce ops for tensor '{name}'")
+                return f"mismatched reduce ops for tensor '{name}'"
             pre = {r.prescale_factor for r in requests.values()}
             post = {r.postscale_factor for r in requests.values()}
             if len(pre) > 1 or len(post) > 1:
-                return error(f"mismatched scale factors for tensor '{name}'")
+                return f"mismatched scale factors for tensor '{name}'"
             shapes = {tuple(r.tensor.shape) for r in requests.values()}
             if len(shapes) > 1:
-                return error(
-                    f"mismatched shapes for allreduce '{name}': "
-                    f"{sorted(shapes)}")
+                return (f"mismatched shapes for allreduce '{name}': "
+                        f"{sorted(shapes)}")
         elif req_type == RequestType.ALLGATHER:
             ndims = {r.tensor.ndim for r in requests.values()}
             if len(ndims) > 1:
-                return error(
-                    f"mismatched tensor ranks for allgather '{name}'")
+                return f"mismatched tensor ranks for allgather '{name}'"
             if 0 in ndims:
-                return error(
-                    f"allgather '{name}': 0-d tensors are not supported; "
-                    f"reshape to (1,) first")
-            trailing = {tuple(r.tensor.shape[1:]) for r in requests.values()}
+                return (f"allgather '{name}': 0-d tensors are not "
+                        f"supported; reshape to (1,) first")
+            trailing = {tuple(r.tensor.shape[1:])
+                        for r in requests.values()}
             if len(trailing) > 1:
-                return error(
-                    f"mismatched trailing dimensions for allgather '{name}'")
+                return (f"mismatched trailing dimensions for allgather "
+                        f"'{name}'")
         elif req_type == RequestType.BROADCAST:
             roots = {r.root_rank for r in requests.values()}
             if len(roots) > 1:
-                return error(
-                    f"mismatched root ranks for broadcast '{name}'")
+                return f"mismatched root ranks for broadcast '{name}'"
             shapes = {tuple(r.tensor.shape) for r in requests.values()}
             if len(shapes) > 1:
-                return error(
-                    f"mismatched shapes for broadcast '{name}'")
+                return f"mismatched shapes for broadcast '{name}'"
         elif req_type == RequestType.ALLTOALL:
             for r in requests.values():
-                if len(r.splits) != self._size:
-                    return error(
-                        f"alltoall '{name}': splits must have one entry per "
-                        f"rank ({self._size}), got {len(r.splits)}")
+                if len(r.splits) != size:
+                    return (f"alltoall '{name}': splits must have one "
+                            f"entry per rank ({size}), got "
+                            f"{len(r.splits)}")
                 if sum(r.splits) != r.tensor.shape[0]:
-                    return error(
-                        f"alltoall '{name}': splits sum "
-                        f"{sum(r.splits)} != first dimension "
-                        f"{r.tensor.shape[0]}")
+                    return (f"alltoall '{name}': splits sum "
+                            f"{sum(r.splits)} != first dimension "
+                            f"{r.tensor.shape[0]}")
+        return None
 
+    def _construct_response(self, name, entry):
+        """Validate cross-rank agreement and build a GroupEntry, or
+        error every handle."""
+        requests = entry.requests
+        message = self.validate_requests(
+            name, requests, size=self._size, joined=bool(self._joined_view))
+        if message is not None:
+            for request in requests.values():
+                request.handle.set_error(message)
+            return None
         return self._build_group(name, entry)
 
     # ----------------------------------------------------------------- fusion
+    @staticmethod
+    def allreduce_bucket_key(dtype, op, prescale, postscale):
+        """Bucket-compatibility key shared with the gmesh coordinator
+        (reference: FuseResponses fuses dtype/op/scale-homogeneous runs)."""
+        return (np.dtype(dtype).name, int(op), prescale, postscale)
+
     def _dispatch(self, responses):
         """Fuse compatible allreduces into <= fusion_threshold buckets
         (reference: controller.cc:640 FuseResponses) and execute."""
-        fusion_bytes = self._config.fusion_threshold_bytes
-        bucket = []
-        bucket_key = None
-        bucket_bytes = 0
+        from horovod_tpu.common.fusion import plan_buckets
 
         def safe(execute, groups):
             try:
@@ -376,29 +379,30 @@ class PythonController:
                     for handle in g.handles.values():
                         handle.set_error(f"collective execution failed: {exc}")
 
-        def flush():
-            nonlocal bucket, bucket_bytes, bucket_key
-            if bucket:
-                groups = bucket
-                safe(lambda: self._execute_allreduce_bucket(groups), groups)
-                bucket, bucket_bytes, bucket_key = [], 0, None
+        def key(item):
+            req_type, group = item
+            if req_type != RequestType.ALLREDUCE:
+                return ("single", id(group))  # never fuses
+            return self.allreduce_bucket_key(
+                group.dtype, group.op, group.prescale_factor,
+                group.postscale_factor)
 
-        for req_type, group in responses:
+        def nbytes(item):
+            _, group = item
+            return (np.dtype(group.dtype).itemsize
+                    * int(np.prod(group.shape or (1,))))
+
+        for bucket in plan_buckets(
+                responses, key_fn=key, nbytes_fn=nbytes,
+                threshold=self._config.fusion_threshold_bytes):
+            req_type = bucket[0][0]
+            groups = [g for _, g in bucket]
             if req_type == RequestType.ALLREDUCE:
-                itemsize = np.dtype(group.dtype).itemsize
-                nbytes = itemsize * int(np.prod(group.shape or (1,)))
-                key = (np.dtype(group.dtype).name, int(group.op),
-                       group.prescale_factor, group.postscale_factor)
-                if bucket and (key != bucket_key
-                               or bucket_bytes + nbytes > fusion_bytes):
-                    flush()
-                bucket.append(group)
-                bucket_key = key
-                bucket_bytes += nbytes
+                safe(lambda groups=groups:
+                     self._execute_allreduce_bucket(groups), groups)
             else:
-                flush()
-                safe(lambda: self._execute_single(req_type, group), [group])
-        flush()
+                safe(lambda req_type=req_type, g=groups[0]:
+                     self._execute_single(req_type, g), groups)
 
     def _execute_allreduce_bucket(self, groups):
         first = groups[0]

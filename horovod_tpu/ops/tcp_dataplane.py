@@ -16,10 +16,15 @@ usual, then move bytes rank-to-rank:
   root uploads each byte once instead of N−1 times.
 - **allgather**: ring block rotation (N−1 forwarding steps).
 
-Accumulation is float64/int64 (matching the coordinator star path, so
-results are bit-identical whichever plane runs a given tensor).
+Accumulation is float64/int64 like the coordinator star path.  The
+two planes are rank-consistent but not bitwise-identical to each other
+for floats: the ring reduces each chunk in ring-rotation order while
+the star sums in ascending rank order, and float addition is not
+associative — a tensor crossing HVD_TCP_RING_THRESHOLD can change in
+the last ulp.
 """
 
+import collections
 import threading
 
 import numpy as np
@@ -48,14 +53,22 @@ class PeerService(network.MuxService):
 
     NAME = "horovod_tpu peer"
 
+    # purged ring ids remembered so late-arriving chunks of aborted
+    # rounds are dropped instead of leaking in the mailbox forever
+    _PURGED_KEEP = 256
+
     def __init__(self, key):
         self._cv = threading.Condition()
         self._mailbox = {}   # (tag, src) -> payload
+        self._purged = collections.deque(maxlen=self._PURGED_KEEP)
+        self._purged_set = set()  # O(1) membership for the hot path
         super().__init__(self.NAME, key)
 
     def _handle(self, req, client_address):
         if isinstance(req, ChunkMsg):
             with self._cv:
+                if req.tag[0] in self._purged_set:
+                    return network.AckResponse()  # aborted round, drop
                 self._mailbox[(req.tag, req.src)] = req.payload
                 self._cv.notify_all()
             return network.AckResponse()
@@ -82,6 +95,10 @@ class PeerService(network.MuxService):
         the coordinator-assigned ring id, so a retry — which gets a NEW
         id — can never consume stale data)."""
         with self._cv:
+            if len(self._purged) == self._purged.maxlen:
+                self._purged_set.discard(self._purged[0])
+            self._purged.append(ring_id)
+            self._purged_set.add(ring_id)
             for key in [k for k in self._mailbox if k[0][0] == ring_id]:
                 del self._mailbox[key]
 

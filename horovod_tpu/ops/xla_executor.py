@@ -203,8 +203,19 @@ class XlaExecutor:
         bufs = []
         for rank in self.local_ranks:
             tensors = [e.tensors.get(rank) for e in entries]
-            if any(t is None for t in tensors):
+            if all(t is None for t in tensors):
                 bufs.append(self._zeros_buf(total, dtype, rank))
+            elif any(t is None for t in tensors):
+                # mixed bucket (the rank joined between two entries'
+                # submissions): zero ONLY the absent entries — zeroing
+                # the whole buffer would silently drop this rank's real
+                # contributions to the present ones
+                filled = [t if t is not None
+                          else jax.device_put(
+                              np.zeros(shapes[i], dtype),
+                              self.devices[rank])
+                          for i, t in enumerate(tensors)]
+                bufs.append(self._fuse_in(filled, sizes, dtype))
             else:
                 bufs.append(self._fuse_in(tensors, sizes, dtype))
         garr = self._stack(bufs, (1, total), dtype)
@@ -216,10 +227,17 @@ class XlaExecutor:
         fn = self._allreduce_cache.get(key)
         if fn is None:
             num_ranks = self.num_ranks
+            # Integer tensors: the reduction stays exact in the integer
+            # dtype and ALL scaling (pre x post x 1/n, which commutes
+            # with the sum) happens once in float32 with a cast back —
+            # casting a fractional factor to an int dtype would truncate
+            # it to 0 and silently zero every result, and int/int true
+            # division would silently change the output dtype.
+            int_dtype = not np.issubdtype(np.dtype(dtype), np.floating)
 
             def flat_body(shard):  # shard: [1, total] on one rank
                 x = shard
-                if prescale_factor != 1.0:
+                if prescale_factor != 1.0 and not int_dtype:
                     x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
                 return jax.lax.psum(x, AXIS)
 
@@ -228,7 +246,7 @@ class XlaExecutor:
                 # allgather on ICI (reference: nccl_operations.cc:162-289:
                 # ncclReduceScatter -> MPI allreduce -> ncclAllgather).
                 x = shard.reshape(-1)
-                if prescale_factor != 1.0:
+                if prescale_factor != 1.0 and not int_dtype:
                     x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
                 local = self.hier_mesh.shape["local"]
                 align = local * FUSION_ALIGN_ELEMS
@@ -250,11 +268,25 @@ class XlaExecutor:
                     red = _shard_map(flat_body, mesh=self.mesh,
                                      in_specs=P(AXIS), out_specs=P())(g)
                 flat = red.reshape(-1)
-                if op == ReduceOp.AVERAGE:
-                    flat = flat / jnp.asarray(num_ranks, dtype=flat.dtype)
-                if postscale_factor != 1.0:
-                    flat = flat * jnp.asarray(postscale_factor,
-                                              dtype=flat.dtype)
+                if int_dtype:
+                    factor = prescale_factor * postscale_factor
+                    if op == ReduceOp.AVERAGE:
+                        factor /= num_ranks
+                    if factor != 1.0:
+                        # float64 when x64 is on; otherwise f32 caps
+                        # exactness at 2**24 — large int sums can lose
+                        # low bits (the tcp plane scales in f64)
+                        sdt = (jnp.float64 if jax.config.jax_enable_x64
+                               else jnp.float32)
+                        flat = (flat.astype(sdt)
+                                * factor).astype(flat.dtype)
+                else:
+                    if op == ReduceOp.AVERAGE:
+                        flat = flat / jnp.asarray(num_ranks,
+                                                  dtype=flat.dtype)
+                    if postscale_factor != 1.0:
+                        flat = flat * jnp.asarray(postscale_factor,
+                                                  dtype=flat.dtype)
                 outs = []
                 offset = 0
                 for size, shape in zip(sizes, shapes):

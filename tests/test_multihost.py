@@ -354,3 +354,54 @@ def test_global_mesh_four_processes():
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
     assert result.stdout.count("GMESH_4P_OK") == 4
+
+
+LOCAL_MISMATCH_WORKER = r"""
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.common.basics import run_parallel
+from horovod_tpu.common.handles import HvdError
+
+hvd.init()
+pid = int(os.environ["HVD_RANK"])
+
+def per_rank(lr):
+    r = hvd.rank()
+    # ranks 0 and 1 live in process 0 and disagree on shape: the
+    # coordinator only compares across processes, so the process must
+    # catch this locally and the error must reach EVERY rank globally
+    shape = (2, 3) if r != 1 else (3, 2)
+    try:
+        hvd.allreduce(jnp.ones(shape), op=hvd.Sum, name="local.bad")
+        return "no-error"
+    except HvdError as exc:
+        assert "mismatched shapes" in str(exc), exc
+        return "raised"
+
+results = run_parallel(per_rank)
+assert all(x == "raised" for x in results), (pid, results)
+
+# and the job keeps working afterwards
+def ok(lr):
+    out = np.asarray(hvd.allreduce(jnp.ones((3,)), op=hvd.Sum,
+                                   name="after.ok"))
+    np.testing.assert_allclose(out, np.full((3,), 8.0))
+    return True
+assert all(run_parallel(ok))
+print(f"proc {pid} GMESH_LOCAL_MISMATCH_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_global_mesh_intra_process_mismatch_errors_globally():
+    """Two ranks INSIDE one process disagreeing on a tensor's shape must
+    error every rank in the job (regression: the coordinator only
+    validated across processes, so the misalignment executed silently)."""
+    result = _run_gmesh(LOCAL_MISMATCH_WORKER, timeout=300)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert result.stdout.count("GMESH_LOCAL_MISMATCH_OK") == 2

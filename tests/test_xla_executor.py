@@ -127,3 +127,66 @@ def test_dtype_flip_mid_burst_splits_buckets_correctly(hvd):
         for out in outs:
             np.testing.assert_allclose(
                 out.astype(np.float64), np.full((9,), expected))
+
+
+def test_mixed_bucket_join_zeroes_only_absent_entries(hvd):
+    """A fused bucket mixing entries where a rank participates in one
+    tensor but not another (it joined in between) must zero ONLY the
+    absent entry — never the rank's real contribution to the other
+    (regression: whole-buffer zeroing dropped submitted gradients)."""
+    import jax
+
+    from horovod_tpu.common.handles import Handle
+    from horovod_tpu.ops.python_controller import GroupEntry
+
+    executor = _executor(hvd)
+
+    def make_entry(name, tensors):
+        handles = {r: Handle(name) for r in tensors}
+        return GroupEntry(name=name, shape=(4,), dtype=np.float32,
+                          tensors=tensors, handles=handles), handles
+
+    # entry A: every rank contributed; entry B: rank 5 absent (joined)
+    a_tensors = {r: executor.commit(jnp.full((4,), float(r + 1)), r)
+                 for r in range(N)}
+    b_tensors = {r: (executor.commit(jnp.full((4,), 10.0 * (r + 1)), r)
+                     if r != 5 else None)
+                 for r in range(N)}
+    entry_a, handles_a = make_entry("mix.a", a_tensors)
+    entry_b, handles_b = make_entry("mix.b", b_tensors)
+
+    from horovod_tpu.common.ops_enum import ReduceOp
+    executor.allreduce_fused([entry_a, entry_b], op=ReduceOp.SUM,
+                             prescale_factor=1.0, postscale_factor=1.0)
+
+    # A: full sum including rank 5
+    expected_a = float(sum(range(1, N + 1)))
+    # B: sum excluding rank 5's (absent) contribution
+    expected_b = 10.0 * float(sum(r + 1 for r in range(N) if r != 5))
+    for r in range(N):
+        np.testing.assert_allclose(
+            np.asarray(handles_a[r].wait()), np.full((4,), expected_a),
+            err_msg="rank contribution to entry A was dropped")
+        np.testing.assert_allclose(
+            np.asarray(handles_b[r].wait()), np.full((4,), expected_b))
+
+
+def test_int_allreduce_fractional_scale_and_average(hvd):
+    """Fractional prescale/postscale on integer tensors must scale in
+    float and cast back — not truncate the factor to 0 (regression:
+    int32 * int32(0.5) zeroed every result); Average keeps the integer
+    dtype (truncating division)."""
+    def fn(r):
+        scaled = hvd.allreduce(jnp.full((4,), 10 * (r + 1), jnp.int32),
+                               op=hvd.Sum, name="int.scale",
+                               prescale_factor=0.5)
+        avg = hvd.allreduce(jnp.full((3,), r, jnp.int32),
+                            op=hvd.Average, name="int.avg")
+        return np.asarray(scaled), np.asarray(avg), avg.dtype
+
+    total = sum(10 * (r + 1) for r in range(N))
+    for scaled, avg, avg_dtype in _per_rank(fn):
+        np.testing.assert_allclose(scaled, np.full((4,), total // 2))
+        assert avg_dtype == jnp.int32, avg_dtype
+        np.testing.assert_allclose(
+            avg, np.full((3,), int(sum(range(N)) / N)))
