@@ -14,6 +14,7 @@ bool ResponseCache::Matches(const Signature& sig, const Request& req) const {
 }
 
 ResponseCache::State ResponseCache::Lookup(const Request& req) const {
+  std::lock_guard<std::mutex> lock(mu_);
   auto it = entries_.find(req.name);
   if (it == entries_.end()) {
     ++misses_;
@@ -27,6 +28,7 @@ ResponseCache::State ResponseCache::Lookup(const Request& req) const {
 }
 
 int ResponseCache::Put(const Request& req) {
+  std::lock_guard<std::mutex> lock(mu_);
   auto it = entries_.find(req.name);
   if (it != entries_.end()) {
     lru_.erase(it->second.second);
@@ -52,6 +54,7 @@ int ResponseCache::Put(const Request& req) {
 }
 
 void ResponseCache::Invalidate(const std::string& name) {
+  std::lock_guard<std::mutex> lock(mu_);
   auto it = entries_.find(name);
   if (it == entries_.end()) return;
   lru_.erase(it->second.second);
@@ -252,7 +255,12 @@ void Core::RunCycle() {
     for (const auto& kv : entry.requests) {
       if (!joined_view_.count(kv.first)) ++have;
     }
-    if (have >= needed && needed > 0) {
+    // ready once every live (non-joined) rank contributed; when ALL
+    // ranks have joined (needed == 0) a leftover entry — submitted
+    // before its ranks joined — is trivially ready and reduces over the
+    // submitters, otherwise the join barrier below (which requires an
+    // empty table) could never fire
+    if (have >= needed) {
       timeline_.End(it->first);
       ready.push_back(ConstructResponse(it->first, entry));
       it = table_.erase(it);

@@ -84,9 +84,18 @@ class ResponseCache {
   // capacity.  Returns the cache bit position assigned to this name.
   int Put(const Request& req);
   void Invalidate(const std::string& name);
-  size_t size() const { return entries_.size(); }
-  uint64_t hits() const { return hits_; }
-  uint64_t misses() const { return misses_; }
+  size_t size() const {
+    std::lock_guard<std::mutex> lock(mu_);
+    return entries_.size();
+  }
+  uint64_t hits() const {
+    std::lock_guard<std::mutex> lock(mu_);
+    return hits_;
+  }
+  uint64_t misses() const {
+    std::lock_guard<std::mutex> lock(mu_);
+    return misses_;
+  }
 
  private:
   struct Signature {
@@ -101,6 +110,10 @@ class ResponseCache {
   bool Matches(const Signature& sig, const Request& req) const;
 
   size_t capacity_;
+  // Lookup/Put run on the background thread; Invalidate on the
+  // dispatcher thread (MarkDone with an error); stats from any Python
+  // thread — one lock guards it all.
+  mutable std::mutex mu_;
   mutable uint64_t hits_ = 0;
   mutable uint64_t misses_ = 0;
   int next_bit_ = 0;

@@ -22,16 +22,29 @@ int64_t Timeline::NowUs() const {
 }
 
 int Timeline::Pid(const std::string& tensor) {
-  auto it = pids_.find(tensor);
-  if (it != pids_.end()) return it->second;
-  int pid = next_pid_++;
-  pids_[tensor] = pid;
-  char buf[512];
-  snprintf(buf, sizeof(buf),
-           "{\"name\": \"process_name\", \"ph\": \"M\", \"pid\": %d, "
-           "\"args\": {\"name\": \"%s\"}}",
-           pid, tensor.c_str());
-  Enqueue(buf);
+  // called from both the background thread (Begin/Instant) and the
+  // dispatcher thread (End via MarkDone) — the map needs the lock
+  int pid;
+  bool fresh = false;
+  {
+    std::lock_guard<std::mutex> lock(pid_mu_);
+    auto it = pids_.find(tensor);
+    if (it != pids_.end()) {
+      pid = it->second;
+    } else {
+      pid = next_pid_++;
+      pids_[tensor] = pid;
+      fresh = true;
+    }
+  }
+  if (fresh) {
+    char buf[512];
+    snprintf(buf, sizeof(buf),
+             "{\"name\": \"process_name\", \"ph\": \"M\", \"pid\": %d, "
+             "\"args\": {\"name\": \"%s\"}}",
+             pid, tensor.c_str());
+    Enqueue(buf);
+  }
   return pid;
 }
 

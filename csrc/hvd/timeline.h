@@ -42,6 +42,7 @@ class Timeline {
   FILE* file_ = nullptr;
   bool mark_cycles_ = false;
   std::chrono::steady_clock::time_point start_;
+  std::mutex pid_mu_;  // pids_/next_pid_: bg thread + dispatcher thread
   std::unordered_map<std::string, int> pids_;
   int next_pid_ = 1;
   bool first_record_ = true;

@@ -202,11 +202,16 @@ class NativeController:
             root_rank=request.root_rank, prescale=request.prescale_factor,
             postscale=request.postscale_factor, name=request.name,
             shape=shape, splits=request.splits or [])
-        with self._lock:
-            self._pending[req_id] = request
         err = ctypes.create_string_buffer(1024)
-        rc = self._lib.hvd_core_enqueue(self._core, payload, len(payload),
-                                        err, len(err))
+        with self._lock:
+            # the core pointer must not be destroyed (shutdown) between
+            # the check and the C call — both sides hold this lock
+            if not self._running or self._core is None:
+                request.handle.set_error("horovod_tpu has been shut down")
+                return
+            self._pending[req_id] = request
+            rc = self._lib.hvd_core_enqueue(self._core, payload,
+                                            len(payload), err, len(err))
         if rc != 0:
             with self._lock:
                 self._pending.pop(req_id, None)
@@ -215,8 +220,11 @@ class NativeController:
     def join(self, rank, handle):
         req_id = next(self._ids)
         with self._lock:
+            if not self._running or self._core is None:
+                handle.set_error("horovod_tpu has been shut down")
+                return
             self._joins[req_id] = handle
-        self._lib.hvd_core_join(self._core, rank, req_id)
+            self._lib.hvd_core_join(self._core, rank, req_id)
 
     def shutdown(self):
         if not self._running:
@@ -239,16 +247,19 @@ class NativeController:
         if drained:
             # close the timeline only after the dispatcher drained its
             # last MarkDone (op End events) — closing inside Shutdown
-            # raced it
-            self._lib.hvd_core_finalize(self._core)
-            self._lib.hvd_core_destroy(self._core)
+            # raced it; destroy under the lock so no producer thread is
+            # mid-C-call on the pointer
+            with self._lock:
+                self._lib.hvd_core_finalize(self._core)
+                self._lib.hvd_core_destroy(self._core)
+                self._core = None
         else:
-            # a stuck dispatcher may still touch the core; leaking it
-            # (and the open timeline file) beats a use-after-free
+            # a stuck dispatcher may still touch the core; leak it (the
+            # pointer stays VALID — nulling it would turn the stuck
+            # dispatcher's next C call into a null-pointer crash)
             self._log.warning(
                 "dispatcher did not drain within 10s; leaking the core "
                 "and leaving the timeline file unfinalized")
-        self._core = None
 
     # ------------------------------------------------------------- statistics
     def _require_core(self):
@@ -257,30 +268,34 @@ class NativeController:
         return self._core
 
     def cache_stats(self):
-        self._require_core()
-        return {
-            "hits": int(self._lib.hvd_core_cache_hits(self._core)),
-            "misses": int(self._lib.hvd_core_cache_misses(self._core)),
-            "size": int(self._lib.hvd_core_cache_size(self._core)),
-        }
+        with self._lock:  # core must not be destroyed mid-call
+            core = self._require_core()
+            return {
+                "hits": int(self._lib.hvd_core_cache_hits(core)),
+                "misses": int(self._lib.hvd_core_cache_misses(core)),
+                "size": int(self._lib.hvd_core_cache_size(core)),
+            }
 
     def tuned_params(self):
         """Current (possibly autotuned) runtime knob values (reference:
         ParameterManager values after SynchronizeParameters)."""
-        lib, core = self._lib, self._require_core()
-        return {
-            "fusion_threshold_bytes": int(
-                lib.hvd_core_param_fusion_bytes(core)),
-            "cycle_time_ms": float(lib.hvd_core_param_cycle_ms(core)),
-            "hierarchical_allreduce": bool(
-                lib.hvd_core_param_hierarchical_allreduce(core)),
-            "hierarchical_allgather": bool(
-                lib.hvd_core_param_hierarchical_allgather(core)),
-            "cache_enabled": bool(lib.hvd_core_param_cache_enabled(core)),
-            "tuning": bool(lib.hvd_core_autotune_tuning(core)),
-            "best_score_bytes_per_sec": float(
-                lib.hvd_core_autotune_best_score(core)),
-        }
+        lib = self._lib
+        with self._lock:  # core must not be destroyed mid-call
+            core = self._require_core()
+            return {
+                "fusion_threshold_bytes": int(
+                    lib.hvd_core_param_fusion_bytes(core)),
+                "cycle_time_ms": float(lib.hvd_core_param_cycle_ms(core)),
+                "hierarchical_allreduce": bool(
+                    lib.hvd_core_param_hierarchical_allreduce(core)),
+                "hierarchical_allgather": bool(
+                    lib.hvd_core_param_hierarchical_allgather(core)),
+                "cache_enabled": bool(
+                    lib.hvd_core_param_cache_enabled(core)),
+                "tuning": bool(lib.hvd_core_autotune_tuning(core)),
+                "best_score_bytes_per_sec": float(
+                    lib.hvd_core_autotune_best_score(core)),
+            }
 
     # ------------------------------------------------------------- dispatcher
     def _next_batch(self):
@@ -376,25 +391,35 @@ class NativeController:
                 prescale_factor=resp["prescale"],
                 postscale_factor=resp["postscale"]))
 
-        if rtype in (ResponseType.ALLREDUCE,):
-            self._executor.allreduce_fused(
-                groups, op=ReduceOp(resp["op"]),
-                prescale_factor=resp["prescale"],
-                postscale_factor=resp["postscale"])
-        elif rtype == ResponseType.ADASUM:
+        try:
+            if rtype in (ResponseType.ALLREDUCE,):
+                self._executor.allreduce_fused(
+                    groups, op=ReduceOp(resp["op"]),
+                    prescale_factor=resp["prescale"],
+                    postscale_factor=resp["postscale"])
+            elif rtype == ResponseType.ADASUM:
+                for g in groups:
+                    self._executor.adasum(g)
+            elif rtype == ResponseType.ALLGATHER:
+                for g in groups:
+                    self._executor.allgather(g)
+            elif rtype == ResponseType.BROADCAST:
+                for g in groups:
+                    self._executor.broadcast(g)
+            elif rtype == ResponseType.ALLTOALL:
+                for g in groups:
+                    self._executor.alltoall(g)
+            else:
+                raise RuntimeError(f"unknown response type {rtype}")
+        except Exception as exc:
+            # the requests were already popped from _pending, so the
+            # caller's _fail_response cannot reach these handles — fail
+            # them HERE or every waiting rank thread hangs forever
             for g in groups:
-                self._executor.adasum(g)
-        elif rtype == ResponseType.ALLGATHER:
-            for g in groups:
-                self._executor.allgather(g)
-        elif rtype == ResponseType.BROADCAST:
-            for g in groups:
-                self._executor.broadcast(g)
-        elif rtype == ResponseType.ALLTOALL:
-            for g in groups:
-                self._executor.alltoall(g)
-        else:
-            raise RuntimeError(f"unknown response type {rtype}")
+                for handle in g.handles.values():
+                    handle.set_error(
+                        f"collective execution failed: {exc}")
+            raise
 
     def _local(self, global_rank):
         """Global rank -> executor device index (identical in single-process

@@ -30,6 +30,7 @@ import time
 
 import numpy as np
 
+from horovod_tpu.common.fusion import plan_buckets
 from horovod_tpu.common.ops_enum import ReduceOp, RequestType
 from horovod_tpu.common.response_cache import SignatureCache
 from horovod_tpu.utils.logging import get_logger
@@ -368,8 +369,6 @@ class PythonController:
     def _dispatch(self, responses):
         """Fuse compatible allreduces into <= fusion_threshold buckets
         (reference: controller.cc:640 FuseResponses) and execute."""
-        from horovod_tpu.common.fusion import plan_buckets
-
         def safe(execute, groups):
             try:
                 execute()

@@ -184,8 +184,12 @@ class CoordinatorService(network.MuxService):
             return network.AckResponse()
         return super()._handle(req, client_address)
 
-    def _needed(self):
-        return self._size - len(self._joined)
+    def _ready(self, entry):
+        """Ready once every live (non-joined) rank has contributed — a
+        raw count would let a since-joined rank's own request stand in
+        for a live rank's missing one (silent wrong result)."""
+        live = set(range(self._size)) - self._joined
+        return live <= entry.requests.keys()
 
     def _handle_collective(self, req):
         with self._cv:
@@ -198,7 +202,7 @@ class CoordinatorService(network.MuxService):
                     f"duplicate request for tensor '{req.name}' from rank "
                     f"{req.rank} before previous one completed"))
             entry.requests[req.rank] = req
-            if len(entry.requests) >= self._needed():
+            if self._ready(entry):
                 self._complete(req.name, entry)
                 self._check_join_barrier()
         # Wait outside negotiation state; requests run on their own mux
@@ -213,13 +217,13 @@ class CoordinatorService(network.MuxService):
                     missing = [r for r in range(self._size)
                                if r not in entry.requests
                                and r not in self._joined]
+                    ready = sorted(entry.requests)
                     entry.stall_warned = True
                     # reference: InvalidateStalledCachedTensors
                     self._sig_cache.evict(req.name)
                 self._log.warning(
                     "Stalled tensor: %s ready ranks: %s, waiting on: %s "
-                    "for more than %ds", req.name,
-                    sorted(entry.requests), missing,
+                    "for more than %ds", req.name, ready, missing,
                     int(self._stall_warning))
             if deadline is not None and time.monotonic() > deadline:
                 # fail EVERY waiter and clear the entry: a poisoned name
@@ -246,8 +250,7 @@ class CoordinatorService(network.MuxService):
             self._join_waiters.append((req.rank, event, slot))
             # a rank joining may complete entries now only missing it
             for name, entry in list(self._forming.items()):
-                if (entry.requests and
-                        len(entry.requests) >= self._needed()):
+                if entry.requests and self._ready(entry):
                     self._complete(name, entry)
             self._check_join_barrier()
         event.wait()
@@ -274,7 +277,9 @@ class CoordinatorService(network.MuxService):
         reqs = entry.requests
         try:
             results = self._execute(name, entry)
-        except ValueError as exc:
+        except Exception as exc:  # noqa: BLE001 — done MUST be set: the
+            # entry left _forming already, so an unset event would spin
+            # every waiting rank forever with no stall escape
             results = {r: ResultMsg(error=str(exc)) for r in reqs}
         entry.results = results
         entry.done.set()
@@ -471,6 +476,7 @@ class TcpController:
         self._coordinator = None
         self._client_addrs = None
         self._mux = None
+        self._mux_lock = threading.Lock()
         self._key = None
         self._peer_service = None
         self._ring = None
@@ -562,11 +568,15 @@ class TcpController:
 
     def _client(self):
         # ONE persistent multiplexed connection (v2); concurrent
-        # blocking requests ride separate mux frames
-        if self._mux is None:
-            self._mux = network.MuxClient(self._client_addrs, self._key,
-                                          timeout=30)
-        return self._mux
+        # blocking requests ride separate mux frames.  Guarded: many
+        # request threads hit first-use together (one burst per backward
+        # pass) and unsynchronized construction leaks every loser's
+        # socket + reader thread
+        with self._mux_lock:
+            if self._mux is None:
+                self._mux = network.MuxClient(self._client_addrs,
+                                              self._key, timeout=30)
+            return self._mux
 
     def _spawn(self, target, *args):
         # one daemon thread per in-flight request (a bounded pool of

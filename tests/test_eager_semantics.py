@@ -230,3 +230,26 @@ def test_broadcast_object_core_surface(hvd):
 
     for out in _per_rank(fn):
         assert out == {"cfg": [1, 2, 3], "root": 5}
+
+
+def test_pending_entry_completes_when_all_ranks_join(hvd):
+    """A tensor submitted asynchronously whose submitters then ALL join
+    must still complete (reduced over the submitters), and the join
+    barrier must release (regression: needed==0 made the entry
+    permanently un-ready, deadlocking every rank inside join())."""
+    def fn(r):
+        h = None
+        if r < 3:
+            h = hvd.allreduce_async(jnp.full((2,), float(r + 1)),
+                                    op=hvd.Sum, name="orphan")
+            # ranks 3..7 never submit 'orphan'; everyone joins
+        last = hvd.join()
+        out = np.asarray(hvd.synchronize(h)) if h is not None else None
+        return last, out
+
+    results = _per_rank(fn)
+    expected = float(1 + 2 + 3)  # submitters only; joined ranks are zeros
+    for r, (last, out) in enumerate(results):
+        assert 0 <= last < N
+        if r < 3:
+            np.testing.assert_allclose(out, np.full((2,), expected))

@@ -199,3 +199,48 @@ def test_tcp_grouped_mixed_planes_4proc():
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
     assert result.stdout.count("GROUPED_OK") == 4
+
+
+JOINED_RANK_WORKER = r"""
+import time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+r = hvd.rank()
+assert hvd.size() == 3
+
+if r == 0:
+    # submit, then join while rank 2 hasn't contributed yet: the
+    # collective must WAIT for rank 2, not complete without it
+    h = hvd.allreduce_async(jnp.full((4,), 1.0), op=hvd.Sum, name="t")
+    last = hvd.join()
+    out = np.asarray(hvd.synchronize(h))
+elif r == 1:
+    out = np.asarray(hvd.allreduce(jnp.full((4,), 2.0), op=hvd.Sum,
+                                   name="t"))
+    last = hvd.join()
+else:
+    time.sleep(1.5)  # rank 0 has joined well before this submission
+    out = np.asarray(hvd.allreduce(jnp.full((4,), 4.0), op=hvd.Sum,
+                                   name="t"))
+    last = hvd.join()
+
+# every contribution must be in the sum, including the joined rank 0's
+np.testing.assert_allclose(out, np.full((4,), 7.0), err_msg=str(out))
+print(f"rank {r} JOINED_COUNT_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_tcp_joined_rank_does_not_satisfy_live_rank():
+    """Regression: the coordinator counted a since-joined rank's request
+    toward completion, finishing a collective without a live rank's
+    contribution (silent wrong sum)."""
+    result = _run_hvdrun(3, JOINED_RANK_WORKER, timeout=300)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert result.stdout.count("JOINED_COUNT_OK") == 3
