@@ -1,0 +1,82 @@
+"""Unit tests for the shared controller helpers: SignatureCache LRU
+semantics (common/response_cache.py) and the fusion bucket planner
+(common/fusion.py) — the pieces every controller flavor now leans on."""
+
+import pytest
+
+from horovod_tpu.common.fusion import plan_buckets
+from horovod_tpu.common.response_cache import SignatureCache
+
+
+# ---------------------------------------------------------- SignatureCache
+def test_cache_miss_then_hit_then_invalidate():
+    cache = SignatureCache(capacity=4)
+    assert not cache.check("t", ["sigA", "sigA"])  # MISS (empty)
+    cache.store("t", ["sigA", "sigA"])
+    assert cache.check("t", ["sigA", "sigA"])      # HIT
+    assert cache.hits == 1
+    assert not cache.check("t", ["sigB", "sigB"])  # signature changed
+    cache.evict("t")
+    assert not cache.check("t", ["sigA", "sigA"])  # INVALID -> miss
+
+
+def test_cache_disagreeing_or_missing_signatures_never_hit_or_store():
+    cache = SignatureCache(capacity=4)
+    cache.store("t", ["sigA", "sigB"])   # ranks disagree: not stored
+    assert len(cache) == 0
+    cache.store("t", ["sigA", None])     # unavailable: not stored
+    assert len(cache) == 0
+    cache.store("t", ["sigA", "sigA"])
+    assert not cache.check("t", ["sigA", None])
+    assert not cache.check("t", ["sigA", "sigB"])
+
+
+def test_cache_lru_eviction_order():
+    cache = SignatureCache(capacity=2)
+    cache.store("a", ["s"])
+    cache.store("b", ["s"])
+    assert cache.check("a", ["s"])  # refresh a
+    cache.store("c", ["s"])         # evicts b (least recent)
+    assert cache.check("a", ["s"])
+    assert not cache.check("b", ["s"])
+    assert cache.check("c", ["s"])
+    assert len(cache) == 2
+
+
+# ------------------------------------------------------------- plan_buckets
+def _buckets(items, threshold=100):
+    return list(plan_buckets(items, key_fn=lambda it: it[0],
+                             nbytes_fn=lambda it: it[1],
+                             threshold=threshold))
+
+
+def test_buckets_split_on_key_change():
+    items = [("k1", 10), ("k1", 10), ("k2", 10), ("k1", 10)]
+    assert _buckets(items) == [
+        [("k1", 10), ("k1", 10)], [("k2", 10)], [("k1", 10)]]
+
+
+def test_buckets_split_on_threshold():
+    items = [("k", 60), ("k", 60), ("k", 60)]
+    assert _buckets(items, threshold=100) == [
+        [("k", 60)], [("k", 60)], [("k", 60)]]
+    items = [("k", 40), ("k", 40), ("k", 40)]
+    assert _buckets(items, threshold=100) == [
+        [("k", 40), ("k", 40)], [("k", 40)]]
+
+
+def test_oversize_single_item_gets_own_bucket():
+    items = [("k", 10), ("k", 500), ("k", 10)]
+    assert _buckets(items, threshold=100) == [
+        [("k", 10)], [("k", 500)], [("k", 10)]]
+
+
+def test_empty_stream_yields_nothing():
+    assert _buckets([]) == []
+
+
+@pytest.mark.parametrize("n", [1, 7, 64])
+def test_order_preserved_within_and_across_buckets(n):
+    items = [("k", 30 + (i % 3)) for i in range(n)]
+    flat = [it for bucket in _buckets(items) for it in bucket]
+    assert flat == items
