@@ -167,9 +167,10 @@ def ring_attention(q, k, v, *, axis_name, causal=False, scale=None,
 
     # Peel the resident (local) K/V block so the scan does exactly
     # p_size - 1 permutes — no discarded final rotation on the ICI.
-    k32 = k.astype(jnp.float32)
-    v32 = v.astype(jnp.float32)
-    o0, l0, m0 = block(o0, l0, m0, k32, v32, my_idx)
+    # K/V rotate in their ORIGINAL dtype: upcasting first would double
+    # the ICI bytes per hop for bf16 activations, and both local paths
+    # cast per block anyway (_block_attend to f32, flash to q.dtype).
+    o0, l0, m0 = block(o0, l0, m0, k, v, my_idx)
 
     def step(carry, s):
         o, l, m, kc, vc = carry
@@ -180,7 +181,7 @@ def ring_attention(q, k, v, *, axis_name, causal=False, scale=None,
         return (o, l, m, kc, vc), None
 
     (o, l, m, _, _), _ = lax.scan(
-        step, (o0, l0, m0, k32, v32), jnp.arange(1, p_size))
+        step, (o0, l0, m0, k, v), jnp.arange(1, p_size))
 
     denom = jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
     return (o / denom).astype(q.dtype)

@@ -5,17 +5,29 @@ request/response objects, plus interface enumeration helpers used for
 routable-NIC discovery).
 
 Wire format per message: ``[4-byte big-endian length][32-byte HMAC-SHA256
-digest][pickled object]``.  The digest is verified BEFORE unpickling — an
-unauthenticated peer cannot reach the unpickler.
+digest][pickled (direction, object)]``.  The digest is verified BEFORE
+unpickling — an unauthenticated peer cannot reach the unpickler — and the
+claimed length is capped before any buffering, so an unauthenticated peer
+cannot make the service hold gigabytes either.  The signed envelope
+carries a direction tag ("q" request / "r" response) so a reflected
+frame cannot answer a request, and mux request ids start at a random
+64-bit offset so a frame recorded from an earlier connection cannot pair
+with a live request.  (An on-path adversary that can splice into the TCP
+stream in real time is outside this threat model — that requires TLS.)
 """
 
 import pickle
+import secrets as _secrets
 import socket
 import socketserver
 import struct
 import threading
 
 from horovod_tpu.run.service import secret
+
+# Largest frame accepted before authentication.  Generous: the tcp star
+# data plane ships whole tensors (the bench sweep goes to 256 MB).
+MAX_FRAME_BYTES = 1 << 30
 
 
 # ------------------------------------------------------------- base messages
@@ -33,30 +45,44 @@ class AckResponse:
 
 
 # ---------------------------------------------------------------- wire codec
-def write_message(sock, key, obj):
-    payload = pickle.dumps(obj)
+def write_message(sock, key, obj, direction):
+    payload = pickle.dumps((direction, obj))
+    if len(payload) > MAX_FRAME_BYTES:
+        # fail HERE with a clear error — the receiver would just drop
+        # the connection and the sender would see a mute timeout
+        raise ValueError(
+            f"frame of {len(payload)} bytes exceeds the "
+            f"{MAX_FRAME_BYTES}-byte transport limit")
     digest = secret.sign(key, payload)
     sock.sendall(struct.pack(">I", len(payload)) + digest + payload)
 
 
-def read_message(sock, key):
+def read_message(sock, key, expected_direction):
     header = _read_exact(sock, 4 + secret.DIGEST_LEN)
     (length,) = struct.unpack(">I", header[:4])
+    if length > MAX_FRAME_BYTES:
+        raise ConnectionError(
+            f"frame length {length} exceeds limit {MAX_FRAME_BYTES}")
     digest = header[4:]
     payload = _read_exact(sock, length)
     if not secret.check(key, payload, digest):
         raise PermissionError("message failed HMAC verification")
-    return pickle.loads(payload)
+    envelope = pickle.loads(payload)
+    if not (isinstance(envelope, tuple) and len(envelope) == 2
+            and envelope[0] == expected_direction):
+        raise PermissionError(
+            "message direction mismatch (reflected frame?)")
+    return envelope[1]
 
 
 def _read_exact(sock, n):
-    buf = b""
+    buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
             raise ConnectionError("peer closed connection")
         buf += chunk
-    return buf
+    return bytes(buf)
 
 
 # ------------------------------------------------------------------- service
@@ -67,28 +93,46 @@ class BasicService:
     def __init__(self, name, key):
         self._name = name
         self._key = key
+        self._start_server(self._make_handler())
+
+    def _make_handler(self):
         service = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
-                    req = read_message(self.request, service._key)
+                    req = read_message(self.request, service._key, "q")
                 except (PermissionError, ConnectionError, EOFError):
                     return  # drop unauthenticated/broken peers silently
                 try:
                     resp = service._handle(req, self.client_address)
                 except Exception as exc:  # noqa: BLE001 — ship to client
                     resp = exc
-                write_message(self.request, service._key, resp)
+                try:
+                    write_message(self.request, service._key, resp, "r")
+                except OSError:
+                    pass  # client went away
+                except Exception as exc:  # noqa: BLE001 — unpicklable resp
+                    try:
+                        write_message(
+                            self.request, service._key,
+                            RuntimeError(
+                                f"response serialization failed: {exc}"),
+                            "r")
+                    except Exception:  # noqa: BLE001
+                        pass
 
+        return Handler
+
+    def _start_server(self, handler):
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
             allow_reuse_address = True
 
-        self._server = Server(("0.0.0.0", 0), Handler)
+        self._server = Server(("0.0.0.0", 0), handler)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True,
-                                        name=f"{name}-service")
+                                        name=f"{self._name}-service")
         self._thread.start()
 
     @property
@@ -141,8 +185,8 @@ class BasicClient:
     def _send_one(self, addr, req):
         with socket.create_connection(addr, timeout=self._timeout) as sock:
             sock.settimeout(self._read_timeout)
-            write_message(sock, self._key, req)
-            resp = read_message(sock, self._key)
+            write_message(sock, self._key, req, "q")
+            resp = read_message(sock, self._key, "r")
         if isinstance(resp, Exception):
             raise resp
         return resp
@@ -152,21 +196,27 @@ class BasicClient:
         request has been written, any error propagates — retransmitting a
         non-idempotent message (e.g. a collective contribution that is
         merely slow to complete) would hit the coordinator's
-        duplicate-request detection and fail the job."""
-        if self._good is not None:
-            return self._send_one(self._good, req)
+        duplicate-request detection and fail the job.  A cached winner
+        whose CONNECT fails is safe to fail over from (nothing was
+        sent), so the other addresses are retried then."""
+        candidates = list(self._addresses)
+        if self._good is not None and self._good in candidates:
+            candidates.remove(self._good)
+            candidates.insert(0, self._good)
         last_error = None
-        for addr in self._addresses:
+        for addr in candidates:
             try:
                 sock = socket.create_connection(addr, timeout=self._timeout)
             except OSError as exc:
                 last_error = exc
+                if addr == self._good:
+                    self._good = None
                 continue
             try:
                 with sock:
                     sock.settimeout(self._read_timeout)
-                    write_message(sock, self._key, req)
-                    resp = read_message(sock, self._key)
+                    write_message(sock, self._key, req, "q")
+                    resp = read_message(sock, self._key, "r")
             except OSError:
                 raise  # sent — do NOT failover to another address
             self._good = addr
@@ -203,10 +253,11 @@ class MuxService(BasicService):
     re-running rendezvous per collective."""
 
     def __init__(self, name, key):
-        self._name = name
-        self._key = key
         self._inflight = 0
         self._inflight_cv = threading.Condition()
+        super().__init__(name, key)
+
+    def _make_handler(self):
         service = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -215,7 +266,7 @@ class MuxService(BasicService):
                 sock = self.request
                 while True:
                     try:
-                        frame = read_message(sock, service._key)
+                        frame = read_message(sock, service._key, "q")
                     except (PermissionError, ConnectionError, EOFError,
                             OSError):
                         return
@@ -234,12 +285,8 @@ class MuxService(BasicService):
                                 resp = exc
                             if req_id is None:
                                 return  # fire-and-forget: no response
-                            try:
-                                with write_lock:
-                                    write_message(sock, service._key,
-                                                  (req_id, resp))
-                            except OSError:
-                                pass  # client went away
+                            service._write_response(sock, write_lock,
+                                                    req_id, resp)
                         finally:
                             with service._inflight_cv:
                                 service._inflight -= 1
@@ -248,15 +295,31 @@ class MuxService(BasicService):
                     threading.Thread(target=run, daemon=True,
                                      name=f"{service._name}-req").start()
 
-        class Server(socketserver.ThreadingTCPServer):
-            daemon_threads = True
-            allow_reuse_address = True
+        return Handler
 
-        self._server = Server(("0.0.0.0", 0), Handler)
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True,
-                                        name=f"{name}-service")
-        self._thread.start()
+    def _write_response(self, sock, write_lock, req_id, resp):
+        try:
+            with write_lock:
+                write_message(sock, self._key, (req_id, resp), "r")
+        except OSError:
+            pass  # client went away
+        except Exception as exc:  # noqa: BLE001 — e.g. unpicklable resp
+            # a silently-dropped frame would hang the client's send()
+            # forever; ship an error, or kill the connection so the
+            # client fails fast
+            try:
+                with write_lock:
+                    write_message(
+                        sock, self._key,
+                        (req_id,
+                         RuntimeError(
+                             f"response serialization failed: {exc}")),
+                        "r")
+            except Exception:  # noqa: BLE001
+                try:
+                    sock.close()
+                except OSError:
+                    pass
 
     def shutdown(self):
         """Drain in-flight requests before closing: a coordinator whose
@@ -292,11 +355,14 @@ class MuxClient:
         self._send_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._pending = {}    # req_id -> [event, response]
-        self._next_id = 0
+        # random start: a (req_id, resp) frame recorded from an earlier
+        # connection/run cannot collide with a live request id
+        self._next_id = _secrets.randbits(48)
         self._reader = None
         self._broken = None
 
-    def _connect(self):
+    def _connect_locked(self):
+        """Establish the socket + reader (caller holds _state_lock)."""
         last_error = None
         for addr in self._addresses:
             try:
@@ -317,12 +383,31 @@ class MuxClient:
             f"could not reach service at any of {self._addresses}: "
             f"{last_error}")
 
+    def _ensure_connected_locked(self):
+        """Returns the live socket (caller holds _state_lock).  The
+        returned reference — not a re-read of self._sock — must be used
+        for the write, so a concurrent reconnect can never route this
+        request onto a connection its pending entry isn't tied to."""
+        if self._sock is None or self._broken is not None:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            self._connect_locked()
+        return self._sock
+
     def _read_loop(self, sock):
         while True:
             try:
-                frame = read_message(sock, self._key)
-            except (PermissionError, ConnectionError, EOFError, OSError) \
-                    as exc:
+                frame = read_message(sock, self._key, "r")
+                if not (isinstance(frame, tuple) and len(frame) == 2):
+                    raise ConnectionError(
+                        f"malformed mux frame {type(frame).__name__}")
+                req_id, resp = frame
+            except Exception as exc:  # noqa: BLE001 — reader must never
+                # die silently: fail every waiter and mark broken
                 with self._state_lock:
                     self._broken = exc
                     pending, self._pending = self._pending, {}
@@ -331,7 +416,6 @@ class MuxClient:
                         f"connection to service lost: {exc}")
                     event.set()
                 return
-            req_id, resp = frame
             with self._state_lock:
                 entry = self._pending.pop(req_id, None)
             if entry is not None:
@@ -340,22 +424,15 @@ class MuxClient:
 
     def send(self, req, timeout=None):
         with self._state_lock:
-            if self._sock is None or self._broken is not None:
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
-                self._connect()
+            sock = self._ensure_connected_locked()
             req_id = self._next_id
             self._next_id += 1
             event, slot = threading.Event(), [None]
             self._pending[req_id] = (event, slot)
         try:
             with self._send_lock:
-                write_message(self._sock, self._key, (req_id, req))
-        except OSError:
+                write_message(sock, self._key, (req_id, req), "q")
+        except Exception:  # OSError, PicklingError, oversize ValueError…
             with self._state_lock:
                 self._pending.pop(req_id, None)
             raise
@@ -373,16 +450,9 @@ class MuxClient:
         (req_id None).  TCP ordering + HMAC still apply; used by the ring
         data plane so chunk streams aren't serialized on ack round-trips."""
         with self._state_lock:
-            if self._sock is None or self._broken is not None:
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
-                self._connect()
+            sock = self._ensure_connected_locked()
         with self._send_lock:
-            write_message(self._sock, self._key, (None, req))
+            write_message(sock, self._key, (None, req), "q")
 
     def close(self):
         with self._state_lock:

@@ -150,3 +150,61 @@ def test_local_interfaces_enumeration():
     assert ifaces, "must report at least one interface"
     for name, ip in ifaces.items():
         assert isinstance(name, str) and ip.count(".") == 3
+
+
+def test_wire_direction_tag_rejects_reflected_frames():
+    """A signed frame can only be read in its own direction — a
+    reflected request cannot pose as a response (regression for the
+    reflection gap in the HMAC envelope)."""
+    import socket as socket_mod
+
+    from horovod_tpu.run.service import network, secret
+
+    key = secret.make_secret_key()
+    a, b = socket_mod.socketpair()
+    try:
+        network.write_message(a, key, {"x": 1}, "q")
+        # reading with the wrong expected direction must fail BEFORE the
+        # payload reaches the caller
+        import pytest
+        with pytest.raises(PermissionError, match="direction"):
+            network.read_message(b, key, "r")
+        # and with the right one it round-trips
+        network.write_message(a, key, {"x": 2}, "q")
+        assert network.read_message(b, key, "q") == {"x": 2}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_rejects_oversized_frame_before_buffering():
+    """An unauthenticated peer's claimed length beyond the cap is
+    refused before any payload is read (pre-auth memory exhaustion)."""
+    import socket as socket_mod
+    import struct
+
+    import pytest
+
+    from horovod_tpu.run.service import network, secret
+
+    key = secret.make_secret_key()
+    a, b = socket_mod.socketpair()
+    try:
+        a.sendall(struct.pack(">I", network.MAX_FRAME_BYTES + 1)
+                  + b"\x00" * secret.DIGEST_LEN)
+        with pytest.raises(ConnectionError, match="exceeds limit"):
+            network.read_message(b, key, "q")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_mux_client_random_id_start():
+    """Request ids start at a random 48-bit offset so frames recorded
+    from another connection cannot pair with live requests."""
+    from horovod_tpu.run.service import network
+
+    ids = {network.MuxClient([("127.0.0.1", 1)], b"k")._next_id
+           for _ in range(4)}
+    assert len(ids) == 4  # collisions astronomically unlikely
+    assert all(i > 0 for i in ids)
