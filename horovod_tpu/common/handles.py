@@ -75,7 +75,14 @@ class HandleManager:
     def wait(self, idx: int, timeout=None):
         handle = self.get(idx)
         try:
-            return handle.wait(timeout)
-        finally:
-            with self._lock:
+            result = handle.wait(timeout)
+        except TimeoutError:
+            raise  # handle stays registered: the collective may still
+            # complete, and a retry must be able to collect the result
+        except Exception:
+            with self._lock:  # terminal (HvdError): drop the entry
                 self._handles.pop(idx, None)
+            raise
+        with self._lock:
+            self._handles.pop(idx, None)
+        return result

@@ -67,7 +67,11 @@ def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
     elif state.config.controller == "tcp":
         import numpy as _np
 
-        committed = _np.asarray(tensor)
+        # copy, not a view: capture-at-call semantics — the caller may
+        # legally reuse its buffer before the coordinator cycle runs,
+        # and different ranks racing that mutation would reduce
+        # inconsistent snapshots (the device path's commit() copies too)
+        committed = _np.array(tensor, copy=True)
     else:
         committed = state.executor.commit(tensor, basics.rank())
     handle = Handle(name)

@@ -11,6 +11,7 @@ required (in-place variants).
 import numpy as np
 import torch
 
+import jax
 import jax.numpy as jnp
 
 from horovod_tpu.common.handles import HandleManager
@@ -25,6 +26,9 @@ _TORCH_NUMPY_FIXUPS = {
 }
 
 
+_WARNED_NARROW = set()
+
+
 def _to_jax(tensor: torch.Tensor):
     src = tensor.detach()
     fixup = _TORCH_NUMPY_FIXUPS.get(src.dtype)
@@ -32,8 +36,33 @@ def _to_jax(tensor: torch.Tensor):
         arr = jnp.asarray(src.to(fixup).numpy()).astype(
             str(src.dtype).replace("torch.", ""))
     else:
+        if src.dtype in (torch.int64, torch.float64) \
+                and not jax.config.jax_enable_x64 \
+                and src.dtype not in _WARNED_NARROW:
+            _WARNED_NARROW.add(src.dtype)
+            from horovod_tpu.utils.logging import get_logger
+            get_logger().warning(
+                "%s tensors narrow to 32-bit on the XLA device plane "
+                "(jax_enable_x64 off); values beyond 32-bit range lose "
+                "precision. Process mode (hvdrun) keeps 64-bit exact.",
+                src.dtype)
         arr = jnp.asarray(src.contiguous().numpy())
     return arr
+
+
+def _to_eager(tensor: torch.Tensor):
+    """Torch tensor -> whatever the active data plane wants: numpy in
+    tcp mode (keeps 64-bit dtypes EXACT on the numpy wire; converting
+    through jax first would narrow them), jax arrays otherwise."""
+    from horovod_tpu.common import basics
+
+    state = basics._get_state()
+    if state.config.controller == "tcp":
+        src = tensor.detach()
+        if src.dtype in _TORCH_NUMPY_FIXUPS:  # bf16: numpy can't hold it
+            return _to_jax(tensor)
+        return src.contiguous().numpy()
+    return _to_jax(tensor)
 
 
 def _to_torch(arr, like: torch.Tensor = None):
@@ -90,7 +119,7 @@ def _allreduce_async_impl(tensor, name, op, prescale_factor,
     compression = compression or Compression.none
     compressed, ctx = compression.compress(tensor)
     core_handle = eager.allreduce_async(
-        _to_jax(compressed), name=name, op=op,
+        _to_eager(compressed), name=name, op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor)
 
     def finalize(result):
@@ -136,7 +165,7 @@ def allreduce_(tensor, average=None, name=None, op=None,
 
 # -------------------------------------------------------------- allgather ---
 def allgather_async(tensor, name=None) -> int:
-    core_handle = eager.allgather_async(_to_jax(tensor), name=name)
+    core_handle = eager.allgather_async(_to_eager(tensor), name=name)
     return _register(core_handle,
                      lambda result: _to_torch(result, like=tensor))
 
@@ -147,7 +176,7 @@ def allgather(tensor, name=None):
 
 # -------------------------------------------------------------- broadcast ---
 def broadcast_async(tensor, root_rank, name=None) -> int:
-    core_handle = eager.broadcast_async(_to_jax(tensor), root_rank,
+    core_handle = eager.broadcast_async(_to_eager(tensor), root_rank,
                                         name=name)
     return _register(core_handle,
                      lambda result: _to_torch(result, like=tensor))
@@ -158,7 +187,7 @@ def broadcast(tensor, root_rank, name=None):
 
 
 def broadcast_async_(tensor, root_rank, name=None) -> int:
-    core_handle = eager.broadcast_async(_to_jax(tensor), root_rank,
+    core_handle = eager.broadcast_async(_to_eager(tensor), root_rank,
                                         name=name)
 
     def finalize(result):
@@ -174,14 +203,20 @@ def broadcast_(tensor, root_rank, name=None):
 
 # --------------------------------------------------------------- alltoall ---
 def alltoall_async(tensor, splits=None, name=None) -> int:
-    if splits is not None and torch.is_tensor(splits):
+    splits_was_tensor = splits is not None and torch.is_tensor(splits)
+    if splits_was_tensor:
         splits = splits.tolist()
-    core_handle = eager.alltoall_async(_to_jax(tensor), splits=splits,
+    core_handle = eager.alltoall_async(_to_eager(tensor), splits=splits,
                                        name=name)
 
     def finalize(result):
-        out, _recv_splits = result
-        return _to_torch(out, like=tensor)
+        out, recv_splits = result
+        out = _to_torch(out, like=tensor)
+        if splits_was_tensor:
+            # reference parity: tensor splits in -> received splits out,
+            # so variable-split callers can partition by source rank
+            return out, torch.tensor(recv_splits, dtype=torch.int32)
+        return out
 
     return _register(core_handle, finalize)
 

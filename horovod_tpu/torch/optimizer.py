@@ -45,10 +45,13 @@ class _DistributedOptimizerMixin:
                     f"{sorted(dups)}")
             self._parameter_names = {v: k for k, v in named_parameters}
         else:
+            # one global index: per-group enumeration would collide
+            # names across groups and pair unrelated gradients
             self._parameter_names = {
                 v: f"allreduce.noname.{i}"
-                for param_group in self.param_groups
-                for i, v in enumerate(param_group["params"])
+                for i, v in enumerate(
+                    p for group in self.param_groups
+                    for p in group["params"])
             }
         self._allreduce_delay = {}
         for group in self.param_groups:
@@ -62,20 +65,51 @@ class _DistributedOptimizerMixin:
         def hook(p):
             if p not in self._allreduce_delay:
                 return
+            if self._allreduce_delay[p] <= 0:
+                # reference: torch/__init__.py asserts here — silently
+                # continuing would overwrite the accumulated gradient
+                # with a stale allreduced one at step()
+                raise AssertionError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before step() or "
+                    "synchronize(); increase backward_passes_per_step "
+                    "or call synchronize() between backward passes")
             self._allreduce_delay[p] -= 1
             if self._allreduce_delay[p] == 0:
                 self._handles[p] = self._allreduce_grad_async(p)
         return hook
 
     def _allreduce_grad_async(self, p):
-        name = self._parameter_names.get(p, "allreduce.unnamed")
+        name = self._parameter_names.get(p)
+        if name is None:
+            # unique per parameter: a shared fallback would pair
+            # unrelated tensors across ranks
+            name = f"unnamed.{id(p)}"
+        if p.grad is None:
+            # a parameter whose hook never fired on this rank still
+            # participates with zeros — ranks where it DID fire would
+            # hang otherwise — and the averaged gradient must land in
+            # p.grad so the optimizer applies the SAME update everywhere
+            p.grad = torch.zeros_like(p)
         return mpi_ops._allreduce_async_impl(
             p.grad, f"allreduce.{name}", self._op, self._prescale_factor,
             self._postscale_factor, self._compression, p.grad)
 
     def synchronize(self):
         """Wait for all outstanding gradient allreduces (reference:
-        torch/__init__.py:165)."""
+        torch/__init__.py:165).  Parameters whose hooks did not fire on
+        this rank (data-dependent branches, frozen-at-runtime paths)
+        are submitted NOW with their current (or zero) gradient — every
+        rank must contribute to every negotiated tensor or the ranks
+        where the hook did fire would hang (reference: the missing_p
+        loop in synchronize)."""
+        for p in self._requires_update:
+            if p not in self._handles:
+                # reference missing_p loop: no delay condition — every
+                # rank must contribute to every negotiated tensor, even
+                # mid-accumulation (calling synchronize mid-window is
+                # the caller's choice; skipping would hang other ranks)
+                self._handles[p] = self._allreduce_grad_async(p)
         for p, handle in self._handles.items():
             mpi_ops.synchronize(handle)
             self._allreduce_delay[p] = self._backward_passes_per_step
@@ -203,7 +237,33 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
     """Broadcast optimizer state from root (reference:
     torch/__init__.py:484).  Tensor state entries broadcast directly;
     scalar entries (step counters, lr, ...) ride type-preserving 0-d
-    broadcasts."""
+    broadcasts.
+
+    Ranks with EMPTY state (torch creates it lazily on the first step)
+    materialize it with a zero-gradient step first — otherwise a root
+    resuming from a checkpoint would submit broadcasts fresh workers
+    never answer, hanging the job (reference: the dummy-step dance at
+    torch/__init__.py:490-516)."""
+    if not optimizer.state_dict().get("state"):
+        saved_grads, backups = [], []
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                saved_grads.append((p, p.grad))
+                backups.append((p, p.detach().clone()))
+                p.grad = torch.zeros_like(p)
+        if isinstance(optimizer, _DistributedOptimizerMixin):
+            # the RAW step: only ranks with empty state run this dummy,
+            # so the wrapped step's synchronize() would hang waiting for
+            # ranks that skipped it (reference calls super().step() too)
+            super(_DistributedOptimizerMixin, optimizer).step()
+        else:
+            optimizer.step()
+        with torch.no_grad():
+            for p, backup in backups:
+                p.copy_(backup)  # undo weight-decay drift etc.
+        for p, grad in saved_grads:
+            p.grad = grad
+
     state_dict = optimizer.state_dict()
 
     scalars = {}

@@ -27,13 +27,23 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
 
     def forward(self, input):
         self._check_input_dim(input)
+        # exponential_average_factor semantics as in _BatchNorm:
+        # momentum=None means a cumulative moving average driven by
+        # num_batches_tracked — on EVERY training path, so single-rank
+        # and distributed runs of the same module behave identically
+        eaf = 0.0 if self.momentum is None else self.momentum
+        if self.training and self.track_running_stats \
+                and self.num_batches_tracked is not None:
+            self.num_batches_tracked.add_(1)
+            if self.momentum is None:
+                eaf = 1.0 / float(self.num_batches_tracked)
         if not self.training or basics.size() == 1:
             return F.batch_norm(
                 input, self.running_mean, self.running_var, self.weight,
-                self.bias, self.training, self.momentum, self.eps)
+                self.bias, self.training, eaf, self.eps)
         return _SyncBatchNormFn.apply(
             input, self.weight, self.bias, self.running_mean,
-            self.running_var, self.eps, self.momentum)
+            self.running_var, self.eps, eaf)
 
 
 class _SyncBatchNormFn(Function):
@@ -41,11 +51,13 @@ class _SyncBatchNormFn(Function):
     def forward(ctx, input, weight, bias, running_mean, running_var, eps,
                 momentum):
         reduce_dims = [0] + list(range(2, input.dim()))
+        # statistics in float32 regardless of activation dtype
+        inp32 = input.float()
         count = torch.tensor(
             [input.numel() // input.size(1)], dtype=torch.float32)
-        mean = input.mean(dim=reduce_dims)
+        mean = inp32.mean(dim=reduce_dims)
         # biased variance for normalization
-        var = input.var(dim=reduce_dims, unbiased=False)
+        var = inp32.var(dim=reduce_dims, unbiased=False)
 
         # gather [count, mean..., var...] from every rank in one op
         packed = torch.cat([count, mean, var]).unsqueeze(0)
@@ -67,11 +79,17 @@ class _SyncBatchNormFn(Function):
             running_var.mul_(1 - momentum).add_(unbiased * momentum)
 
         shape = [1, -1] + [1] * (input.dim() - 2)
-        xhat = (input - global_mean.reshape(shape)) * invstd.reshape(shape)
-        out = xhat * weight.reshape(shape) + bias.reshape(shape)
+        xhat = (inp32 - global_mean.reshape(shape)) * invstd.reshape(shape)
+        if weight is not None:
+            out = xhat * weight.float().reshape(shape) \
+                + bias.float().reshape(shape)
+        else:  # affine=False
+            out = xhat
 
         ctx.save_for_backward(input, weight, global_mean, invstd, total)
-        return out
+        # activations keep the input dtype (bf16 stays bf16 distributed
+        # and single-rank alike); stats stayed fp32 above
+        return out.to(input.dtype)
 
     @staticmethod
     def backward(ctx, grad_output):
@@ -79,9 +97,10 @@ class _SyncBatchNormFn(Function):
         reduce_dims = [0] + list(range(2, input.dim()))
         shape = [1, -1] + [1] * (input.dim() - 2)
 
-        xmu = input - global_mean.reshape(shape)
-        sum_dy = grad_output.sum(dim=reduce_dims)
-        sum_dy_xmu = (grad_output * xmu).sum(dim=reduce_dims)
+        grad32 = grad_output.float()
+        xmu = input.float() - global_mean.reshape(shape)
+        sum_dy = grad32.sum(dim=reduce_dims)
+        sum_dy_xmu = (grad32 * xmu).sum(dim=reduce_dims)
 
         # per-channel global sums across ranks
         packed = torch.cat([sum_dy, sum_dy_xmu]).unsqueeze(0)
@@ -90,12 +109,18 @@ class _SyncBatchNormFn(Function):
         g_sum_dy = reduced[:sum_dy.numel()]
         g_sum_dy_xmu = reduced[sum_dy.numel():]
 
-        w_invstd = (weight * invstd).reshape(shape)
-        grad_input = w_invstd * (
-            grad_output - (g_sum_dy.reshape(shape)
-                           + xmu * (invstd ** 2).reshape(shape)
-                           * g_sum_dy_xmu.reshape(shape)) / total)
+        scale = (weight.float() * invstd if weight is not None
+                 else invstd).reshape(shape)
+        grad_input = scale * (
+            grad32 - (g_sum_dy.reshape(shape)
+                      + xmu * (invstd ** 2).reshape(shape)
+                      * g_sum_dy_xmu.reshape(shape)) / total)
+        grad_input = grad_input.to(grad_output.dtype)
 
-        grad_weight = sum_dy_xmu * invstd
-        grad_bias = sum_dy
+        if weight is not None:
+            grad_weight = (sum_dy_xmu * invstd).to(weight.dtype)
+            grad_bias = sum_dy.to(weight.dtype)
+        else:
+            grad_weight = None
+            grad_bias = None
         return grad_input, grad_weight, grad_bias, None, None, None, None

@@ -607,3 +607,168 @@ def test_torch_broadcast_object():
 
     for out in _per_rank(fn):
         assert out == {"epoch": 7, "sched": [0.1, 0.01], "rank": 3}
+
+
+def test_optimizer_unnamed_multi_group_names_do_not_collide():
+    """Regression: per-group enumeration gave group0-param0 and
+    group1-param0 the same fallback collective name, pairing unrelated
+    gradients (or erroring on duplicates)."""
+    def fn(r):
+        a = torch.nn.Linear(4, 4, bias=False)
+        b = torch.nn.Linear(4, 4, bias=False)
+        with torch.no_grad():
+            a.weight.fill_(0.0)
+            b.weight.fill_(0.0)
+        opt = hvd.DistributedOptimizer(torch.optim.SGD(
+            [{"params": a.parameters(), "lr": 1.0},
+             {"params": b.parameters(), "lr": 1.0}]))
+        x = torch.ones(1, 4)
+        loss = a(x).sum() * (r + 1) + b(x).sum() * 10 * (r + 1)
+        loss.backward()
+        opt.step()
+        return a.weight.detach().clone(), b.weight.detach().clone()
+
+    mean_scale = np.mean([r + 1 for r in range(N)])
+    for wa, wb in _per_rank(fn):
+        # d(loss)/d(a.w) = (r+1); averaged = mean(r+1); lr=1 -> -mean
+        assert torch.allclose(wa, torch.full((4, 4), -mean_scale)), wa
+        assert torch.allclose(wb, torch.full((4, 4), -10 * mean_scale)), wb
+
+
+def test_optimizer_extra_backward_raises():
+    """Regression: a second backward past backward_passes_per_step
+    silently discarded gradient contributions; now it raises like the
+    reference."""
+    def fn(r):
+        model = torch.nn.Linear(3, 1)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        x = torch.ones(2, 3)
+        model(x).sum().backward()
+        try:
+            model(x).sum().backward()
+            return "no-error"
+        except (AssertionError, RuntimeError):
+            # torch surfaces hook exceptions as RuntimeError in backward
+            opt.synchronize()  # drain the first backward's allreduces
+            return "raised"
+
+    assert all(x == "raised" for x in _per_rank(fn))
+
+
+def test_optimizer_missing_hook_param_contributes_zeros():
+    """A parameter untouched by this rank's backward (data-dependent
+    branch) must still participate at synchronize() — otherwise ranks
+    whose hook DID fire hang (reference: the missing_p loop)."""
+    init_a = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        init_a.weight.fill_(0.0)
+    state = {k: v.clone() for k, v in init_a.state_dict().items()}
+
+    def fn(r):
+        a = torch.nn.Linear(2, 1, bias=False)
+        b = torch.nn.Linear(2, 1, bias=False)
+        a.load_state_dict(state)
+        with torch.no_grad():
+            b.weight.fill_(0.0)
+        opt = hvd.DistributedOptimizer(torch.optim.SGD(
+            [{"params": list(a.parameters()) + list(b.parameters()),
+              "lr": 1.0}]))
+        x = torch.ones(1, 2)
+        # only even ranks touch b
+        loss = a(x).sum()
+        if r % 2 == 0:
+            loss = loss + b(x).sum()
+        loss.backward()
+        opt.step()
+        return b.weight.detach().clone()
+
+    # b's grad: 1 on even ranks, zero stand-in on odd -> average 0.5
+    for wb in _per_rank(fn):
+        assert torch.allclose(wb, torch.full((1, 2), -0.5)), wb
+
+
+def test_broadcast_optimizer_state_materializes_empty_state():
+    """Regression: a root resuming with populated Adam state deadlocked
+    fresh workers whose lazy state was empty; workers now materialize
+    state with a zero-grad step before the exchange."""
+    base = torch.nn.Linear(3, 2)
+    state = {k: v.clone() for k, v in base.state_dict().items()}
+
+    def fn(r):
+        model = torch.nn.Linear(3, 2)
+        model.load_state_dict(state)
+        opt = torch.optim.Adam(model.parameters(), lr=0.01)
+        if r == 0:
+            # only the root has taken real steps (checkpoint resume)
+            for _ in range(3):
+                opt.zero_grad()
+                model(torch.ones(2, 3)).sum().backward()
+                opt.step()
+        before = [p.detach().clone() for p in model.parameters()]
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+        # params must be untouched by the materialization dummy step
+        for p, b in zip(model.parameters(), before):
+            assert torch.allclose(p, b)
+        steps = {int(s["step"]) for s in opt.state_dict()["state"].values()}
+        return steps
+
+    for steps in _per_rank(fn):
+        assert steps == {3}, steps
+
+
+def test_torch_alltoall_tensor_splits_returns_recv_splits():
+    """Reference parity: passing splits as a TENSOR returns
+    (output, received_splits)."""
+    def fn(r):
+        rows = sum(d + 1 for d in range(N))
+        data = torch.full((rows, 2), float(r))
+        splits = torch.tensor([d + 1 for d in range(N)])
+        out, recv = hvd.alltoall(data, splits=splits, name="t.a2a.rs")
+        return out, recv
+
+    for r, (out, recv) in enumerate(_per_rank(fn)):
+        assert torch.equal(recv, torch.full((N,), r + 1,
+                                            dtype=torch.int32))
+        assert out.shape[0] == int(recv.sum())
+
+
+def test_sync_batch_norm_affine_false_and_bf16_dtype():
+    """affine=False must not crash distributed (weight/bias None) and
+    bf16 activations keep their dtype through the sync path."""
+    full = torch.randn(16, 3, generator=torch.Generator().manual_seed(0))
+
+    def fn(r):
+        bn = hvd.SyncBatchNorm(3, affine=False)
+        bn.train()
+        out = bn(full[r * 2:(r + 1) * 2])
+        bnb = hvd.SyncBatchNorm(3)
+        bnb.train()
+        outb = bnb(full[r * 2:(r + 1) * 2].to(torch.bfloat16))
+        return out, outb.dtype, bn.running_mean.clone()
+
+    expected_mean = 0.1 * full.mean(dim=0)
+    for out, dtype_b, rmean in _per_rank(fn):
+        assert out.shape == (2, 3)
+        assert dtype_b == torch.bfloat16
+        assert torch.allclose(rmean, expected_mean, atol=1e-5)
+
+
+def test_sync_batch_norm_momentum_none_cumulative():
+    """momentum=None uses the cumulative moving average via
+    num_batches_tracked (base _BatchNorm semantics)."""
+    full = torch.randn(16, 4, generator=torch.Generator().manual_seed(1))
+
+    def fn(r):
+        bn = hvd.SyncBatchNorm(4, momentum=None)
+        bn.train()
+        bn(full[r * 2:(r + 1) * 2])
+        bn(full[r * 2:(r + 1) * 2])
+        return bn.num_batches_tracked.clone(), bn.running_mean.clone()
+
+    # two batches of identical data: cumulative average == batch mean
+    expected = full.mean(dim=0)
+    for nbt, rmean in _per_rank(fn):
+        assert int(nbt) == 2
+        assert torch.allclose(rmean, expected, atol=1e-5)
