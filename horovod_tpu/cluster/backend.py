@@ -24,8 +24,31 @@ class InProcessBackend(Backend):
     def num_processes(self):
         import horovod_tpu as hvd
 
+        if self._num_proc is not None:
+            from horovod_tpu.common import basics
+
+            if basics._state is None:
+                # restrict the rank set to num_proc devices BEFORE the
+                # first init: the threaded eager path would otherwise
+                # wait forever for device ranks that have no training
+                # thread
+                import jax
+
+                devices = list(jax.devices())
+                if self._num_proc < len(devices):
+                    hvd.init(comm=devices[:self._num_proc])
+                else:
+                    hvd.init()
+            else:
+                hvd.init()  # no-op; verify compatibility below
+            if hvd.size() != self._num_proc:
+                raise RuntimeError(
+                    f"InProcessBackend(num_proc={self._num_proc}) but "
+                    f"horovod_tpu is initialized with {hvd.size()} "
+                    f"ranks; shut down first or match num_proc")
+            return self._num_proc
         hvd.init()
-        return self._num_proc or hvd.local_size()
+        return hvd.local_size()
 
     def run(self, fn, args=(), kwargs=None):
         from horovod_tpu.common import basics

@@ -12,7 +12,9 @@ from horovod_tpu.cluster.store import LocalStore
 def _default_loss(preds, y):
     import jax.numpy as jnp
 
-    if y.ndim == 1 and np.issubdtype(np.asarray(y).dtype, np.integer):
+    # y may be a jax tracer inside the jitted step: inspect .dtype
+    # directly (np.asarray on a tracer raises at trace time)
+    if y.ndim == 1 and jnp.issubdtype(y.dtype, jnp.integer):
         import optax
         return jnp.mean(
             optax.softmax_cross_entropy_with_integer_labels(preds, y))
@@ -193,10 +195,9 @@ class JaxEstimator:
         if isinstance(backend, InProcessBackend):
             import horovod_tpu as hvd
 
-            hvd.init()
-            # the compiled SPMD plane requires the rank count to be the
-            # full mesh; an explicit smaller num_proc keeps the threaded
-            # eager path
+            # backend.num_processes() above already initialized — with a
+            # comm-restricted rank set when num_proc is below the device
+            # count (see InProcessBackend)
             use_spmd = n == hvd.mesh().devices.size
         if use_spmd:
             metrics = _train_spmd(
@@ -214,6 +215,12 @@ class JaxEstimator:
 
         template = self.model.init(jax.random.PRNGKey(self.seed),
                                    jnp.asarray(x[:1]))
-        params, _ = ckpt.restore_checkpoint(store.checkpoint_path(),
-                                            template)
+        params, restored_step = ckpt.restore_checkpoint(
+            store.checkpoint_path(), template)
+        if restored_step is None:
+            raise RuntimeError(
+                f"training finished but no checkpoint was found at "
+                f"{store.checkpoint_path()} — with a multi-host "
+                f"ProcessBackend the store prefix must be on a shared "
+                f"filesystem (rank 0 writes the checkpoint)")
         return JaxModel(self.model, params, self.loss), metrics

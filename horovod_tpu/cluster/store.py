@@ -77,6 +77,17 @@ def materialize_shards(store, x, y, num_ranks):
         raise ValueError(
             f"need at least one sample per rank ({num_ranks}), "
             f"got {len(x)}")
+    # EQUAL shard lengths: uneven shards would give ranks different
+    # per-epoch step counts, silently pairing gradients from different
+    # optimization steps in the name-matched eager exchange and then
+    # deadlocking on the unpaired remainder
+    even = (len(x) // num_ranks) * num_ranks
+    if even != len(x):
+        from horovod_tpu.utils.logging import get_logger
+        get_logger().warning(
+            "dropping %d trailing sample(s) so every rank gets an "
+            "equal shard (%d each)", len(x) - even, even // num_ranks)
+        x, y = x[:even], y[:even]
     for rank, (xs, ys) in enumerate(
             zip(np.array_split(x, num_ranks),
                 np.array_split(y, num_ranks))):

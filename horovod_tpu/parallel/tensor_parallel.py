@@ -107,7 +107,12 @@ def shard_params(params, mesh, rules=None):
 
 
 def constrain(x, mesh, *spec):
-    """Activation sharding constraint (no-op if mesh lacks the axes)."""
+    """Activation sharding constraint (a true no-op if the mesh lacks
+    every requested axis — mapping absent axes to None would impose a
+    full-replication constraint, overriding GSPMD's propagated sharding
+    and forcing an all-gather of e.g. batch-sharded MoE activations)."""
     mesh_axes = set(mesh.axis_names)
     parts = tuple(a if (a is None or a in mesh_axes) else None for a in spec)
+    if not any(p is not None for p in parts):
+        return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*parts)))

@@ -62,7 +62,12 @@ def save_checkpoint(directory, target, step, keep=3, rank=None):
         f.write(data)
     os.replace(tmp, path)  # atomic publish
     if keep is not None:
-        for old in _steps_in(directory)[:-keep]:
+        # keep == 0 would slice [:-0] == nothing; it means "keep only
+        # the checkpoint just written"
+        steps = _steps_in(directory)
+        old_steps = [s for s in steps if s != step] if keep == 0 \
+            else steps[:-keep]
+        for old in old_steps:
             try:
                 os.remove(_ckpt_path(directory, old))
             except FileNotFoundError:
@@ -95,15 +100,30 @@ def resume_step(directory):
 
     step = latest_step(directory)
     state = basics._state
-    if state is None or (state.config.controller != "tcp" and
-                         getattr(basics._tls, "local_rank", None) is None):
+    multiprocess = state is not None and \
+        state.config.controller in ("tcp", "gmesh")
+    if not multiprocess and (
+            state is None
+            or getattr(basics._tls, "local_rank", None) is None):
         # single-process device mode (or not initialized): the local
-        # filesystem view IS the global view
+        # filesystem view IS the global view.  Multi-process modes
+        # (tcp AND gmesh pods) must broadcast — each host has its own
+        # filesystem view
         return step
-    out = hvd.broadcast(
-        np.asarray([-1 if step is None else step], dtype=np.int64),
-        root_rank=0, name="checkpoint.resume_step")
-    val = int(np.asarray(out)[0])
+
+    def _bcast(_rank=None):
+        out = hvd.broadcast(
+            np.asarray([-1 if step is None else step], dtype=np.int64),
+            root_rank=0, name="checkpoint.resume_step")
+        return int(np.asarray(out)[0])
+
+    if state.config.controller == "gmesh" \
+            and getattr(basics._tls, "local_rank", None) is None:
+        # pod mode from the main thread: every local device rank must
+        # participate in the eager broadcast
+        val = basics.run_parallel(_bcast)[0]
+    else:
+        val = _bcast()
     return None if val < 0 else val
 
 

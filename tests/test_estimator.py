@@ -159,3 +159,34 @@ def test_spark_submodule_import_path_parity():
     assert spark_keras.KerasEstimator is not None
     assert spark_keras.Store is not None
     assert spark_torch.TorchEstimator is not None
+
+
+def test_jax_estimator_integer_label_classification(hvd, tmp_path):
+    """Regression: the default integer-label cross-entropy path crashed
+    at trace time (np.asarray on a tracer); it must train a classifier
+    end-to-end."""
+    from horovod_tpu.models import MLP
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 8).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64) + 2 * (x[:, 1] > 0).astype(np.int64)
+
+    est = JaxEstimator(MLP(features=(32, 4)), epochs=20, batch_size=32,
+                       learning_rate=0.1, store=LocalStore(str(tmp_path)),
+                       backend=InProcessBackend())
+    model, metrics = est.fit(x, y)
+    preds = np.asarray(model.predict(x)).argmax(axis=1)
+    assert (preds == y).mean() > 0.8, (preds == y).mean()
+
+
+def test_materialize_shards_equalizes_lengths(tmp_path):
+    """Regression: uneven shards gave ranks different per-epoch step
+    counts, silently cross-pairing gradients from different steps."""
+    from horovod_tpu.cluster.store import materialize_shards
+
+    store = LocalStore(str(tmp_path))
+    x = np.arange(22, dtype=np.float32).reshape(11, 2)  # 11 over 4 ranks
+    y = np.arange(11, dtype=np.float32)
+    materialize_shards(store, x, y, 4)
+    lengths = {len(store.load_shard(r)["x"]) for r in range(4)}
+    assert lengths == {2}, lengths  # 11 -> 8 kept, 2 per rank
