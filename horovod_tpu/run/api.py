@@ -13,6 +13,7 @@ import sys
 from horovod_tpu.run import allocate as allocate_mod
 from horovod_tpu.run.http_server import RendezvousServer
 from horovod_tpu.run.launch import launch_job
+from horovod_tpu.run.service import secret as secret_mod
 from horovod_tpu.utils import env as env_util
 
 try:
@@ -39,22 +40,41 @@ def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
 
     rendezvous = RendezvousServer()
     port = rendezvous.start()
-
-    payload = _pickler.dumps((fn, args, kwargs))
-    with rendezvous._server.kv_lock:
-        rendezvous._server.kv.setdefault(FN_SCOPE, {})["fn"] = payload
-
-    env = dict(extra_env or {})
-    env.setdefault("HVD_RUN_FUNC", "1")
-    if np > 1:
-        env.setdefault(env_util.HVD_CONTROLLER, "tcp")
-    if use_tpu:
-        env.setdefault("HVD_TPU", "1")
-
-    command = f"{sys.executable} -m horovod_tpu.run.task_runner"
-    code = launch_job(slots, command, "127.0.0.1", port, extra_env=env,
-                      verbose=verbose)
     try:
+        # the KV store is an unauthenticated HTTP server bound on
+        # 0.0.0.0; the pickled-fn and pickled-result channels through it
+        # are HMAC-signed with the job secret so a network peer cannot
+        # inject pickles into the workers or the driver
+        supplied = (extra_env or {}).get(env_util.HVD_SECRET_KEY) \
+            or os.environ.get(env_util.HVD_SECRET_KEY)
+        key = base64.b64decode(supplied) if supplied \
+            else secret_mod.make_secret_key()
+
+        payload = _pickler.dumps((fn, args, kwargs))
+        signed = secret_mod.sign(key, payload) + payload
+        with rendezvous._server.kv_lock:
+            rendezvous._server.kv.setdefault(FN_SCOPE, {})["fn"] = signed
+
+        env = dict(extra_env or {})
+        env.setdefault("HVD_RUN_FUNC", "1")
+        # force-set: workers must hold the SAME key the driver signs with
+        env[env_util.HVD_SECRET_KEY] = base64.b64encode(key).decode()
+        if np > 1:
+            env.setdefault(env_util.HVD_CONTROLLER, "tcp")
+        if use_tpu:
+            env.setdefault("HVD_TPU", "1")
+
+        # remote workers must reach the driver's KV store; honor the
+        # same override + discovery the CLI path uses
+        addr = os.environ.get("HVD_RENDEZVOUS_HOST_ADDR")
+        if addr is None:
+            from horovod_tpu.run.runner import _routable_addr
+
+            addr = _routable_addr(slots)
+
+        command = f"{sys.executable} -m horovod_tpu.run.task_runner"
+        code = launch_job(slots, command, addr, port, extra_env=env,
+                          verbose=verbose)
         if code != 0:
             raise RuntimeError(f"hvdrun job failed with exit code {code}")
         results = []
@@ -62,7 +82,12 @@ def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
             blob = rendezvous.get(RESULT_SCOPE, str(rank))
             if blob is None:
                 raise RuntimeError(f"rank {rank} produced no result")
-            status, value = pickle.loads(blob)
+            digest, payload = (blob[:secret_mod.DIGEST_LEN],
+                               blob[secret_mod.DIGEST_LEN:])
+            if not secret_mod.check(key, payload, digest):
+                raise PermissionError(
+                    f"rank {rank} result failed HMAC verification")
+            status, value = pickle.loads(payload)
             if status == "error":
                 raise RuntimeError(f"rank {rank} failed: {value}")
             results.append(value)

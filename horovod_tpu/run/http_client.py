@@ -5,11 +5,21 @@ import urllib.error
 import urllib.request
 
 
-def put(addr, port, scope, key, value: bytes):
-    req = urllib.request.Request(
-        f"http://{addr}:{port}/{scope}/{key}", data=value, method="PUT")
-    with urllib.request.urlopen(req, timeout=30):
-        pass
+def put(addr, port, scope, key, value: bytes, retry_for=30.0):
+    """PUT with a bounded transient-failure retry: a single TCP blip
+    must not lose a worker's result after hours of training."""
+    deadline = time.monotonic() + retry_for
+    while True:
+        req = urllib.request.Request(
+            f"http://{addr}:{port}/{scope}/{key}", data=value,
+            method="PUT")
+        try:
+            with urllib.request.urlopen(req, timeout=30):
+                return
+        except (urllib.error.URLError, OSError):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.25)
 
 
 def get(addr, port, scope, key, timeout=None):
@@ -27,3 +37,10 @@ def get(addr, port, scope, key, timeout=None):
             if deadline is None or time.monotonic() > deadline:
                 raise KeyError(f"{scope}/{key} not found in rendezvous")
             time.sleep(0.05)
+        except (urllib.error.URLError, OSError):
+            # transient transport blip (driver briefly saturated, TCP
+            # RST): retry within the budget instead of crashing the
+            # worker — a spurious crash tears down the whole job
+            if deadline is None or time.monotonic() > deadline:
+                raise
+            time.sleep(0.25)

@@ -60,39 +60,67 @@ def _ssh_command(slot, command, env, ssh_port=None):
 def launch_job(slots, command, rendezvous_addr, rendezvous_port,
                extra_env=None, ssh_port=None, verbose=False) -> int:
     """Launch one process per slot; kill everything on first failure.
-    Returns the first nonzero exit code (or 0)."""
+    Returns the FIRST failure's exit code (or 0) — after the
+    kill-on-first-failure fan-out, later ranks die with signal codes
+    (-15) that would mask the real error if rank order decided."""
     log = get_logger()
     failure = threading.Event()
-    exit_codes = [0] * len(slots)
+    first_failure = []  # [(rank, code)] — append under the lock, once
+    first_failure_lock = threading.Lock()
 
-    def run_rank(i, slot):
-        env = slot_env(slot, rendezvous_addr, rendezvous_port, extra_env)
-        stdin_data = None
-        if slot.hostname in LOCAL_HOSTS:
-            # local: secrets ride the process env, never a command line
-            full_env = dict(os.environ)
-            full_env.update(env)
-            cmd = command
-        else:
-            full_env = dict(os.environ)
-            cmd, stdin_data = _ssh_command(slot, command, env, ssh_port)
-        if verbose:
-            log.warning("launching rank %d on %s: %s", slot.rank,
-                        slot.hostname, cmd)
-        code = safe_shell_exec.execute(
-            cmd, env=full_env, stdout=sys.stdout, stderr=sys.stderr,
-            events=[failure], stdin_data=stdin_data)
-        exit_codes[i] = code
+    def run_rank(slot):
+        try:
+            env = slot_env(slot, rendezvous_addr, rendezvous_port,
+                           extra_env)
+            stdin_data = None
+            if slot.hostname in LOCAL_HOSTS:
+                # local: secrets ride the process env, never a command
+                # line
+                full_env = dict(os.environ)
+                full_env.update(env)
+                cmd = command
+            else:
+                full_env = dict(os.environ)
+                cmd, stdin_data = _ssh_command(slot, command, env,
+                                               ssh_port)
+            if verbose:
+                log.warning("launching rank %d on %s: %s", slot.rank,
+                            slot.hostname, cmd)
+            code = safe_shell_exec.execute(
+                cmd, env=full_env, stdout=sys.stdout, stderr=sys.stderr,
+                events=[failure], stdin_data=stdin_data)
+        except Exception as exc:  # noqa: BLE001 — a thread dying
+            # silently would record no failure (reported success) while
+            # sibling ranks hang waiting for this one
+            log.error("launching rank %d failed: %s", slot.rank, exc)
+            code = 1
         if code != 0:
+            with first_failure_lock:
+                if not first_failure:
+                    first_failure.append((slot.rank, code))
             failure.set()
 
-    threads = [threading.Thread(target=run_rank, args=(i, s), daemon=True)
-               for i, s in enumerate(slots)]
+    threads = [threading.Thread(target=run_rank, args=(s,), daemon=True)
+               for s in slots]
     for t in threads:
         t.start()
-    for t in threads:
-        t.join()
-    for code in exit_codes:
-        if code != 0:
-            return code
+    try:
+        for t in threads:
+            t.join()
+    except KeyboardInterrupt:
+        # the interrupt lands HERE (main thread), not in the launcher
+        # threads — without this, the driver exits and every child
+        # (started in its own session, so it never sees the terminal's
+        # SIGINT) keeps running, holding chips and ports
+        log.warning("interrupted: terminating all ranks")
+        failure.set()
+        for t in threads:
+            t.join(timeout=15)
+        raise
+
+    if first_failure:
+        rank, code = first_failure[0]
+        log.error("rank %d failed first with exit code %d "
+                  "(other ranks were terminated)", rank, code)
+        return code
     return 0

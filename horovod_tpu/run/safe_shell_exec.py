@@ -51,7 +51,6 @@ def execute(command, env=None, stdout=None, stderr=None,
     remote command line).
     Returns the exit code.
     """
-    import sys
 
     shell = isinstance(command, str)
     proc = subprocess.Popen(
@@ -99,5 +98,4 @@ def execute(command, env=None, stdout=None, stderr=None,
         stop_watch.set()
     for t in forwarders:
         t.join(timeout=5)
-    del sys
     return proc.returncode
