@@ -120,6 +120,12 @@ class MixedC(nn.Module):  # 8x8 blocks, expanded filter bank
 
 
 class InceptionV3(nn.Module):
+    """Canonical Inception V3 topology WITHOUT the auxiliary classifier
+    head — matching tf_cnn_benchmarks (the reference's benchmark
+    vehicle, ``docs/benchmarks.rst``), which also omits AuxLogits;
+    torchvision's aux_logits=True training configuration has ~1-2%
+    more FLOPs."""
+
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
     dropout_rate: float = 0.5

@@ -191,7 +191,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                                # [bq, bk]
+        # fully-masked rows carry lse = m = NEG_INF; exp(s - lse)
+        # there would be exp(0) = 1 per entry — mirror the
+        # forward's guard so such rows contribute zero gradient
+        p = jnp.where(lse > _NEG_INF / 2,
+                      jnp.exp(s - lse), 0.0)              # [bq, bk]
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -233,7 +237,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                                # [bq, bk]
+        # fully-masked rows carry lse = m = NEG_INF; exp(s - lse)
+        # there would be exp(0) = 1 per entry — mirror the
+        # forward's guard so such rows contribute zero gradient
+        p = jnp.where(lse > _NEG_INF / 2,
+                      jnp.exp(s - lse), 0.0)              # [bq, bk]
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # [bk, d]
