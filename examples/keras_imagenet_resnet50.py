@@ -56,9 +56,9 @@ def main():
         # (reference: keras_imagenet_resnet50.py resume pattern)
         model = hvd.load_model(ckpt_tmpl.format(epoch=resume_epoch))
     else:
-        # without warmup the scaled LR applies from step 0; with it the
-        # warmup callback ramps base_lr -> base_lr * size
-        lr = args.base_lr * (hvd.size() if args.warmup_epochs == 0 else 1)
+        # reference recipe: compile with the size-scaled LR; warmup
+        # (when enabled) ramps from base_lr up to it
+        lr = args.base_lr * hvd.size()
         model = keras.applications.ResNet50(
             weights=None, classes=args.num_classes,
             input_shape=(args.img, args.img, 3))
@@ -73,8 +73,9 @@ def main():
         hvd.callbacks.MetricAverageCallback(),
     ]
     if args.warmup_epochs > 0:
+        # initial_lr omitted: the callback reads the COMPILED
+        # (size-scaled) target and ramps from target/size up to it
         callbacks.append(hvd.callbacks.LearningRateWarmupCallback(
-            initial_lr=args.base_lr,
             warmup_epochs=args.warmup_epochs,
             steps_per_epoch=max(args.num_samples // args.batch_size, 1)))
     if hvd.rank() == 0:

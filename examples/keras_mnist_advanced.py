@@ -56,9 +56,11 @@ def main(epochs=4, batch=128, base_lr=0.01, warmup_epochs=2,
         keras.layers.Dense(10, activation="softmax"),
     ])
 
-    # reference recipe: scale LR by world size, warm up to it, decay
+    # reference recipe: COMPILE with the size-scaled LR; the warmup
+    # callback ramps from base_lr up to it, the schedule decays later
     opt = hvd.DistributedOptimizer(
-        keras.optimizers.SGD(learning_rate=base_lr, momentum=0.9))
+        keras.optimizers.SGD(learning_rate=base_lr * hvd.size(),
+                             momentum=0.9))
     model.compile(optimizer=opt,
                   loss="sparse_categorical_crossentropy",
                   metrics=["accuracy"], run_eagerly=True)

@@ -36,7 +36,9 @@ def main():
     ])
     model.compile(
         optimizer=hvd.DistributedOptimizer(
-            keras.optimizers.Adam(args.lr)),
+            # reference recipe: compile with the size-scaled
+            # LR; the warmup callback ramps up to it
+            keras.optimizers.Adam(args.lr * hvd.size())),
         loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
         metrics=["accuracy"],
         run_eagerly=True,  # the data plane crosses into numpy per step

@@ -246,7 +246,10 @@ def local_device():
     must use the chips)."""
     state = _get_state()
     devices = state.executor.devices
-    return devices[rank() % len(devices)]
+    # the within-host index, NOT the global rank: with non-block rank
+    # placement rank() % len(devices) can double-book one chip and
+    # leave another idle
+    return devices[local_rank() % len(devices)]
 
 
 def run_parallel(fn, num_ranks=None):

@@ -64,8 +64,10 @@ def _mpi_placed() -> "Topology | None":
                                     os.environ.get("MPI_LOCALRANKID", 0)))
     local_size = int(os.environ.get("OMPI_COMM_WORLD_LOCAL_SIZE",
                                     os.environ.get("MPI_LOCALNRANKS", 1)))
-    # uniform-slots assumption for the derived cross axis (the ssh path
-    # computes exact values; heterogeneous MPI jobs should set HVD_*)
+    # uniform-slots + BLOCK placement assumption for the derived cross
+    # axis (mpirun's default --map-by core/slot fills hosts in rank
+    # blocks; --map-by node round-robins ranks and breaks this
+    # derivation — such jobs should export the HVD_* contract instead)
     cross_size = max(size // max(local_size, 1), 1)
     return Topology(rank, size, local_rank, local_size,
                     cross_rank=rank // max(local_size, 1),

@@ -59,6 +59,13 @@ def DistributedOptimizer(optimizer, name=None, op=Average,
 def broadcast_global_variables(model_or_variables, root_rank=0):
     """Sync weights from ``root_rank`` (reference:
     ``keras/__init__.py`` broadcast_global_variables)."""
+    if isinstance(model_or_variables, int):
+        raise TypeError(
+            "TF2 has no global-variable collection: pass the model (or "
+            "its variables) explicitly, e.g. "
+            "broadcast_global_variables(model, root_rank=0) — the "
+            "reference's broadcast_global_variables(root_rank) signature "
+            "is TF1-only")
     _require_keras()
     from horovod_tpu import tensorflow as hvd_tf
 
@@ -89,6 +96,11 @@ def load_model(filepath, custom_objects=None, compression=None,
             cls = _make_distributed_class(obj, compression=compression,
                                           sparse_as_dense=sparse_as_dense)
             custom.setdefault(cls.__name__, cls)
+            # ALSO under the plain class name: a model saved with an
+            # unwrapped optimizer then deserializes its slot variables
+            # and iteration count directly INTO the wrapped class —
+            # re-wrapping after the fact would reset that state
+            custom.setdefault(obj.__name__, cls)
     model = _keras.models.load_model(filepath, custom_objects=custom)
     if getattr(model, "optimizer", None) is not None and not getattr(
             model.optimizer, "_hvd_wrapped", False):
@@ -137,8 +149,10 @@ if _keras is not None:
                         continue
 
     class LearningRateWarmupCallback(_keras.callbacks.Callback):
-        """Epoch-based warmup from the single-worker LR to the
-        size-scaled LR (reference: ``_keras/callbacks.py:172``)."""
+        """Reference warmup convention (``_keras/callbacks.py:172``):
+        the COMPILED learning rate is the already-size-scaled target;
+        warmup ramps from initial_lr/size up to initial_lr.  (Compile
+        with ``lr = base_lr * hvd.size()`` per the horovod recipe.)"""
 
         def __init__(self, initial_lr=None, warmup_epochs=5,
                      momentum_correction=True, steps_per_epoch=None,
@@ -171,15 +185,15 @@ if _keras is not None:
                     / self.warmup_epochs
             else:
                 progress = (self._epoch + 1) / self.warmup_epochs
-            scale = 1.0 + progress * (_basics.size() - 1.0)
+            size = _basics.size()
+            scale = (1.0 + progress * (size - 1.0)) / size
             self._set_lr(self.initial_lr * scale)
 
         def on_epoch_end(self, epoch, logs=None):
             if epoch + 1 == self.warmup_epochs:
-                self._set_lr(self.initial_lr * _basics.size())
+                self._set_lr(self.initial_lr)
                 if self.verbose and _basics.rank() == 0:
-                    print(f"Warmup complete: lr = "
-                          f"{self.initial_lr * _basics.size()}")
+                    print(f"Warmup complete: lr = {self.initial_lr}")
 
     class LearningRateScheduleCallback(_keras.callbacks.Callback):
         """Multiplier schedule vs the initial LR (reference:

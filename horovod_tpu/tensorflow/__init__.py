@@ -66,8 +66,26 @@ def _graph_bridge(fn, tensor, out_dtype, out_shape=None):
     ``tf.py_function`` (the reference uses registered custom ops for
     graph mode, ``tensorflow/mpi_ops.cc``; the py_function node plays
     that role here — it executes the eager data-plane call at step time
-    with a trace-stable name)."""
-    out = _tf.py_function(fn, [tensor], Tout=out_dtype)
+    with a trace-stable name).
+
+    The py_function body runs on a TF executor thread, NOT the thread
+    that traced it — so the tracing thread's rank context
+    (``basics._tls``, set by ``run_parallel``) is captured here and
+    re-entered around the eager call, or device-rank collectives would
+    see no rank and fail (or all commit as rank 0)."""
+    captured_rank = getattr(_basics._tls, "local_rank", None)
+
+    def body(t):
+        if captured_rank is None:
+            return fn(t)
+        previous = getattr(_basics._tls, "local_rank", None)
+        _basics._tls.local_rank = captured_rank
+        try:
+            return fn(t)
+        finally:
+            _basics._tls.local_rank = previous
+
+    out = _tf.py_function(body, [tensor], Tout=out_dtype)
     if out_shape is not None:
         out.set_shape(out_shape)
     return out
@@ -100,23 +118,60 @@ def allreduce(tensor, average=None, name=None, op=None,
         if resolved == Adasum:
             raise NotImplementedError(
                 "Adasum is not supported for tf.IndexedSlices")
-        values = allgather(tensor.values,
+        values = tensor.values
+        if prescale_factor != 1.0:
+            values = values * _tf.cast(prescale_factor, values.dtype)
+        values = allgather(values,
                            name=f"{name}.values" if name else None)
         indices = allgather(tensor.indices,
                             name=f"{name}.indices" if name else None)
         if resolved == Average:
             values = values / size()
+        if postscale_factor != 1.0:
+            values = values * _tf.cast(postscale_factor, values.dtype)
         return _tf.IndexedSlices(values, indices,
                                  dense_shape=tensor.dense_shape)
 
     from horovod_tpu.tensorflow.compression import Compression
     comp = compression or Compression.none
     tensor = _tf.convert_to_tensor(tensor)
-    compressed, ctx = comp.compress(tensor)
-    out = _eager.allreduce(
-        compressed.numpy(), average=average, name=name, op=op,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
-    return comp.decompress(_to_tf(out, compressed.dtype), ctx)
+
+    # custom gradient so code differentiating THROUGH the allreduce
+    # keeps a connected tape (the numpy round trip would sever it);
+    # reference: tf.RegisterGradient("HorovodAllreduce") = allreduce of
+    # the upstream gradient with the same op (mpi_ops.py:111)
+    @_tf.custom_gradient
+    def _allreduce_diff(t):
+        compressed, ctx = comp.compress(t)
+        out = _eager.allreduce(
+            compressed.numpy(), average=average, name=name, op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+        out = comp.decompress(_to_tf(out, compressed.dtype), ctx)
+        # the forward body runs WITH the rank context (directly on the
+        # rank thread, or re-entered by _graph_bridge); the grad closure
+        # fires later on whatever thread runs the backward — carry the
+        # context along
+        captured_rank = getattr(_basics._tls, "local_rank", None)
+
+        def grad(dy):
+            gname = f"{name}.grad" if name else None
+            previous = getattr(_basics._tls, "local_rank", None)
+            if captured_rank is not None:
+                _basics._tls.local_rank = captured_rank
+            try:
+                g = _eager.allreduce(
+                    dy.numpy(), average=average, name=gname, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)
+            finally:
+                if captured_rank is not None:
+                    _basics._tls.local_rank = previous
+            return _to_tf(g, dy.dtype)
+
+        return out, grad
+
+    return _allreduce_diff(tensor)
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None):
@@ -124,9 +179,22 @@ def grouped_allreduce(tensors, average=None, name=None, op=None):
     base = name or "tf_grouped"
     tensors = [_tf.convert_to_tensor(t) for t in tensors]
     if not _tf.executing_eagerly():
+        # same executor-thread context capture as _graph_bridge
+        captured_rank = getattr(_basics._tls, "local_rank", None)
+
+        def body(*ts):
+            previous = getattr(_basics._tls, "local_rank", None)
+            if captured_rank is not None:
+                _basics._tls.local_rank = captured_rank
+            try:
+                return grouped_allreduce(list(ts), average=average,
+                                         name=base, op=op)
+            finally:
+                if captured_rank is not None:
+                    _basics._tls.local_rank = previous
+
         outs = _tf.py_function(
-            lambda *ts: grouped_allreduce(list(ts), average=average,
-                                          name=base, op=op),
+            body,
             tensors, Tout=[t.dtype for t in tensors])
         for out, t in zip(outs, tensors):
             out.set_shape(t.shape)
@@ -217,7 +285,7 @@ class _DistributedGradientTape:
         self.__dict__["_prescale"] = prescale_factor
         self.__dict__["_postscale"] = postscale_factor
         self.__dict__["_sparse_as_dense"] = sparse_as_dense
-        self.__dict__["_counter"] = 0
+
 
     def __enter__(self):
         self._tape.__enter__()
@@ -231,12 +299,15 @@ class _DistributedGradientTape:
 
     def gradient(self, target, sources, output_gradients=None):
         gradients = self._tape.gradient(target, sources, output_gradients)
-        self.__dict__["_counter"] += 1
+        # a STABLE prefix: per-call counters freeze at trace time inside
+        # tf.function, so ranks that retrace a different number of times
+        # would submit mismatched names (hang or cross-step pairing);
+        # collectives are synchronous, so steady-state name reuse is safe
         return _allreduce_grads(
             gradients, op=self._op, compression=self._compression,
             prescale_factor=self._prescale,
             postscale_factor=self._postscale,
-            name_prefix=f"tape{self._counter}",
+            name_prefix="tape",
             sparse_as_dense=self._sparse_as_dense)
 
 
@@ -305,7 +376,7 @@ def _make_distributed_class(base_cls, name=None, op=Average,
             grads = [g for g, _ in grads_and_vars]
             hvariables = [v for _, v in grads_and_vars]
             state = self.__dict__.setdefault(
-                "_hvd_state", {"count": 0, "acc": None, "rounds": 0})
+                "_hvd_state", {"count": 0, "acc": None})
             if backward_passes_per_step > 1:
                 dense = [
                     _tf.convert_to_tensor(g) if g is not None else None
@@ -323,12 +394,14 @@ def _make_distributed_class(base_cls, name=None, op=Average,
                 grads, state["acc"] = state["acc"], None
                 grads = [g / backward_passes_per_step
                          if g is not None else None for g in grads]
-            state["rounds"] += 1
+            # stable name prefix (no per-round counter): see the tape
+            # wrapper — retrace-count skew across ranks must not shift
+            # collective names
             reduced = _allreduce_grads(
                 grads, op=op, compression=compression,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
-                name_prefix=f"opt.{name or 'grad'}.{state['rounds']}",
+                name_prefix=f"opt.{name or 'grad'}",
                 sparse_as_dense=sparse_as_dense)
             return super().apply_gradients(
                 zip(reduced, hvariables), *args, **kwargs)

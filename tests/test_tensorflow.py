@@ -217,9 +217,10 @@ hist = model.fit(x, y, batch_size=8, epochs=3, verbose=0, callbacks=cbs)
 losses = hist.history["loss"]
 assert losses[-1] < losses[0], losses
 
-# after warmup the LR is scaled by size
+# reference convention: the COMPILED lr is the scaled target; warmup
+# ramps from lr/size back UP to it (reference _keras/callbacks.py:172)
 lr = float(model.optimizer.learning_rate.numpy())
-np.testing.assert_allclose(lr, 0.05 * n, rtol=1e-5)
+np.testing.assert_allclose(lr, 0.05, rtol=1e-5)
 
 # weights identical across ranks after distributed fit
 digest = float(sum(np.sum(v.numpy().astype(np.float64))
@@ -307,3 +308,46 @@ def test_sparse_as_dense_2proc():
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-4000:]}"
     assert result.stdout.count("SPARSE_AS_DENSE_OK") == 2
+
+
+GRAD_THROUGH_WORKER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import tensorflow as tf
+import horovod_tpu.tensorflow as hvd
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+# differentiating THROUGH hvd.allreduce must keep a connected tape
+# (reference registers a gradient: allreduce of the upstream grad)
+v = tf.Variable([2.0, 3.0])
+with tf.GradientTape() as tape:
+    avg = hvd.allreduce(v * (r + 1.0), op=hvd.Average, name="thru")
+    loss = tf.reduce_sum(avg)
+g = tape.gradient(loss, v)
+assert g is not None, "gradient severed through allreduce"
+# reference semantics: grad of allreduce = allreduce(upstream grad);
+# upstream is ones -> averaged ones -> chain through the local factor
+expect = r + 1.0
+np.testing.assert_allclose(g.numpy(), np.full((2,), expect), rtol=1e-6)
+
+# sparse path honors prescale/postscale
+slices = tf.IndexedSlices(
+    values=tf.fill([1, 2], 4.0),
+    indices=tf.constant([r], dtype=tf.int64),
+    dense_shape=tf.constant([2, 2], dtype=tf.int64))
+out = hvd.allreduce(slices, op=hvd.Sum, name="sp",
+                    prescale_factor=0.5, postscale_factor=0.25)
+np.testing.assert_allclose(out.values.numpy(), np.full((2, 2), 0.5))
+print(f"rank {r} TF_GRAD_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_tf_gradient_through_allreduce_and_sparse_scaling():
+    result = _run_hvdrun(2, GRAD_THROUGH_WORKER)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-4000:]}"
+    assert result.stdout.count("TF_GRAD_OK") == 2
