@@ -73,9 +73,12 @@ def main():
         hvd.callbacks.MetricAverageCallback(),
     ]
     if args.warmup_epochs > 0:
-        # initial_lr omitted: the callback reads the COMPILED
-        # (size-scaled) target and ramps from target/size up to it
+        # explicit UNIFORM target (= the compiled scaled LR): on resume
+        # only rank 0 loads the checkpoint, whose optimizer carries a
+        # mid-warmup LR — reading the target from each rank's compiled
+        # optimizer would diverge the per-rank step sizes
         callbacks.append(hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=args.base_lr * hvd.size(),
             warmup_epochs=args.warmup_epochs,
             steps_per_epoch=max(args.num_samples // args.batch_size, 1)))
     if hvd.rank() == 0:

@@ -43,8 +43,16 @@ class GaussianProcess:
                                           noise_variance)
 
     def fit(self, x, y):
-        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        x = np.asarray(x, dtype=np.float64)
+        if x.ndim == 1:
+            # n one-dimensional samples — np.atleast_2d would instead
+            # produce ONE n-dimensional sample (1, n) and silently fit
+            # a garbage model against a length-mismatched y
+            x = x[:, None]
         y = np.asarray(y, dtype=np.float64)
+        if len(y) != x.shape[0]:
+            raise ValueError(
+                f"x has {x.shape[0]} samples but y has {len(y)}")
         xa, xp = _as_dbl(x)
         ya, yp = _as_dbl(y)
         rc = self._lib.hvd_gp_fit(self._h, xp, yp, x.shape[0], x.shape[1])

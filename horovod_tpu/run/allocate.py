@@ -69,6 +69,25 @@ def allocate(hosts, np_total: int):
     """Assign ``np_total`` ranks round-filling hosts in order; returns one
     SlotInfo per rank (reference: _allocate fills each host's slots before
     moving on)."""
+    # coalesce duplicate hostnames (their slots add up) and drop
+    # zero-slot entries: bookkeeping below keys by hostname, so
+    # duplicates would double-bind local_ranks to one device, and a
+    # drained 0-slot host would become a phantom cross-peer that no
+    # process owns (hanging cross collectives)
+    merged = {}
+    order = []
+    for h in hosts:
+        if h.slots <= 0:
+            continue
+        if h.hostname in merged:
+            merged[h.hostname] = HostInfo(
+                h.hostname, merged[h.hostname].slots + h.slots)
+        else:
+            merged[h.hostname] = h
+            order.append(h.hostname)
+    hosts = [merged[name] for name in order]
+    if not hosts:
+        raise ValueError("no hosts with available slots")
     capacity = sum(h.slots for h in hosts)
     if np_total > capacity:
         raise ValueError(

@@ -66,7 +66,22 @@ def _parse_simple_yaml(path):
     section = None
     with open(path) as f:
         for raw in f:
-            line = raw.split("#", 1)[0].rstrip()
+            # strip comments only when the ' #' occurs OUTSIDE quotes
+            # ('/tmp/run#3' and "a #3" keep their hashes)
+            line = raw.rstrip("\n")
+            if line.lstrip().startswith("#"):
+                continue
+            in_quote = None
+            for i, ch in enumerate(line):
+                if in_quote:
+                    if ch == in_quote:
+                        in_quote = None
+                elif ch in "'\"":
+                    in_quote = ch
+                elif ch == "#" and i > 0 and line[i - 1] == " ":
+                    line = line[:i]
+                    break
+            line = line.rstrip()
             if not line.strip():
                 continue
             indented = line.startswith((" ", "\t"))
@@ -81,6 +96,8 @@ def _parse_simple_yaml(path):
 
 
 def _coerce(value: str):
+    if len(value) >= 2 and value[0] == value[-1] and value[0] in "'\"":
+        return value[1:-1]  # quoted string: verbatim (like PyYAML)
     low = value.lower()
     if low in ("true", "yes", "on"):
         return True
@@ -95,9 +112,15 @@ def _coerce(value: str):
 
 
 def apply_config_to_args(args, config: dict):
-    """File values fill in args the CLI left at default (None)."""
+    """File values fill in args the CLI left at default (None).
+
+    Identity comparison, not equality: an EXPLICIT ``--flag 0`` /
+    ``0.0`` compares equal to False and would be silently overridden by
+    the file, violating CLI-over-file precedence.  (Store-true flags
+    use ``default=None`` in the parser, so ``False`` never appears as a
+    default here; ``None`` is the only unset sentinel.)"""
     for key, value in config.items():
-        if getattr(args, key, None) in (None, False):
+        if getattr(args, key, None) is None:
             setattr(args, key, value)
 
 

@@ -99,6 +99,10 @@ def allreduce(tensor, average=None, name=None, op=None,
     IndexedSlices follow the reference's sparse path
     (``mpi_ops.py:111-144``): values/indices are allgathered instead of
     densified, and Average divides the gathered values by size.
+    Differentiating THROUGH the sparse path is not supported (the dense
+    path carries a custom gradient; sparse gradients normally arrive
+    FROM the tape, not inside it — use ``sparse_as_dense=True`` if a
+    connected tape through an IndexedSlices allreduce is required).
 
     Works in eager mode and inside ``tf.function`` (via a py_function
     bridge node).

@@ -62,6 +62,7 @@ class Timeline:
 
     def __init__(self, path=None, mark_cycles=False):
         self._writer = TimelineWriter(path) if path else None
+        self._closed = False
         self._mark_cycles = mark_cycles
         self._lock = threading.Lock()
         self._pids = {}
@@ -79,7 +80,7 @@ class Timeline:
 
     @property
     def enabled(self):
-        return self._writer is not None
+        return self._writer is not None and not self._closed
 
     def _ts(self):
         return int((time.monotonic() - self._start) * 1e6)
@@ -130,9 +131,13 @@ class Timeline:
                                   "pid": pid, "tid": 0, "s": "g"})
 
     def close(self):
-        if self._writer:
-            self._writer.close()
-            self._writer = None
+        # a recorder thread may have passed its `enabled` check already;
+        # keep the writer object reachable (enqueue after close is a
+        # no-op) instead of nulling it under their feet
+        writer = self._writer
+        if writer:
+            self._closed = True
+            writer.close()
 
 
 def publish_and_merge(rank, size, base_path, timeline, scope="timeline"):
@@ -160,8 +165,14 @@ def publish_and_merge(rank, size, base_path, timeline, scope="timeline"):
         content = "[]"
     try:
         http_client.put(addr, port, scope, str(rank), content.encode())
-    except OSError:
-        return
+    except OSError as exc:
+        from horovod_tpu.utils.logging import get_logger as _gl
+
+        _gl().warning("timeline publish failed for rank %d: %s", rank, exc)
+        if rank != 0:
+            return
+        # rank 0 already holds its own content — the merge of every
+        # OTHER rank's trace does not depend on this upload
     if rank == 0:
         contents = {0: content}
         for r in range(1, size):
@@ -191,6 +202,12 @@ def merge_timeline_contents(contents, out_path):
         try:
             events = json.loads(contents[rank])
         except json.JSONDecodeError:
+            from horovod_tpu.utils.logging import get_logger as _gl
+
+            _gl().warning(
+                "timeline merge: rank %d trace is not valid JSON "
+                "(truncated flush?) — omitted from the merged view",
+                rank)
             continue
         parsed[rank] = events
         for event in events:

@@ -194,3 +194,37 @@ def test_run_fn_two_processes_no_collectives():
 
     results = run(_train_fn, args=(5,), np=2, extra_env=_TESTS_ENV)
     assert results == [(0, 10), (1, 10)]
+
+
+def test_allocate_merges_duplicate_hosts_and_drops_zero_slots():
+    """Regression: duplicate hostnames collapsed the bookkeeping
+    (double-bound local ranks, skipped cross indices) and 0-slot hosts
+    became phantom cross-peers."""
+    slots = alloc.allocate(
+        [alloc.HostInfo("drained", 0), alloc.HostInfo("h1", 2),
+         alloc.HostInfo("h1", 2)], 4)
+    assert [s.hostname for s in slots] == ["h1"] * 4
+    assert [s.local_rank for s in slots] == [0, 1, 2, 3]
+    assert all(s.local_size == 4 for s in slots)
+    assert all(s.cross_rank == 0 and s.cross_size == 1 for s in slots)
+
+
+def test_config_explicit_zero_cli_beats_file(tmp_path):
+    """Regression: an explicit --fusion-threshold-mb 0 compared equal
+    to False and was overridden by the config file."""
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("params:\n  fusion_threshold_mb: 64\n")
+    from horovod_tpu.run.runner import make_parser
+
+    args = make_parser().parse_args(
+        ["-np", "1", "--fusion-threshold-mb", "0", "python", "t.py"])
+    config_parser.apply_config_to_args(
+        args, config_parser.load_config_file(str(cfg)))
+    assert args.fusion_threshold_mb == 0.0
+
+
+def test_fallback_yaml_keeps_hash_in_values(tmp_path):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("timeline:\n  filename: /tmp/run#3/t.json  # note\n")
+    tree = config_parser._parse_simple_yaml(str(cfg))
+    assert tree["timeline"]["filename"] == "/tmp/run#3/t.json"
