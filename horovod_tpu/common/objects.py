@@ -13,21 +13,29 @@ from horovod_tpu.ops import eager
 def broadcast_object(obj, root_rank=0, name=None):
     """Broadcast an arbitrary picklable object from ``root_rank``.
 
-    Two eager broadcasts: an int64 length, then the uint8 payload —
+    Two eager broadcasts: the payload length as two int32 halves
+    (int64 would narrow under jax_enable_x64=False), then the uint8
+    payload —
     every rank must call this collectively (same contract as the
     reference's torch/TF flavors, which this single implementation
     backs)."""
     name = name or "bcast_object"
+    # The length rides the eager plane, where jax_enable_x64=False
+    # silently narrows int64 to int32 — a >= 2 GiB payload would wrap.
+    # Split it into two non-negative int32 halves instead (31 bits each,
+    # 62-bit range), which survive any narrowing.
     if basics.rank() == root_rank:
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-        length = np.array([payload.size], dtype=np.int64)
+        length = np.array([payload.size & 0x7FFFFFFF,
+                           payload.size >> 31], dtype=np.int32)
     else:
         payload = None
-        length = np.zeros((1,), dtype=np.int64)
+        length = np.zeros((2,), dtype=np.int32)
     length = np.asarray(eager.synchronize(eager.broadcast_async(
         length, root_rank, name=f"{name}.len")))
     if payload is None:
-        payload = np.zeros((int(length[0]),), dtype=np.uint8)
+        size = (int(length[1]) << 31) | int(length[0])
+        payload = np.zeros((size,), dtype=np.uint8)
     out = np.asarray(eager.synchronize(eager.broadcast_async(
         payload, root_rank, name=f"{name}.data")))
     return pickle.loads(out.tobytes())

@@ -60,6 +60,9 @@ def _mpi_placed() -> "Topology | None":
     if rank is None or size is None:
         return None
     rank, size = int(rank), int(size)
+    placed = _from_host_slots(rank, size)
+    if placed is not None:
+        return placed
     local_rank = int(os.environ.get("OMPI_COMM_WORLD_LOCAL_RANK",
                                     os.environ.get("MPI_LOCALRANKID", 0)))
     local_size = int(os.environ.get("OMPI_COMM_WORLD_LOCAL_SIZE",
@@ -67,11 +70,44 @@ def _mpi_placed() -> "Topology | None":
     # uniform-slots + BLOCK placement assumption for the derived cross
     # axis (mpirun's default --map-by core/slot fills hosts in rank
     # blocks; --map-by node round-robins ranks and breaks this
-    # derivation — such jobs should export the HVD_* contract instead)
+    # derivation).  The delegation drivers export HVD_HOST_SLOTS (the
+    # exact rank-block layout, handled above) precisely so non-uniform
+    # allocations — e.g. jsrun's trimmed last host — never reach this
+    # fallback; it remains for scripts run under bare mpirun.
     cross_size = max(size // max(local_size, 1), 1)
     return Topology(rank, size, local_rank, local_size,
                     cross_rank=rank // max(local_size, 1),
                     cross_size=cross_size, mode="process")
+
+
+def _from_host_slots(rank, size) -> "Topology | None":
+    """Exact per-rank placement from the ``HVD_HOST_SLOTS`` layout the
+    mpirun/jsrun delegation drivers export (``run/runner.py``,
+    ``run/js_run.py``): ``"h1:n1,h2:n2"``, host-major in rank-block
+    order — the order both the jsrun rankfile and ``mpirun -H
+    --map-by slot`` place ranks in.  Correct even when hosts carry
+    unequal slot counts, where the MPI-local-vars derivation above
+    would give ranks on the short host a different cross_size."""
+    spec = os.environ.get(env_util.HVD_HOST_SLOTS)
+    if not spec:
+        return None
+    counts = []
+    for part in spec.split(","):
+        host, _, n = part.rpartition(":")
+        if not host or not n.isdigit():
+            return None
+        counts.append(int(n))
+    if sum(counts) != size:
+        return None  # stale/foreign layout: fall back to MPI vars
+    base = 0
+    for cross_rank, n in enumerate(counts):
+        if rank < base + n:
+            return Topology(rank, size,
+                            local_rank=rank - base, local_size=n,
+                            cross_rank=cross_rank,
+                            cross_size=len(counts), mode="process")
+        base += n
+    return None
 
 
 def from_env() -> "Topology | None":

@@ -67,6 +67,17 @@ def _flatten_rows(x, fill=0.0, pad_multiple=8):
     return x2, n
 
 
+def _pick_block_n(n, d, slabs=1):
+    """Row-block size for the row-blocked kernels (layer_norm,
+    softmax_xent): keep the kernel's [block_n, d] fp32 slabs well under
+    VMEM; ``slabs`` counts how many the kernel holds at once."""
+    budget = max((4 << 20) // (d * 4 * slabs), 8)
+    for cand in (256, 128, 64, 32, 16, 8):
+        if cand <= budget and n % cand == 0:
+            return cand
+    return 8  # callers pad the row count to a multiple of 8 first
+
+
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying the varying-manual-axes of ``like`` so the
     kernel composes with new-style shard_map (check_vma=True)."""

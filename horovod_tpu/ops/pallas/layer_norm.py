@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from horovod_tpu.ops.pallas.flash_attention import (_default_interpret,
-                                                    _flatten_rows, _sds,
+                                                    _flatten_rows,
+                                                    _pick_block_n, _sds,
                                                     _vmem_spec)
 
 
@@ -76,18 +77,11 @@ def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
     db_ref[...] += jnp.sum(dy, axis=0, keepdims=True).astype(db_ref.dtype)
 
 
-def _pick_block_n(n):
-    for cand in (256, 128, 64, 32, 16, 8):
-        if n % cand == 0:
-            return cand
-    return 8  # callers pad the row count to a multiple of 8 first
-
-
 def _call_fwd(x2, gamma, beta, eps, interpret, with_stats):
     """One pallas_call builder for both forwards; ``with_stats`` adds
     the mean/rstd residual outputs the VJP needs."""
     np_, d = x2.shape
-    block_n = _pick_block_n(np_)
+    block_n = _pick_block_n(np_, d, slabs=2)
     grid = (np_ // block_n,)
     out_specs = [_vmem_spec((block_n, d), lambda i: (i, 0))]
     out_shape = [_sds((np_, d), x2.dtype, x2)]
@@ -148,7 +142,7 @@ def _ln_bwd(eps, interpret, residuals, dout):
         # dgamma/dbeta accumulation and their dx is sliced off below
         dy2 = jnp.concatenate(
             [dy2, jnp.zeros((np_ - n, d), dy2.dtype)], axis=0)
-    block_n = _pick_block_n(np_)
+    block_n = _pick_block_n(np_, d, slabs=3)
     grid = (np_ // block_n,)
 
     dx, dg, db = pl.pallas_call(

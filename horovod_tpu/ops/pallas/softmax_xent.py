@@ -27,7 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from horovod_tpu.ops.pallas.flash_attention import (_default_interpret,
-                                                    _flatten_rows, _sds,
+                                                    _flatten_rows,
+                                                    _pick_block_n, _sds,
                                                     _vmem_spec)
 
 _VCHUNK = 2048  # vocab streamed in chunks of this many columns
@@ -86,16 +87,6 @@ def _pick_vchunk(v):
         if v % cand == 0:
             return cand
     return v  # small/odd vocab: single chunk
-
-
-def _pick_block_n(n, v, slabs=1):
-    # keep the kernel's [block_n, v] fp32 slabs well under VMEM;
-    # ``slabs`` counts how many the kernel holds (bwd: x + dx = 2)
-    budget = max((4 << 20) // (v * 4 * slabs), 8)
-    for cand in (256, 128, 64, 32, 16, 8):
-        if cand <= budget and n % cand == 0:
-            return cand
-    return 8
 
 
 def _rows(logits, labels):

@@ -73,13 +73,20 @@ def js_run(num_proc, command, env=None, extra_args=None):
         raise RuntimeError(
             "jsrun delegation requires an LSF job (LSB_JOBID) with "
             "jsrun on PATH")
-    rankfile = generate_rankfile(
-        _trim_allocation(lsf.get_slots_per_host(), num_proc))
+    trimmed = _trim_allocation(lsf.get_slots_per_host(), num_proc)
+    rankfile = generate_rankfile(trimmed)
     argv = build_jsrun_command(num_proc, command, rankfile=rankfile,
                                extra_args=extra_args)
     get_logger().info("jsrun delegation: %s", " ".join(argv))
+    run_env = dict(env or os.environ)
+    # the rankfile is the authoritative rank-block layout (the trimmed
+    # last host may carry fewer ranks); export it so every worker
+    # derives the same cross_rank/cross_size (topology._from_host_slots)
+    from horovod_tpu.utils import env as env_util
+    run_env[env_util.HVD_HOST_SLOTS] = ",".join(
+        f"{h}:{n}" for h, n in trimmed.items())
     try:
-        return subprocess.call(argv, env=dict(env or os.environ))
+        return subprocess.call(argv, env=run_env)
     finally:
         try:
             os.unlink(rankfile)

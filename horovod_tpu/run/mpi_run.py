@@ -12,6 +12,7 @@ import os
 import shutil
 import subprocess
 
+from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils.logging import get_logger
 
 OPENMPI = "OpenMPI"
@@ -107,7 +108,21 @@ def build_mpirun_command(num_proc, hosts, command, env=None, impl=None,
 
 def mpi_run(num_proc, hosts, command, env=None, extra_args=None):
     """Exec the mpirun command (blocking); returns the exit code."""
-    argv = build_mpirun_command(num_proc, hosts, command, env=env,
-                                extra_args=extra_args)
+    impl = detect_impl()
+    run_env = dict(env or os.environ)
+    # Export the exact rank-block layout so workers derive
+    # cross_rank/cross_size correctly under unequal slots per host
+    # (topology._from_host_slots) — but ONLY where the command line
+    # enforces that layout: OpenMPI/Spectrum honor `-H host:slots
+    # --map-by slot` (block fill).  Hydra (MPICH) gets bare hostnames
+    # and places by core count, so asserting a layout there would
+    # override the runtime's CORRECT per-rank variables with a lie.
+    # Must be in the env BEFORE argv is built: the `-x`/`-envlist`
+    # forwarding flags are emitted from the keys present at build time,
+    # and remote-host ranks only receive forwarded variables.
+    if hosts and impl in (OPENMPI, SPECTRUM):
+        run_env[env_util.HVD_HOST_SLOTS] = hosts
+    argv = build_mpirun_command(num_proc, hosts, command, env=run_env,
+                                impl=impl, extra_args=extra_args)
     get_logger().info("mpirun delegation: %s", " ".join(argv))
-    return subprocess.call(argv, env=dict(env or os.environ))
+    return subprocess.call(argv, env=run_env)

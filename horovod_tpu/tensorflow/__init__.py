@@ -147,8 +147,14 @@ def allreduce(tensor, average=None, name=None, op=None,
     @_tf.custom_gradient
     def _allreduce_diff(t):
         compressed, ctx = comp.compress(t)
+        # Resolve the auto name NOW, on the rank thread, with the same
+        # per-thread counter the submission would use: the backward
+        # below must reuse this exact name (+".grad") — minting a fresh
+        # auto name at grad time would diverge across ranks whenever
+        # gradient evaluation order differs (cross-rank hang).
+        resolved = name or _eager._auto_name("allreduce")
         out = _eager.allreduce(
-            compressed.numpy(), average=average, name=name, op=op,
+            compressed.numpy(), average=average, name=resolved, op=op,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor)
         out = comp.decompress(_to_tf(out, compressed.dtype), ctx)
@@ -159,7 +165,7 @@ def allreduce(tensor, average=None, name=None, op=None,
         captured_rank = getattr(_basics._tls, "local_rank", None)
 
         def grad(dy):
-            gname = f"{name}.grad" if name else None
+            gname = f"{resolved}.grad"
             previous = getattr(_basics._tls, "local_rank", None)
             if captured_rank is not None:
                 _basics._tls.local_rank = captured_rank

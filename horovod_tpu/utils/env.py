@@ -46,6 +46,7 @@ HVD_RENDEZVOUS_PORT = "HVD_RENDEZVOUS_PORT"
 HVD_CONTROLLER_ADDR = "HVD_CONTROLLER_ADDR"
 HVD_IFACE = "HVD_IFACE"
 HVD_GLOBAL_MESH = "HVD_GLOBAL_MESH"            # pod mode: one global jax mesh
+HVD_HOST_SLOTS = "HVD_HOST_SLOTS"      # "h1:n1,h2:n2" rank-block layout
 HVD_COORDINATOR_ADDR = "HVD_COORDINATOR_ADDR"  # jax.distributed coordinator
 
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024

@@ -237,3 +237,86 @@ def test_runner_lsf_fills_num_proc(monkeypatch):
     assert rc == 0
     assert called["np"] == 4
     assert called["hosts"] == "nA:2,nB:2"
+
+
+def test_topology_host_slots_non_uniform(monkeypatch):
+    """ADVICE r2: with unequal slots per host (jsrun's trimmed last
+    host), the MPI-local-vars derivation gave ranks on the short host a
+    different cross_size; the HVD_HOST_SLOTS layout makes every rank
+    agree."""
+    from horovod_tpu.common import topology
+
+    monkeypatch.delenv("HVD_RANK", raising=False)
+    monkeypatch.setenv("HVD_RENDEZVOUS_ADDR", "10.0.0.1")
+    monkeypatch.setenv("HVD_HOST_SLOTS", "h1:4,h2:2")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "6")
+    expected = [  # (local_rank, local_size, cross_rank) per global rank
+        (0, 4, 0), (1, 4, 0), (2, 4, 0), (3, 4, 0), (0, 2, 1), (1, 2, 1)]
+    for rank in range(6):
+        monkeypatch.setenv("OMPI_COMM_WORLD_RANK", str(rank))
+        # deliberately-wrong OMPI locals: layout must win
+        monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "0")
+        monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "4")
+        topo = topology.from_env()
+        assert topo.cross_size == 2, f"rank {rank}"
+        assert (topo.local_rank, topo.local_size,
+                topo.cross_rank) == expected[rank], f"rank {rank}"
+
+
+def test_topology_host_slots_stale_falls_back(monkeypatch):
+    from horovod_tpu.common import topology
+
+    monkeypatch.delenv("HVD_RANK", raising=False)
+    monkeypatch.setenv("HVD_RENDEZVOUS_ADDR", "10.0.0.1")
+    monkeypatch.setenv("HVD_HOST_SLOTS", "h1:4,h2:2")  # sums to 6, not 8
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "5")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "8")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "4")
+    topo = topology.from_env()
+    assert (topo.cross_rank, topo.cross_size) == (1, 2)  # MPI-vars path
+
+
+def test_jsrun_exports_trimmed_layout(monkeypatch):
+    """js_run must hand workers the rankfile's exact (trimmed) layout."""
+    monkeypatch.setenv("LSB_JOBID", "7")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "n1 4 n2 4")
+    monkeypatch.setattr("shutil.which", lambda _: "/usr/bin/jsrun")
+    seen = {}
+
+    def fake_call(argv, env=None):
+        seen["env"] = dict(env or {})
+        return 0
+
+    monkeypatch.setattr(subprocess, "call", fake_call)
+    assert js_run.js_run(6, ["python", "t.py"]) == 0
+    assert seen["env"]["HVD_HOST_SLOTS"] == "n1:4,n2:2"
+
+
+@pytest.mark.parametrize("impl,exported", [
+    (mpi_run.OPENMPI, True),     # -H host:slots --map-by slot: block fill
+    (mpi_run.SPECTRUM, True),
+    (mpi_run.MPICH, False),      # Hydra gets bare hostnames; it places by
+])                               # core count — asserting a layout would lie
+def test_mpirun_exports_layout_only_when_enforced(monkeypatch, impl,
+                                                  exported):
+    monkeypatch.setattr(mpi_run, "detect_impl", lambda *a, **k: impl)
+    seen = {}
+
+    def fake_call(argv, env=None):
+        seen["env"] = dict(env or {})
+        seen["argv"] = list(argv)
+        return 0
+
+    monkeypatch.setattr(subprocess, "call", fake_call)
+    rc = mpi_run.mpi_run(3, "hostX:2,hostY:1", ["python", "t.py"],
+                         env={"PATH": "/usr/bin"})
+    assert rc == 0
+    if exported:
+        assert seen["env"]["HVD_HOST_SLOTS"] == "hostX:2,hostY:1"
+        # remote-host ranks only get -x/-envlist forwarded vars: the
+        # layout must be in the forwarding flags, not just local env
+        s = " ".join(seen["argv"])
+        assert "HVD_HOST_SLOTS" in s, s
+    else:
+        assert "HVD_HOST_SLOTS" not in seen["env"]

@@ -253,3 +253,21 @@ def test_pending_entry_completes_when_all_ranks_join(hvd):
         assert 0 <= last < N
         if r < 3:
             np.testing.assert_allclose(out, np.full((2,), expected))
+
+
+def test_broadcast_object_length_split_survives_int32(hvd):
+    """ADVICE r2: the payload length rides the eager plane where x64-off
+    narrows int64 to int32.  The length is now two int31 halves; verify
+    the encode/decode arithmetic covers > 2 GiB sizes exactly, and the
+    collective path still round-trips a real object."""
+    for n in (0, 1, 2**31 - 1, 2**31, 2**31 + 7, 5 * 2**30, 2**40):
+        lo, hi = n & 0x7FFFFFFF, n >> 31
+        assert 0 <= lo < 2**31 and 0 <= hi < 2**31  # int32-safe halves
+        assert (hi << 31) | lo == n
+
+    def fn(r):
+        payload = {"big": "x" * 10_000} if r == 0 else None
+        return hvd.broadcast_object(payload, root_rank=0, name="len.obj")
+
+    for out in _per_rank(fn):
+        assert out == {"big": "x" * 10_000}

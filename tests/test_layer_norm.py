@@ -112,3 +112,18 @@ def test_transformer_blocks_use_fused_layer_norm():
     logits = model.apply({"params": params}, tokens)
     assert logits.shape == (1, 8, 64)
     assert np.all(np.isfinite(np.asarray(logits)))
+
+def test_block_n_budgeted_by_feature_dim():
+    """ADVICE r2: block_n must shrink with d so the kernel's fp32 slabs
+    stay under VMEM (softmax_xent's budget rule); d=8192 previously
+    picked block_n=256 -> 8192*256*4*3 = 24 MB > 16 MB VMEM."""
+    from horovod_tpu.ops.pallas.layer_norm import _pick_block_n
+    assert _pick_block_n(1024, 128, slabs=2) == 256   # small d: unchanged
+    assert _pick_block_n(1024, 8192, slabs=3) * 8192 * 4 * 3 <= 4 << 20
+    assert _pick_block_n(1024, 8192, slabs=3) >= 8
+    # numerics still hold at large d with the smaller block
+    x, g, b = _data((16, 8192), seed=3)
+    out = layer_norm(x, g, b, 1e-6, True)
+    ref = layer_norm_reference(x, g, b)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
