@@ -90,12 +90,46 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+# ----------------------------------------------------- row-scalar packing
+#
+# Per-row scalars (logsumexp, delta) are natural [rows, 1] columns inside
+# the kernels (rows = sublanes) but must not be stored to HBM broadcast
+# across a 128-lane tile — that costs 128x the necessary bandwidth and
+# capped long-sequence backward (the bundled jax.experimental kernel
+# pays exactly this).  When block_q == 128 the scalars are packed dense:
+# HBM shape [bh, t/128, 128], one q-block's column per lane row.  The
+# lane<->sublane conversion uses an MXU identity contraction — bit-exact
+# for fp32 (one nonzero term per output) and guaranteed to lower on any
+# Mosaic version, unlike a reshape across the minor-two dims.
+
+def _eye(n):
+    return (jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+            == jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+            ).astype(jnp.float32)
+
+
+def _col_to_row(c):
+    """[n, 1] fp32 column -> [1, n] lane row (MXU transpose)."""
+    return jax.lax.dot_general(c, _eye(c.shape[0]), (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _row_to_col(r):
+    """[1, n] lane row -> [n, 1] fp32 column (MXU transpose)."""
+    return jax.lax.dot_general(_eye(r.shape[1]), r, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+_PACK = 128  # lane width: one q-block of row scalars per packed lane row
+
+
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_k):
+                block_q, block_k, packed):
     # q_ref: [block_q, d]; k_ref/v_ref: [t_kv, d]; o_ref: [block_q, d]
-    # lse_ref: [block_q, 128] (logsumexp broadcast across lanes)
+    # lse_ref: packed [1, 128] (one lane per row) or broadcast
+    # [block_q, 128] for odd block sizes
     iq = pl.program_id(1)
     t_kv = k_ref.shape[1]
     d = q_ref.shape[2]
@@ -145,17 +179,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     l_safe = jnp.where(l > 0, l, 1.0)
     o_ref[0] = (o / l_safe).astype(o_ref.dtype)
     lse = m + jnp.log(l_safe)
-    lse_ref[0] = jnp.broadcast_to(lse, (block_q, 128))
+    if packed:
+        lse_ref[0] = _col_to_row(lse)
+    else:
+        lse_ref[0] = jnp.broadcast_to(lse, (block_q, 128))
 
 
 def _fwd(q3, k3, v3, *, scale, causal, block_q, block_k, interpret):
+    """Returns ``(out [bh, t, d], lse [bh, t])``."""
     bh, t, d = q3.shape
     t_kv = k3.shape[1]
     nq = t // block_q
+    packed = block_q == _PACK
+
+    if packed:
+        lse_spec = _vmem_spec((1, 1, _PACK), lambda b, i: (b, i, 0))
+        lse_shape = _sds((bh, nq, _PACK), jnp.float32, q3)
+    else:
+        lse_spec = _vmem_spec((1, block_q, 128), lambda b, i: (b, i, 0))
+        lse_shape = _sds((bh, t, 128), jnp.float32, q3)
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, packed=packed),
         grid=(bh, nq),
         in_specs=[
             _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -164,21 +210,21 @@ def _fwd(q3, k3, v3, *, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
-            _vmem_spec((1, block_q, 128), lambda b, i: (b, i, 0)),
+            lse_spec,
         ],
         out_shape=[
             _sds((bh, t, d), q3.dtype, q3),
-            _sds((bh, t, 128), jnp.float32, q3),
+            lse_shape,
         ],
         interpret=interpret,
     )(q3, k3, v3)
-    return out, lse[:, :, 0]
+    return out, lse.reshape(bh, t) if packed else lse[:, :, 0]
 
 
 # --------------------------------------------------------------- backward
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_q, block_k):
+                   *, scale, causal, block_q, block_k, packed):
     iq = pl.program_id(1)
     t_kv = k_ref.shape[1]
     d = q_ref.shape[2]
@@ -186,8 +232,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, :, 0:1]                                # [bq, 1]
-    delta = delta_ref[0, :, 0:1]                            # [bq, 1]
+    if packed:
+        lse = _row_to_col(lse_ref[0])                       # [bq, 1]
+        delta = _row_to_col(delta_ref[0])
+    else:
+        lse = lse_ref[0, :, 0:1]                            # [bq, 1]
+        delta = delta_ref[0, :, 0:1]
 
     q_pos = iq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
@@ -223,7 +273,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, block_k):
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                    packed):
     ik = pl.program_id(1)
     t_q = q_ref.shape[1]
     d = k_ref.shape[2]
@@ -239,8 +290,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(iq * block_q, block_q), 0:1]
-        delta = delta_ref[0, pl.ds(iq * block_q, block_q), 0:1]
+        if packed:
+            lse = _row_to_col(lse_ref[0, pl.ds(iq, 1), :])
+            delta = _row_to_col(delta_ref[0, pl.ds(iq, 1), :])
+        else:
+            lse = lse_ref[0, pl.ds(iq * block_q, block_q), 0:1]
+            delta = delta_ref[0, pl.ds(iq * block_q, block_q), 0:1]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -292,20 +347,31 @@ def _bwd(res, g, *, scale, causal, block_q, block_k, interpret,
                     axis=-1)                                # [bh, t]
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
-    lse_b = jnp.broadcast_to(lse[:, :, None], (bh, t, 128))
-    delta_b = jnp.broadcast_to(delta[:, :, None], (bh, t, 128))
+    packed = block_q == _PACK
+    if packed:
+        # dense: one q-block's 128 row scalars per lane row (a reshape,
+        # i.e. free) — 128x less HBM than the broadcast fallback below
+        lse_b = lse.reshape(bh, nq, _PACK)
+        delta_b = delta.reshape(bh, nq, _PACK)
+        dq_lse_spec = _vmem_spec((1, 1, _PACK), lambda b, i: (b, i, 0))
+        dkv_lse_spec = _vmem_spec((1, nq, _PACK), lambda b, i: (b, 0, 0))
+    else:
+        lse_b = jnp.broadcast_to(lse[:, :, None], (bh, t, 128))
+        delta_b = jnp.broadcast_to(delta[:, :, None], (bh, t, 128))
+        dq_lse_spec = _vmem_spec((1, block_q, 128), lambda b, i: (b, i, 0))
+        dkv_lse_spec = _vmem_spec((1, t, 128), lambda b, i: (b, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, packed=packed),
         grid=(bh, nq),
         in_specs=[
             _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
             _vmem_spec((1, t_kv, d), lambda b, i: (b, 0, 0)),
             _vmem_spec((1, t_kv, d), lambda b, i: (b, 0, 0)),
             _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
-            _vmem_spec((1, block_q, 128), lambda b, i: (b, i, 0)),
-            _vmem_spec((1, block_q, 128), lambda b, i: (b, i, 0)),
+            dq_lse_spec,
+            dq_lse_spec,
         ],
         out_specs=_vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=_sds((bh, t, d), q3.dtype, q3),
@@ -314,15 +380,15 @@ def _bwd(res, g, *, scale, causal, block_q, block_k, interpret,
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, packed=packed),
         grid=(bh, nk),
         in_specs=[
             _vmem_spec((1, t, d), lambda b, i: (b, 0, 0)),
             _vmem_spec((1, block_k, d), lambda b, i: (b, i, 0)),
             _vmem_spec((1, block_k, d), lambda b, i: (b, i, 0)),
             _vmem_spec((1, t, d), lambda b, i: (b, 0, 0)),
-            _vmem_spec((1, t, 128), lambda b, i: (b, 0, 0)),
-            _vmem_spec((1, t, 128), lambda b, i: (b, 0, 0)),
+            dkv_lse_spec,
+            dkv_lse_spec,
         ],
         out_specs=[
             _vmem_spec((1, block_k, d), lambda b, i: (b, i, 0)),
