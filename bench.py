@@ -155,6 +155,104 @@ def _bench_resnet(devices):
     return img_sec_per_device, mfu
 
 
+def _bench_transformer(devices):
+    """Transformer-LM headline: tokens/sec/chip + MFU for a fixed small
+    LM (bf16, seq 2048) — the vehicle that exercises all three Pallas
+    kernels (flash attention, fused LayerNorm, fused softmax-xent).
+    Reference vehicle: ``examples/tensorflow2_synthetic_benchmark.py``
+    (same timed-synthetic-loop methodology, LM config instead of
+    ResNet).  Same ``device_get`` synchronization discipline as the
+    ResNet bench."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel._compat import shard_map
+    import horovod_tpu as hvd
+    from horovod_tpu.models import Transformer, TransformerConfig, lm_loss
+    from horovod_tpu.parallel import make_mesh
+
+    n = len(devices)
+    mesh = make_mesh({"hvd": n}, devices=devices)
+
+    seq_len = int(os.environ.get("BENCH_LM_SEQ", 2048))
+    per_device_batch = int(os.environ.get("BENCH_LM_BATCH", 8))
+    d_model = int(os.environ.get("BENCH_LM_DMODEL", 1024))
+    n_layers = int(os.environ.get("BENCH_LM_LAYERS", 8))
+    vocab = int(os.environ.get("BENCH_LM_VOCAB", 32768))
+    batch = per_device_batch * n
+
+    cfg = TransformerConfig(
+        vocab_size=vocab, n_layers=n_layers, d_model=d_model,
+        n_heads=d_model // 128, d_ff=4 * d_model, max_len=seq_len,
+        dtype=jnp.bfloat16)
+    model = Transformer(cfg)
+    tokens = np.random.RandomState(0).randint(
+        0, vocab, (batch, seq_len))
+
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, seq_len), jnp.int32))
+    params = params["params"]
+    opt = hvd.DistributedOptimizer(optax.adamw(1e-4), named_axes=("hvd",))
+    opt_state = opt.init(params)
+
+    def per_shard(params, opt_state, tokens):
+        def loss_fn(p):
+            return lm_loss(model.apply({"params": p}, tokens), tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, \
+            jax.lax.pmean(loss, "hvd")
+
+    step = jax.jit(shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(), P(), P("hvd")),
+        out_specs=(P(), P(), P())), donate_argnums=(0, 1))
+
+    td = jax.device_put(tokens, NamedSharding(mesh, P("hvd")))
+
+    flops_per_step = None
+    try:
+        cost = step.lower(params, opt_state, td).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops_per_step = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    if not flops_per_step:
+        # analytic: 6 * params * tokens per train step
+        n_params = sum(int(np.prod(p.shape))
+                       for p in jax.tree_util.tree_leaves(params))
+        flops_per_step = 6.0 * n_params * batch * seq_len
+
+    for _ in range(int(os.environ.get("BENCH_WARMUP", 3))):
+        params, opt_state, loss = step(params, opt_state, td)
+    float(jax.device_get(loss))
+
+    iters = int(os.environ.get("BENCH_LM_ITERS", 10))
+    start = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, td)
+    float(jax.device_get(loss))
+    elapsed = time.perf_counter() - start
+
+    tokens_sec_per_device = batch * seq_len * iters / elapsed / n
+    mfu = None
+    peak = _peak_flops_per_chip(devices[0])
+    if peak:
+        mfu = flops_per_step * iters / elapsed / n / peak
+    return {
+        "tokens_sec_per_chip": round(tokens_sec_per_device, 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "config": {"d_model": d_model, "n_layers": n_layers,
+                   "seq_len": seq_len, "vocab": vocab,
+                   "batch_per_chip": per_device_batch, "dtype": "bf16"},
+    }
+
+
 def _bench_allreduce_bandwidth():
     """Eager hvd.allreduce algorithmic bandwidth over a size sweep."""
     import numpy as np
@@ -214,9 +312,23 @@ def worker():
     ready.set()
     platform = devices[0].platform
 
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        # keep the fallback fast: tiny LM so the methodology still runs
+        os.environ.setdefault("BENCH_LM_SEQ", "256")
+        os.environ.setdefault("BENCH_LM_BATCH", "1")
+        os.environ.setdefault("BENCH_LM_DMODEL", "256")
+        os.environ.setdefault("BENCH_LM_LAYERS", "2")
+        os.environ.setdefault("BENCH_LM_VOCAB", "1024")
+        os.environ.setdefault("BENCH_LM_ITERS", "2")
+
     import horovod_tpu as hvd
     hvd.init()
     img_sec_per_device, mfu = _bench_resnet(devices)
+    transformer = None
+    try:
+        transformer = _bench_transformer(devices)
+    except Exception as exc:  # never lose the ResNet number to the LM leg
+        sys.stderr.write(f"transformer bench failed: {exc!r}\n")
     allreduce_gbs = _bench_allreduce_bandwidth()
     hvd.shutdown()
 
@@ -230,12 +342,144 @@ def worker():
             "platform": platform,
             "n_devices": len(devices),
             "mfu": round(mfu, 4) if mfu is not None else None,
+            "transformer": transformer,
             "allreduce_gbs": allreduce_gbs,
         },
     }))
 
 
-def _run_worker_once(extra_env=None, timeout=900):
+def scaling_worker():
+    """Scaling-efficiency harness (BASELINE.md north star: the
+    reference's 8->64-GPU 90% scaling efficiency, ``docs/benchmarks.rst``).
+    Runs on the virtual CPU mesh today (mesh sizes 1/2/4/8) and on real
+    multi-chip unchanged when pod hardware exists: for each mesh size it
+    measures the fused-SPMD allreduce bus bandwidth and a synthetic
+    per-shard train step at FIXED per-device batch (weak scaling), and
+    reports efficiency = step_ms(1) / step_ms(n) — 1.0 is perfect.
+
+    Prints one JSON object (not the driver headline line)."""
+    import jax
+
+    if not os.environ.get("BENCH_SCALING_REAL"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel._compat import shard_map
+    import horovod_tpu as hvd
+    from horovod_tpu.models import MLP
+    from horovod_tpu.parallel import make_mesh
+
+    all_devices = jax.devices()
+    sizes = [n for n in (1, 2, 4, 8, 16, 32, 64)
+             if n <= len(all_devices)]
+    per_device_batch = int(os.environ.get("BENCH_SCALING_BATCH", 8))
+    ar_bytes = int(os.environ.get("BENCH_SCALING_AR_BYTES", 4 << 20))
+
+    results = {}
+    for n in sizes:
+        devices = all_devices[:n]
+        mesh = make_mesh({"hvd": n}, devices=devices)
+        sharded = NamedSharding(mesh, P("hvd"))
+        replicated = NamedSharding(mesh, P())
+
+        # -- fused-SPMD allreduce (the DistributedOptimizer hot path):
+        # one jitted psum program over the mesh
+        x = jax.device_put(
+            np.ones((n, ar_bytes // 4), np.float32),
+            NamedSharding(mesh, P("hvd", None)))
+
+        def ar_shard(x):
+            return jax.lax.psum(x, "hvd")
+
+        ar = jax.jit(shard_map(
+            ar_shard, mesh=mesh, in_specs=P("hvd", None),
+            out_specs=P("hvd", None)))
+        out = ar(x)
+        float(jax.device_get(out[0, 0]))  # warmup + sync
+        iters = 20
+        start = time.perf_counter()
+        for _ in range(iters):
+            out = ar(x)
+        float(jax.device_get(out[0, 0]))
+        elapsed = time.perf_counter() - start
+        # bus bandwidth convention (NCCL tests): 2*(n-1)/n * bytes / time
+        algo_gbs = ar_bytes * iters / elapsed / 1e9
+        bus_gbs = algo_gbs * (2 * (n - 1) / n) if n > 1 else algo_gbs
+
+        # -- synthetic train step, fixed per-device batch (weak scaling)
+        model = MLP(features=(256, 128, 10))
+        params = jax.jit(model.init)(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 784), jnp.float32))["params"]
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                       named_axes=("hvd",))
+        opt_state = opt.init(params)
+        xb = jax.device_put(
+            np.random.RandomState(0).randn(
+                per_device_batch * n, 784).astype(np.float32), sharded)
+        yb = jax.device_put(
+            np.random.RandomState(1).randint(
+                0, 10, (per_device_batch * n,)), sharded)
+
+        def per_shard_step(params, opt_state, xb, yb):
+            def loss_fn(p):
+                logits = model.apply({"params": p}, xb)
+                one_hot = jax.nn.one_hot(yb, 10)
+                return -jnp.mean(jnp.sum(
+                    jax.nn.log_softmax(logits) * one_hot, axis=-1))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, \
+                jax.lax.pmean(loss, "hvd")
+
+        step = jax.jit(shard_map(
+            per_shard_step, mesh=mesh,
+            in_specs=(P(), P(), P("hvd"), P("hvd")),
+            out_specs=(P(), P(), P())), donate_argnums=(0, 1))
+        params = jax.device_put(params, replicated)
+        opt_state = jax.device_put(opt_state, replicated)
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, xb, yb)
+        float(jax.device_get(loss))
+        iters = 30
+        start = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step(params, opt_state, xb, yb)
+        float(jax.device_get(loss))
+        step_ms = (time.perf_counter() - start) / iters * 1e3
+
+        results[str(n)] = {"allreduce_bus_gbs": round(bus_gbs, 3),
+                           "step_ms": round(step_ms, 3)}
+
+    base = results[str(sizes[0])]["step_ms"]
+    for n in sizes:
+        results[str(n)]["efficiency"] = round(
+            base / results[str(n)]["step_ms"], 3)
+    print(json.dumps({"scaling": results,
+                      "platform": all_devices[0].platform,
+                      "per_device_batch": per_device_batch}))
+
+
+def _run_scaling(timeout=600):
+    """Run the scaling harness in a CPU-forced subprocess; returns the
+    parsed dict or None."""
+    line, _, _ = _run_worker_once(
+        flag="--scaling-worker",
+        extra_env={"XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                                 " --xla_force_host_platform_device_count=8"
+                                 ).strip()},
+        timeout=timeout)
+    if line is None:
+        return None
+    return json.loads(line)
+
+
+def _run_worker_once(extra_env=None, timeout=900, flag="--worker"):
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(os.path.dirname(
@@ -243,7 +487,7 @@ def _run_worker_once(extra_env=None, timeout=900):
     env.update(extra_env or {})
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--worker"],
+            [sys.executable, os.path.abspath(__file__), flag],
             env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, timeout=timeout)
@@ -321,7 +565,7 @@ def main():
         line, out, err = _run_worker_once()
         last_out = out
         if line is not None:
-            print(line)
+            print(_attach_scaling(line))
             return 0
         sys.stderr.write(
             f"bench attempt {attempt + 1}/{attempts} failed ({err}); "
@@ -332,14 +576,39 @@ def main():
                      "running labeled CPU fallback\n")
     line = _cpu_fallback()
     if line is not None:
-        print(line)
+        print(_attach_scaling(line))
         return 0
     sys.stderr.write(last_out[-3000:] + "\n")
     return 1
 
 
+def _attach_scaling(line):
+    """Merge the CPU-mesh scaling harness results into the headline
+    record's extra (VERDICT r2 item 10: the 8->64-chip efficiency
+    measurement machinery, pre-validated on the virtual mesh).
+    ``BENCH_SCALING=0`` skips it (quick smoke runs)."""
+    if os.environ.get("BENCH_SCALING", "1") in ("0", "false", "no"):
+        return line
+    scaling = _run_scaling()
+    if scaling is None:
+        return line
+    try:
+        record = json.loads(line)
+    except json.JSONDecodeError:
+        return line
+    record.setdefault("extra", {})["scaling"] = scaling
+    return json.dumps(record)
+
+
 if __name__ == "__main__":
     if "--worker" in sys.argv:
         worker()
+    elif "--scaling-worker" in sys.argv:
+        scaling_worker()
+    elif "--scaling" in sys.argv:
+        result = _run_scaling()
+        print(json.dumps(result if result is not None else
+                         {"error": "scaling run failed"}))
+        sys.exit(0 if result is not None else 1)
     else:
         sys.exit(main())

@@ -218,7 +218,22 @@ def test_packed_lse_layout_engaged_and_dense():
     q3, k3, v3 = mk(), mk(), mk()
     out, lse = _fwd(q3, k3, v3, scale=d ** -0.5, causal=False,
                     block_q=128, block_k=128, interpret=True)
-    assert lse.shape == (bh, t)  # dense rows, not [bh, t, 128]
+    assert lse.shape == (bh, t)
+
+    # prove the PACKED layout is what the kernel writes to HBM: the
+    # pallas_call's lse output aval must be [bh, t/128, 128], not the
+    # broadcast [bh, t, 128] (which would also reshape to (bh, t) after
+    # the [:, :, 0] slice — shape of the public return can't catch it)
+    import functools as ft
+    jaxpr = jax.make_jaxpr(ft.partial(
+        _fwd, scale=d ** -0.5, causal=False, block_q=128, block_k=128,
+        interpret=True))(q3, k3, v3)
+    pallas_out_shapes = [
+        tuple(v.aval.shape)
+        for eqn in jaxpr.jaxpr.eqns if eqn.primitive.name == "pallas_call"
+        for v in eqn.outvars]
+    assert (bh, t // 128, 128) in pallas_out_shapes, pallas_out_shapes
+    assert (bh, t, 128) not in pallas_out_shapes, pallas_out_shapes
 
     # end-to-end gradient at t=512 (packed path active: block_q=128)
     q = jnp.asarray(rng.randn(1, 512, 2, 16).astype(np.float32))
