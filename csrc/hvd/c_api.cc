@@ -203,6 +203,12 @@ int hvd_pm_hierarchical_allreduce(void* pm) {
              : 0;
 }
 
+int hvd_pm_hierarchical_allgather(void* pm) {
+  return static_cast<hvd::ParameterManager*>(pm)->hierarchical_allgather()
+             ? 1
+             : 0;
+}
+
 int hvd_pm_cache_enabled(void* pm) {
   return static_cast<hvd::ParameterManager*>(pm)->cache_enabled() ? 1 : 0;
 }

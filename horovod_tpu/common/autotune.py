@@ -148,6 +148,10 @@ class ParameterManager:
         return bool(self._lib.hvd_pm_hierarchical_allreduce(self._h))
 
     @property
+    def hierarchical_allgather(self):
+        return bool(self._lib.hvd_pm_hierarchical_allgather(self._h))
+
+    @property
     def cache_enabled(self):
         return bool(self._lib.hvd_pm_cache_enabled(self._h))
 

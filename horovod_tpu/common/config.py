@@ -22,6 +22,8 @@ class Config:
     controller: str = "native"
     autotune: bool = False
     autotune_log: str | None = None
+    autotune_warmup_samples: int = 3
+    autotune_steady_state_samples: int = 10
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     # Opt-in separately from hierarchical_allreduce: hierarchical Adasum
@@ -52,6 +54,10 @@ class Config:
             controller=env_util.get_str(env_util.HVD_CONTROLLER, "native"),
             autotune=env_util.get_bool(env_util.HVD_AUTOTUNE),
             autotune_log=env_util.get_str(env_util.HVD_AUTOTUNE_LOG),
+            autotune_warmup_samples=env_util.get_int(
+                env_util.HVD_AUTOTUNE_WARMUP_SAMPLES, 3),
+            autotune_steady_state_samples=env_util.get_int(
+                env_util.HVD_AUTOTUNE_STEADY_STATE_SAMPLES, 10),
             hierarchical_allreduce=env_util.get_bool(
                 env_util.HVD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=env_util.get_bool(

@@ -28,11 +28,14 @@ class SignatureCache:
         self._entries = OrderedDict()
         self._capacity = capacity
         self.hits = 0
+        self.enabled = True   # autotune's cache_enabled knob lands here
 
     def check(self, name, sigs) -> bool:
         """True iff every rank's signature agrees and matches the cached
         one.  ``sigs`` is the set (or iterable) of per-rank signatures;
         ``None`` (signature unavailable) never matches."""
+        if not self.enabled:
+            return False
         sigs = set(sigs)
         if len(sigs) != 1 or None in sigs:
             return False
@@ -47,6 +50,8 @@ class SignatureCache:
         """Record a validated round's signature; only when all ranks
         agreed (a mixed set means validation rejected or per-rank shapes
         legitimately differ, e.g. variable-dim0 allgather)."""
+        if not self.enabled:
+            return
         sigs = set(sigs)
         if len(sigs) != 1 or None in sigs:
             return

@@ -1,0 +1,127 @@
+"""Autotune for the multi-process controllers (tcp / gmesh / python).
+
+The reference tunes runtime knobs on rank 0 with a Gaussian-process
+Bayesian optimizer and broadcasts the winners to every rank inside the
+coordinator's response stream, so all ranks apply identical values at
+the same cycle boundary (``horovod/common/controller.cc:33``
+``SynchronizeParameters``, ``parameter_manager.cc:88``).  This module
+gives the pure-Python controllers the same machinery through
+:class:`horovod_tpu.common.autotune.ParameterManager` (the ctypes face
+of the C++ GP + expected-improvement tuner with CSV logging,
+``csrc/hvd/parameter_manager.cc``).
+
+Distribution of tuned values is controller-specific but always
+coordinator-serialized:
+
+- **gmesh**: rank 0's metadata coordinator emits a ``params`` entry
+  into the global sequence log; every process applies it at that exact
+  point of the ordered response stream.
+- **tcp**: the coordinator stamps a ``(seq, params)`` publication onto
+  every result message of an entry at completion time, so all ranks of
+  the same collective apply the same values.
+- **python** (in-process): the single cycle loop both tunes and
+  applies — no distribution needed (all logical ranks share one
+  process).
+"""
+
+import threading
+import time
+
+from horovod_tpu.common.autotune import ParameterManager
+from horovod_tpu.utils.logging import get_logger
+
+
+def default_params(config):
+    """The untuned knob view every controller's ``tuned_params()``
+    reports when autotune is off — ONE definition so the surface cannot
+    drift between controllers."""
+    return {
+        "fusion_threshold_bytes": config.fusion_threshold_bytes,
+        "cycle_time_ms": config.cycle_time_ms,
+        "hierarchical_allreduce": config.hierarchical_allreduce,
+        "hierarchical_allgather": config.hierarchical_allgather,
+        "cache_enabled": True,
+        "tuning": False,
+        "best_score_bytes_per_sec": 0.0,
+    }
+
+
+class AutotuneManager:
+    """Rank-0-owned tuner: records per-cycle tensor bytes, periodically
+    re-optimizes (fusion threshold, cycle time, cache on/off), and
+    reports value changes for the controller to distribute."""
+
+    @classmethod
+    def create(cls, config, log):
+        """Build the manager iff autotune is enabled; a native-lib
+        build failure logs a warning and runs the job untuned instead
+        of taking it down."""
+        if not config.autotune:
+            return None
+        try:
+            return cls(config)
+        except Exception as exc:  # noqa: BLE001
+            log.warning("autotune unavailable: %s", exc)
+            return None
+
+    def __init__(self, config):
+        self._pm = ParameterManager(
+            warmup_samples=int(
+                getattr(config, "autotune_warmup_samples", 3)),
+            steady_state_samples=int(
+                getattr(config, "autotune_steady_state_samples", 10)),
+            log_path=config.autotune_log or None,
+            fusion_threshold_bytes=int(config.fusion_threshold_bytes),
+            cycle_time_ms=float(config.cycle_time_ms))
+        self._start = time.monotonic()
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last = None
+        self._closed = False
+        self._log = get_logger()
+
+    def record(self, nbytes: int):
+        with self._lock:
+            if not self._closed:
+                self._pm.record(int(nbytes))
+
+    def maybe_update(self):
+        """Feed the tuner a clock tick; returns ``(seq, params)`` when
+        the tuned values changed (or on the first call), else None."""
+        with self._lock:
+            if self._closed:
+                return None
+            changed = self._pm.update(time.monotonic() - self._start)
+            if not changed and self._last is not None:
+                return None
+            params = self._snapshot()
+            if params == self._last:
+                return None
+            self._last = params
+            self._seq += 1
+            self._log.debug("autotune: new params #%d %s", self._seq,
+                            params)
+            return self._seq, params
+
+    def params(self):
+        with self._lock:
+            if self._closed:
+                return dict(self._last or {})
+            return self._snapshot()
+
+    def _snapshot(self):
+        pm = self._pm
+        return {
+            "fusion_threshold_bytes": pm.fusion_threshold_bytes,
+            "cycle_time_ms": pm.cycle_time_ms,
+            "hierarchical_allreduce": pm.hierarchical_allreduce,
+            "hierarchical_allgather": pm.hierarchical_allgather,
+            "cache_enabled": pm.cache_enabled,
+            "tuning": pm.tuning,
+            "best_score_bytes_per_sec": pm.best_score,
+        }
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._pm = None  # __del__ frees the native handle

@@ -93,14 +93,14 @@ class LogEntry:
 
     __slots__ = ("seq", "kind", "req_type", "names", "shapes", "dtype",
                  "op", "prescale", "postscale", "root_rank", "all_dims0",
-                 "splits_matrix", "error", "last_rank", "joined")
+                 "splits_matrix", "error", "last_rank", "joined", "params")
 
     def __init__(self, seq, kind, req_type=None, names=(), shapes=(),
                  dtype=None, op=0, prescale=1.0, postscale=1.0,
                  root_rank=-1, all_dims0=None, splits_matrix=None,
-                 error=None, last_rank=-1, joined=()):
+                 error=None, last_rank=-1, joined=(), params=None):
         self.seq = seq
-        self.kind = kind              # "group" | "error" | "join_done"
+        self.kind = kind    # "group" | "error" | "join_done" | "params"
         self.req_type = req_type
         self.names = tuple(names)
         self.shapes = tuple(tuple(s) for s in shapes)
@@ -114,6 +114,7 @@ class LogEntry:
         self.error = error
         self.last_rank = last_rank
         self.joined = tuple(joined)   # global joined snapshot at emit time
+        self.params = params          # tuned knob dict ("params" entries)
 
 
 class CycleResp:
@@ -141,7 +142,8 @@ class MetaCoordinatorService(network.MuxService):
     NAME = "horovod_tpu gmesh coordinator"
 
     def __init__(self, num_processes, local_sizes, key, fusion_threshold,
-                 stall_warning_sec=60.0, stall_shutdown_sec=0.0):
+                 stall_warning_sec=60.0, stall_shutdown_sec=0.0,
+                 autotune=None):
         self._nproc = num_processes
         self._local_sizes = local_sizes      # ranks per process
         self._rank_pid = {}
@@ -152,6 +154,7 @@ class MetaCoordinatorService(network.MuxService):
             base += ls
         self._world = base
         self._fusion_threshold = fusion_threshold
+        self._autotune = autotune    # rank-0-owned AutotuneManager|None
         self._stall_warning = stall_warning_sec
         self._stall_shutdown = stall_shutdown_sec
         self._cv = threading.Condition()
@@ -259,6 +262,20 @@ class MetaCoordinatorService(network.MuxService):
             _, meta = item
             return (np.dtype(meta["dtype"]).itemsize *
                     int(np.prod(meta["shape"] or (1,))))
+
+        if self._autotune is not None:
+            for item in validated:
+                self._autotune.record(nbytes(item))
+            upd = self._autotune.maybe_update()
+            if upd is not None:
+                _, params = upd
+                # the coordinator's own fusion planning retunes here;
+                # the "params" entry hands every process the same values
+                # at the same point of the ordered response stream
+                # (reference: SynchronizeParameters, controller.cc:33)
+                self._fusion_threshold = params["fusion_threshold_bytes"]
+                self._emit(LogEntry(self._next_seq(), "params",
+                                    params=params))
 
         for bucket in plan_buckets(validated, key_fn=key,
                                    nbytes_fn=nbytes,
@@ -459,6 +476,10 @@ class GlobalMeshController(PythonController):
         self._client_addrs = None
         self._client_obj = None
         self._key = None
+        self._coord_autotune = None
+
+    def _owns_autotune(self):
+        return False  # tuning happens at the pid-0 metadata coordinator
 
     # -------------------------------------------------------------- lifecycle
     def start(self):
@@ -486,13 +507,17 @@ class GlobalMeshController(PythonController):
         port = os.environ.get(env_util.HVD_RENDEZVOUS_PORT)
         from horovod_tpu.run import http_client
         if self._pid == 0:
+            from horovod_tpu.ops.autotune import AutotuneManager
+            self._coord_autotune = AutotuneManager.create(self._config,
+                                                          self._log)
             self._coordinator = MetaCoordinatorService(
                 self._nproc,
                 [self._local_size] * self._nproc,
                 self._key,
                 self._config.fusion_threshold_bytes,
                 stall_warning_sec=self._config.stall_warning_seconds,
-                stall_shutdown_sec=self._config.stall_shutdown_seconds)
+                stall_shutdown_sec=self._config.stall_shutdown_seconds,
+                autotune=self._coord_autotune)
             tagged = [(iface, ip, self._coordinator.port)
                       for iface, ip in network.local_interfaces().items()]
             tagged.append(("lo", "127.0.0.1", self._coordinator.port))
@@ -545,6 +570,9 @@ class GlobalMeshController(PythonController):
         if self._coordinator is not None:
             self._coordinator.shutdown()
             self._coordinator = None
+        if self._coord_autotune is not None:
+            self._coord_autotune.close()
+            self._coord_autotune = None
 
     # --------------------------------------------------------- the wire cycle
     def _run_cycle(self, pending):
@@ -642,6 +670,10 @@ class GlobalMeshController(PythonController):
 
     # ------------------------------------------------------------- execution
     def _apply(self, entry):
+        if entry.kind == "params":
+            self._apply_tuned(entry.params)
+            return
+
         if entry.kind == "error":
             for name in entry.names:
                 local = self._table.pop(name, None)

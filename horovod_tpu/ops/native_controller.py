@@ -158,7 +158,8 @@ def _load_lib():
     lib.hvd_pm_fusion_bytes.argtypes = [ctypes.c_void_p]
     lib.hvd_pm_cycle_ms.restype = ctypes.c_double
     lib.hvd_pm_cycle_ms.argtypes = [ctypes.c_void_p]
-    for fn in ("hvd_pm_hierarchical_allreduce", "hvd_pm_cache_enabled",
+    for fn in ("hvd_pm_hierarchical_allreduce",
+               "hvd_pm_hierarchical_allgather", "hvd_pm_cache_enabled",
                "hvd_pm_tuning"):
         getattr(lib, fn).restype = ctypes.c_int
         getattr(lib, fn).argtypes = [ctypes.c_void_p]

@@ -115,6 +115,8 @@ class PythonController:
         self._log = get_logger()
         self._sig_cache = SignatureCache(
             getattr(config, "cache_capacity", 1024))
+        self._autotune = None
+        self._tuned = None   # last applied tuned-parameter dict
 
     @property
     def cache_hits(self):
@@ -122,10 +124,47 @@ class PythonController:
 
     # ----------------------------------------------------------- producer API
     def start(self):
+        if self._owns_autotune():
+            from horovod_tpu.ops.autotune import AutotuneManager
+            self._autotune = AutotuneManager.create(self._config,
+                                                    self._log)
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="hvd-coordinator")
         self._thread.start()
+
+    def _owns_autotune(self):
+        """The in-process cycle loop both tunes and applies; the gmesh
+        subclass tunes at its metadata coordinator instead."""
+        return True
+
+    def tuned_params(self):
+        """Current (possibly autotuned) runtime knob values — same
+        surface as the native controller (reference: ParameterManager
+        values after SynchronizeParameters)."""
+        if self._autotune is not None:
+            return self._autotune.params()
+        if self._tuned is not None:
+            return dict(self._tuned)
+        from horovod_tpu.ops.autotune import default_params
+        return default_params(self._config)
+
+    def _apply_tuned(self, params):
+        """Apply a tuned-parameter set to this process's knobs (the
+        reference applies SynchronizeParameters results the same way:
+        config values swap at a cycle boundary) — including the
+        categorical choices, which the tuner is actively scoring: the
+        executor must really run hierarchically when the candidate says
+        so, or every hierarchical sample would measure the flat path."""
+        self._tuned = dict(params)
+        self._config.fusion_threshold_bytes = \
+            params["fusion_threshold_bytes"]
+        self._config.cycle_time_ms = params["cycle_time_ms"]
+        self._executor.hierarchical_allreduce = \
+            params["hierarchical_allreduce"]
+        self._executor.hierarchical_allgather = \
+            params["hierarchical_allgather"]
+        self._sig_cache.enabled = params["cache_enabled"]
 
     def enqueue(self, request: EagerRequest):
         with self._lock:
@@ -150,6 +189,9 @@ class PythonController:
         self._wakeup.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self._autotune is not None:
+            self._autotune.close()
+            self._autotune = None
         with self._lock:
             for request in self._queue:
                 request.handle.set_error("horovod_tpu has been shut down")
@@ -162,8 +204,9 @@ class PythonController:
 
     # ------------------------------------------------------- coordinator loop
     def _loop(self):
-        cycle_s = self._config.cycle_time_ms / 1000.0
         while True:
+            # re-read each cycle: autotune retunes cycle_time_ms live
+            cycle_s = self._config.cycle_time_ms / 1000.0
             self._wakeup.wait(timeout=cycle_s)
             self._wakeup.clear()
             with self._lock:
@@ -236,6 +279,19 @@ class PythonController:
 
         # 4. fuse + dispatch
         self._dispatch(responses)
+
+        # 4b. feed the tuner (rank-0-analog: this process IS the
+        # coordinator) and apply any retuned knobs at this cycle
+        # boundary
+        if self._autotune is not None:
+            for _, group in responses:
+                self._autotune.record(
+                    np.dtype(group.dtype).itemsize
+                    * int(np.prod(group.shape or (1,))))
+            upd = self._autotune.maybe_update()
+            if upd is not None:
+                _, params = upd
+                self._apply_tuned(params)
 
         # 5. join barrier: everyone joined -> complete join handles with the
         # last rank to join (dict preserves join-call order)

@@ -79,7 +79,7 @@ class CollectiveMsg:
 class ResultMsg:
     def __init__(self, payload=None, shape=None, dtype=None, error=None,
                  recv_splits=None, ring_go=False, participants=None,
-                 dims0=None, ring_id=None):
+                 dims0=None, ring_id=None, params_seq=0, params=None):
         self.payload = payload
         self.shape = shape
         self.dtype = dtype
@@ -89,6 +89,8 @@ class ResultMsg:
         self.participants = participants
         self.dims0 = dims0              # per-rank first dims (ring allgather)
         self.ring_id = ring_id          # coordinator-assigned round id
+        self.params_seq = params_seq    # autotune publication counter
+        self.params = params            # tuned knob dict (rank 0 -> all)
 
 
 class JoinMsg:
@@ -161,7 +163,8 @@ class CoordinatorService(network.MuxService):
     NAME = "horovod_tpu coordinator"
 
     def __init__(self, size, key, stall_warning_sec=60.0,
-                 stall_shutdown_sec=0.0, cache_capacity=1024):
+                 stall_shutdown_sec=0.0, cache_capacity=1024,
+                 autotune=None):
         self._size = size
         self._stall_warning = stall_warning_sec
         self._stall_shutdown = stall_shutdown_sec
@@ -171,6 +174,8 @@ class CoordinatorService(network.MuxService):
         self._join_waiters = []     # (rank, Event, [last_rank])
         self._sig_cache = SignatureCache(cache_capacity)
         self._ring_seq = 0               # unique id per ring round
+        self._autotune = autotune        # rank-0-owned manager | None
+        self._published = None           # (seq, tuned knob dict)
         self._log = get_logger()
         super().__init__(self.NAME, key)
 
@@ -281,6 +286,31 @@ class CoordinatorService(network.MuxService):
             # entry left _forming already, so an unset event would spin
             # every waiting rank forever with no stall escape
             results = {r: ResultMsg(error=str(exc)) for r in reqs}
+        if self._autotune is not None:
+            # only SUCCESSFUL entries score the tuner: a failed
+            # collective transferred nothing, and counting its bytes
+            # would inflate bytes/sec for whatever knob values were
+            # active (the gmesh coordinator records validated-only for
+            # the same reason)
+            if not any(r.error for r in results.values()):
+                first = next(iter(reqs.values()))
+                self._autotune.record(
+                    np.dtype(first.dtype).itemsize
+                    * int(np.prod(first.shape or (1,))))
+            upd = self._autotune.maybe_update()
+            if upd is not None:
+                # publish: result messages carry the new values
+                # (reference: SynchronizeParameters — rank 0 tunes,
+                # winners ride the coordinator's responses)
+                self._published = upd
+                self._sig_cache.enabled = upd[1]["cache_enabled"]
+        if self._published is not None:
+            # stamp HERE (one point per entry), not at each rank's
+            # return: every rank of the same collective must see the
+            # same (seq, params) — the "same cycle boundary" contract
+            seq, params = self._published
+            for resp in results.values():
+                resp.params_seq, resp.params = seq, params
         entry.results = results
         entry.done.set()
 
@@ -482,6 +512,9 @@ class TcpController:
         self._ring = None
         self._ring_threshold = env_util.get_int(
             "HVD_TCP_RING_THRESHOLD", DEFAULT_RING_THRESHOLD)
+        self._autotune = None       # rank 0 only
+        self._tuned = None          # last applied (seq, params)
+        self._tuned_lock = threading.Lock()
         self._log = get_logger()
 
     # -------------------------------------------------------------- lifecycle
@@ -499,11 +532,15 @@ class TcpController:
         addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
         port = os.environ.get(env_util.HVD_RENDEZVOUS_PORT)
         if self._rank == 0:
+            from horovod_tpu.ops.autotune import AutotuneManager
+            self._autotune = AutotuneManager.create(self._config,
+                                                    self._log)
             self._coordinator = CoordinatorService(
                 self._size, self._key,
                 stall_warning_sec=self._config.stall_warning_seconds,
                 stall_shutdown_sec=self._config.stall_shutdown_seconds,
-                cache_capacity=self._config.cache_capacity)
+                cache_capacity=self._config.cache_capacity,
+                autotune=self._autotune)
             tagged = [(iface, ip, self._coordinator.port)
                       for iface, ip in network.local_interfaces().items()]
             tagged.append(("lo", "127.0.0.1", self._coordinator.port))
@@ -624,6 +661,7 @@ class TcpController:
                                  f"NEGOTIATE_{rtype.name}")
             resp = self._client().send(msg)
             self._timeline.end(request.name)
+            self._maybe_apply_params(resp)
             if resp.error is not None:
                 request.handle.set_error(resp.error)
                 return
@@ -700,6 +738,43 @@ class TcpController:
 
         self._spawn(run)
 
+    # -------------------------------------------------------------- autotune
+    def _maybe_apply_params(self, resp):
+        """Apply tuned knob values published by the coordinator
+        (reference: SynchronizeParameters applies rank-0's winners on
+        every rank).  The knob this data plane owns is its byte-size
+        cutover: the tuned fusion threshold IS the ring threshold — the
+        size above which tensors take the bulk p2p path instead of
+        riding coordinator payloads (same role the fusion threshold
+        plays for the in-process planners).  A transiently-stale
+        threshold on some rank is safe: the coordinator resolves the
+        ring-vs-payload choice per tensor and all participants follow
+        its ring_go."""
+        seq = getattr(resp, "params_seq", 0)
+        params = getattr(resp, "params", None)
+        if not params:
+            return
+        # in-flight request threads race here: without the lock a
+        # thread holding an OLDER stamp could overwrite a newer one
+        with self._tuned_lock:
+            if self._tuned is not None and seq <= self._tuned[0]:
+                return
+            self._tuned = (seq, dict(params))
+            self._ring_threshold = params["fusion_threshold_bytes"]
+            self._config.fusion_threshold_bytes = \
+                params["fusion_threshold_bytes"]
+            self._config.cycle_time_ms = params["cycle_time_ms"]
+
+    def tuned_params(self):
+        """Same surface as the native controller (reference:
+        ParameterManager values after SynchronizeParameters)."""
+        if self._autotune is not None:    # rank 0: live tuner view
+            return self._autotune.params()
+        if self._tuned is not None:
+            return dict(self._tuned[1])
+        from horovod_tpu.ops.autotune import default_params
+        return default_params(self._config)
+
     def shutdown(self):
         self._merge_timelines()
         if self._mux is not None:
@@ -714,6 +789,9 @@ class TcpController:
         if self._coordinator is not None:
             self._coordinator.shutdown()
             self._coordinator = None
+        if self._autotune is not None:
+            self._autotune.close()
+            self._autotune = None
 
     # -------------------------------------------------------------- timeline
     def _merge_timelines(self):
