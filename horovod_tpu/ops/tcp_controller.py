@@ -79,7 +79,8 @@ class CollectiveMsg:
 class ResultMsg:
     def __init__(self, payload=None, shape=None, dtype=None, error=None,
                  recv_splits=None, ring_go=False, participants=None,
-                 dims0=None, ring_id=None, params_seq=0, params=None):
+                 dims0=None, ring_id=None, params_seq=0, params=None,
+                 resend=False):
         self.payload = payload
         self.shape = shape
         self.dtype = dtype
@@ -91,6 +92,7 @@ class ResultMsg:
         self.ring_id = ring_id          # coordinator-assigned round id
         self.params_seq = params_seq    # autotune publication counter
         self.params = params            # tuned knob dict (rank 0 -> all)
+        self.resend = resend    # ring infeasible: resubmit with payload
 
 
 class JoinMsg:
@@ -292,7 +294,7 @@ class CoordinatorService(network.MuxService):
             # would inflate bytes/sec for whatever knob values were
             # active (the gmesh coordinator records validated-only for
             # the same reason)
-            if not any(r.error for r in results.values()):
+            if not any(r.error or r.resend for r in results.values()):
                 first = next(iter(reqs.values()))
                 self._autotune.record(
                     np.dtype(first.dtype).itemsize
@@ -344,10 +346,13 @@ class CoordinatorService(network.MuxService):
                 if r.dtype != first.dtype:
                     raise ValueError(
                         f"mismatched dtypes for tensor '{first.name}'")
-                if r.ring != first.ring:
-                    raise ValueError(
-                        f"mismatched data planes for tensor '{first.name}' "
-                        f"(ring threshold must agree on every rank)")
+
+        # The coordinator RESOLVES the data plane: any rank asking for
+        # the ring wins (thresholds can transiently disagree while
+        # autotuned values propagate; every rank holds its array locally
+        # so ring_go is always executable).  When the ring is infeasible
+        # but payload-less requests exist, everyone resends with payload.
+        ring = any(r.ring for r in reqs.values())
 
         if self._joined and rtype in (RequestType.ALLGATHER,
                                       RequestType.BROADCAST,
@@ -368,13 +373,29 @@ class CoordinatorService(network.MuxService):
                             f"mismatched reduce ops or scale factors for "
                             f"tensor '{first.name}'")
                 self._cache_store(name, entry)
-            if first.ring and rtype == RequestType.ALLREDUCE:
+            if ring and rtype == RequestType.ALLREDUCE:
                 participants = sorted(reqs.keys())
                 self._ring_seq += 1
                 return {r: ResultMsg(ring_go=True,
                                      participants=participants,
                                      ring_id=self._ring_seq)
                         for r in reqs}
+            if ring and rtype == RequestType.ADASUM:
+                participants = sorted(reqs.keys())
+                p = len(participants)
+                if p == self._size and p & (p - 1) == 0:
+                    self._ring_seq += 1
+                    return {r: ResultMsg(ring_go=True,
+                                         participants=participants,
+                                         ring_id=self._ring_seq)
+                            for r in reqs}
+                # joined ranks (zero stand-ins at world tree positions)
+                # or non-power-of-two world: only the payload path keeps
+                # the reference tree semantics — uniform resend
+                return {r: ResultMsg(resend=True) for r in reqs}
+            # reaching here means ring resolved False: every rank
+            # submitted a payload (ring=True implies payload=None and
+            # takes the branches above)
             arrs = {r: _decode(m) for r, m in reqs.items()}
             if rtype == RequestType.ADASUM:
                 out = self._adasum(arrs, first)
@@ -393,7 +414,7 @@ class CoordinatorService(network.MuxService):
                 raise ValueError(
                     f"mismatched trailing dimensions for allgather "
                     f"'{first.name}'")
-            if first.ring:
+            if ring:
                 participants = sorted(reqs.keys())
                 dims0 = [shapes[r][0] for r in participants]
                 self._ring_seq += 1
@@ -421,7 +442,7 @@ class CoordinatorService(network.MuxService):
                 raise ValueError(
                     f"broadcast '{first.name}': root rank "
                     f"{first.root_rank} did not participate")
-            if first.ring:
+            if ring:
                 participants = sorted(reqs.keys())
                 self._ring_seq += 1
                 return {r: ResultMsg(ring_go=True,
@@ -637,16 +658,23 @@ class TcpController:
             # nbytes-vs-threshold choice would disagree across ranks;
             # the ring is the uniform choice
             return True
+        if rtype == RequestType.ADASUM:
+            # distributed VHDD only over the full power-of-two world;
+            # the coordinator still referees (joined ranks force the
+            # payload path via resend)
+            return (nbytes >= self._ring_threshold
+                    and self._size & (self._size - 1) == 0)
         return (nbytes >= self._ring_threshold
                 and rtype in (RequestType.ALLREDUCE,
                               RequestType.BROADCAST))
 
-    def _run_one(self, request):
+    def _run_one(self, request, force_payload=False):
         try:
             arr = np.asarray(request.tensor)
             arr, wire_dtype = _wire_dtype(arr)
             rtype = RequestType(request.req_type)
-            ring = self._use_ring(request.req_type, arr.nbytes)
+            ring = (not force_payload
+                    and self._use_ring(request.req_type, arr.nbytes))
             msg = CollectiveMsg(
                 name=request.name, rank=self._rank,
                 req_type=request.req_type, op=request.op,
@@ -664,6 +692,11 @@ class TcpController:
             self._maybe_apply_params(resp)
             if resp.error is not None:
                 request.handle.set_error(resp.error)
+                return
+            if getattr(resp, "resend", False):
+                # coordinator resolved to the payload path but this
+                # round had payload-less submissions — one uniform retry
+                self._run_one(request, force_payload=True)
                 return
             if resp.ring_go:
                 out = self._run_ring(rtype, request, arr, resp)
@@ -703,6 +736,9 @@ class TcpController:
                     world_size=self._size,
                     prescale=request.prescale_factor,
                     postscale=request.postscale_factor, timeout=timeout)
+            elif rtype == RequestType.ADASUM:
+                out = self._ring.adasum(
+                    resp.ring_id, arr, resp.participants, timeout=timeout)
             elif rtype == RequestType.BROADCAST:
                 out = self._ring.broadcast(
                     resp.ring_id,
