@@ -53,7 +53,7 @@ def _peak_flops_per_chip(device):
     return None
 
 
-def _bench_resnet(devices):
+def _bench_resnet(devices, per_device_batch=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -68,7 +68,8 @@ def _bench_resnet(devices):
     n = len(devices)
     mesh = make_mesh({"hvd": n}, devices=devices)
 
-    per_device_batch = int(os.environ.get("BENCH_BATCH", 64))
+    if per_device_batch is None:
+        per_device_batch = int(os.environ.get("BENCH_BATCH", 64))
     batch = per_device_batch * n
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
 
@@ -324,6 +325,16 @@ def worker():
     import horovod_tpu as hvd
     hvd.init()
     img_sec_per_device, mfu = _bench_resnet(devices)
+    bs128 = None
+    if platform == "tpu" and not os.environ.get("BENCH_SKIP_BS128"):
+        # MXU occupancy leg: bs=64/chip is the reference-parity config
+        # (headline); bs=128 fills the late small-spatial stages better
+        try:
+            v, m = _bench_resnet(devices, per_device_batch=128)
+            bs128 = {"img_sec_per_chip": round(v, 2),
+                     "mfu": round(m, 4) if m is not None else None}
+        except Exception as exc:  # noqa: BLE001 — OOM etc.: keep headline
+            sys.stderr.write(f"bs128 leg failed: {exc!r}\n")
     transformer = None
     try:
         transformer = _bench_transformer(devices)
@@ -342,6 +353,7 @@ def worker():
             "platform": platform,
             "n_devices": len(devices),
             "mfu": round(mfu, 4) if mfu is not None else None,
+            "resnet_bs128": bs128,
             "transformer": transformer,
             "allreduce_gbs": allreduce_gbs,
         },

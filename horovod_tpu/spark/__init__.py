@@ -9,6 +9,7 @@ cluster, pair those estimators with a Backend built on :func:`run`.
 """
 
 from horovod_tpu.spark.runner import run  # noqa: F401
+from horovod_tpu.spark.backend import SparkBackend  # noqa: F401
 
 # estimator surface re-exported for reference-parity imports
 # (horovod.spark.keras.KerasEstimator etc. map here)

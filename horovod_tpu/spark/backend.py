@@ -1,0 +1,46 @@
+"""Spark backend for the estimator framework (reference:
+``horovod/spark/common/backend.py`` — ``SparkBackend.run`` places one
+training task per rank through ``horovod.spark.run``).
+
+Pairs the Spark-free estimators in :mod:`horovod_tpu.cluster` with
+Spark task placement: ``KerasEstimator(backend=SparkBackend(2), ...)``
+trains inside barrier Spark tasks exactly like the reference's
+estimators do."""
+
+from horovod_tpu.cluster.backend import Backend
+from horovod_tpu.spark import runner
+
+
+class SparkBackend(Backend):
+    def __init__(self, num_proc=None, use_barrier=True, verbose=False,
+                 jax_platform=None):
+        self._num_proc = num_proc
+        self._use_barrier = use_barrier
+        self._verbose = verbose
+        self._jax_platform = jax_platform
+
+    def num_processes(self):
+        if self._num_proc is not None:
+            return self._num_proc
+        runner._require_pyspark()
+        from pyspark.sql import SparkSession
+
+        sc = SparkSession.builder.getOrCreate().sparkContext
+        return max(int(sc.defaultParallelism), 1)
+
+    def run(self, fn, args=(), kwargs=None):
+        # Backend contract: fn(rank, *args).  runner.run's task fn runs
+        # inside an initialized rank context, so the wrapper reads the
+        # rank there (reference: SparkBackend wraps the train fn the
+        # same way, backend.py:90).
+        def wrapper(*a, **kw):
+            import horovod_tpu as hvd
+
+            return fn(hvd.rank(), *a, **kw)
+
+        env = ({"JAX_PLATFORMS": self._jax_platform}
+               if self._jax_platform else None)
+        return runner.run(wrapper, args=args, kwargs=kwargs,
+                          num_proc=self.num_processes(),
+                          use_barrier=self._use_barrier,
+                          verbose=self._verbose, env=env)

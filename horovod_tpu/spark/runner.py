@@ -8,8 +8,12 @@ partition per rank — and replaces the mpirun/gloo orchestration with
 this framework's env contract + rendezvous KV: the driver hosts the
 RendezvousServer, each Spark task assumes its rank, connects back, and
 runs the fn through the tcp controller exactly like an ``hvdrun``
-worker.  Requires PySpark (import-guarded; absent from this image —
-exercised by inspection, a documented scope note)."""
+worker.  Requires PySpark (import-guarded).  Executed for real by
+``tests/test_spark.py`` against a local-mode stand-in
+(``tests/_pyspark_shim``) that reproduces the API surface, cloudpickle
+serialization, separate-process executors, and barrier gang-failure
+semantics this module depends on — genuine PySpark cannot be installed
+in the CI image (no network egress to PyPI)."""
 
 import os
 import socket
@@ -39,10 +43,25 @@ def _driver_ip():
 
 
 def _task_fn(index, num_proc, fn, args, kwargs, rendezvous_addr,
-             rendezvous_port, secret_b64):
+             rendezvous_port, secret_b64, extra_env):
     """Runs inside one Spark task (= one rank)."""
     from horovod_tpu.utils import env as env_util
 
+    for key, value in (extra_env or {}).items():
+        os.environ[key] = value
+    if "JAX_PLATFORMS" in os.environ:
+        # must land before hvd.init touches jax.local_devices(); some
+        # TPU plugins ignore the env var, so pin programmatically too
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # register this task's start with the driver (start_timeout watches
+    # for the full gang; reference: task-to-driver registration,
+    # spark/driver_service.py)
+    from horovod_tpu.run import http_client
+
+    http_client.put(rendezvous_addr, int(rendezvous_port),
+                    "spark-start", str(index), b"1")
     os.environ[env_util.HVD_RANK] = str(index)
     os.environ[env_util.HVD_SIZE] = str(num_proc)
     os.environ[env_util.HVD_LOCAL_RANK] = "0"
@@ -64,10 +83,11 @@ def _task_fn(index, num_proc, fn, args, kwargs, rendezvous_addr,
 
 
 def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=None,
-        use_barrier=True, verbose=False):
+        use_barrier=True, verbose=False, env=None):
     """Run ``fn(*args, **kwargs)`` as a Horovod job inside Spark tasks;
     returns the list of per-rank results (reference signature:
-    ``spark/runner.py:131``)."""
+    ``spark/runner.py:131``; ``env`` merges into each task's
+    environment, as there)."""
     _require_pyspark()
     del verbose
     from pyspark.sql import SparkSession
@@ -89,19 +109,53 @@ def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=None,
 
     def mapper(index, _iterator):
         yield _task_fn(index, num_proc, fn, args, kwargs, addr, port,
-                       secret_b64)
+                       secret_b64, env)
 
     try:
         rdd = sc.parallelize(range(num_proc), num_proc)
         if use_barrier and hasattr(rdd, "barrier"):
             # barrier mode guarantees all ranks are scheduled together
             # (a partial gang would deadlock the collectives)
-            results = rdd.barrier().mapPartitionsWithIndex(
-                mapper).collect()
+            mapped = rdd.barrier().mapPartitionsWithIndex(mapper)
         else:
-            if start_timeout:
-                sc.setLocalProperty("spark.task.maxFailures", "1")
-            results = rdd.mapPartitionsWithIndex(mapper).collect()
-        return results
+            mapped = rdd.mapPartitionsWithIndex(mapper)
+        if not start_timeout:
+            return mapped.collect()
+        # start_timeout semantics (reference: spark/runner.py — fail
+        # when the cluster cannot schedule the full gang in time, e.g.
+        # fewer slots than num_proc): collect in a thread, watch the
+        # tasks' start registrations in the rendezvous KV.
+        import threading
+
+        box = {}
+
+        def _collect():
+            try:
+                box["results"] = mapped.collect()
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                box["error"] = exc
+
+        thread = threading.Thread(target=_collect, daemon=True)
+        thread.start()
+        import time as time_mod
+
+        deadline = time_mod.monotonic() + start_timeout
+        started = set()
+        while thread.is_alive() and len(started) < num_proc:
+            for i in range(num_proc):
+                if i not in started and rendezvous.get(
+                        "spark-start", str(i)) is not None:
+                    started.add(i)
+            if time_mod.monotonic() > deadline:
+                raise RuntimeError(
+                    f"Spark could not start all {num_proc} training "
+                    f"tasks within start_timeout={start_timeout}s "
+                    f"({len(started)} started); does the cluster have "
+                    f"enough task slots?")
+            thread.join(timeout=0.5)
+        thread.join()
+        if "error" in box:
+            raise box["error"]
+        return box["results"]
     finally:
         rendezvous.stop()

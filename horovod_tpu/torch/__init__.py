@@ -42,6 +42,10 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     broadcast_async_,
     alltoall,
     alltoall_async,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    grouped_allreduce_,
+    grouped_allreduce_async_,
     synchronize,
     poll,
     join,

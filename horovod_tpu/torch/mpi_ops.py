@@ -8,6 +8,8 @@ their numpy views, so the copies are torch-side only where semantically
 required (in-place variants).
 """
 
+import threading
+
 import numpy as np
 import torch
 
@@ -97,13 +99,39 @@ def _register(core_handle, finalize) -> int:
     return _handle_manager.allocate(_TorchHandle(core_handle, finalize))
 
 
+# grouped ops hand back ONE handle for the whole group (reference
+# contract: synchronize(grouped_allreduce_async(...)) -> list of
+# tensors).  Group ids are negative so they can never collide with the
+# HandleManager's per-tensor ints.
+_group_handles = {}
+_group_lock = threading.Lock()
+_group_next = [-1]
+
+
+def _register_group(handles) -> int:
+    with _group_lock:
+        gh = _group_next[0]
+        _group_next[0] -= 1
+        _group_handles[gh] = list(handles)
+    return gh
+
+
 def synchronize(handle: int):
-    """Block until the async op completes and return the torch result
-    (reference: mpi_ops.synchronize)."""
+    """Block until the async op completes and return the torch result —
+    a list of results for a group handle (reference:
+    mpi_ops.synchronize)."""
+    with _group_lock:
+        members = _group_handles.pop(handle, None)
+    if members is not None:
+        return [_handle_manager.wait(h) for h in members]
     return _handle_manager.wait(handle)
 
 
 def poll(handle: int) -> bool:
+    with _group_lock:
+        members = _group_handles.get(handle)
+    if members is not None:
+        return all(_handle_manager.poll(h) for h in members)
     return _handle_manager.poll(handle)
 
 
@@ -161,6 +189,50 @@ def allreduce_(tensor, average=None, name=None, op=None,
     return synchronize(allreduce_async_(
         tensor, average=average, name=name, op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor))
+
+
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            prescale_factor=1.0,
+                            postscale_factor=1.0) -> int:
+    """ONE group handle for the burst (reference contract:
+    ``torch/mpi_ops.py grouped_allreduce_async`` — ``synchronize`` on
+    it returns the list of reduced tensors); the per-tensor
+    submissions share a base name so the controller fuses compatible
+    runs."""
+    op = eager._resolve_op(op, average)
+    base = name or eager._auto_name("torch_grouped")
+    return _register_group([
+        _allreduce_async_impl(t, f"{base}.{i}", op, prescale_factor,
+                              postscale_factor, None, None)
+        for i, t in enumerate(tensors)])
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0):
+    return synchronize(grouped_allreduce_async(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
+
+
+def grouped_allreduce_async_(tensors, average=None, name=None, op=None,
+                             prescale_factor=1.0,
+                             postscale_factor=1.0) -> int:
+    """In-place grouped variant: results copy back into ``tensors``."""
+    op = eager._resolve_op(op, average)
+    base = name or eager._auto_name("torch_grouped")
+    return _register_group([
+        _allreduce_async_impl(t, f"{base}.{i}", op, prescale_factor,
+                              postscale_factor, None, t)
+        for i, t in enumerate(tensors)])
+
+
+def grouped_allreduce_(tensors, average=None, name=None, op=None,
+                       prescale_factor=1.0, postscale_factor=1.0):
+    return synchronize(grouped_allreduce_async_(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
 
 
 # -------------------------------------------------------------- allgather ---

@@ -1,0 +1,151 @@
+"""Minimal local-mode PySpark stand-in for exercising
+``horovod_tpu.spark`` for real (PyPI is unreachable from this image, so
+the genuine package cannot be installed — this shim reproduces the
+exact API surface, serialization model, and scheduling semantics the
+spark attachment depends on):
+
+- ``SparkSession.builder.getOrCreate()`` / ``sparkContext`` /
+  ``defaultParallelism`` (``local[N]`` via ``SPARK_SHIM_PARALLELISM``),
+- ``sc.parallelize(seq, n).mapPartitionsWithIndex(f)`` with
+  ``.barrier()`` gang scheduling,
+- executor-side execution in SEPARATE spawned Python processes with the
+  mapper shipped by cloudpickle — the same serialization real PySpark
+  uses, so closure-capture bugs surface identically,
+- barrier failure semantics: one task failing aborts the whole stage
+  and kills the gang (Spark's barrier contract).
+
+What it does NOT reproduce: the JVM, shuffle, SQL, dynamic allocation.
+The horovod attachment uses none of those.
+"""
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import time
+
+import cloudpickle
+
+__version__ = "0.0-shim"
+
+
+class _MappedRDD:
+    def __init__(self, partitions, f, barrier):
+        self._partitions = partitions
+        self._f = f
+        self._barrier = barrier
+
+    def collect(self):
+        workdir = tempfile.mkdtemp(prefix="pyspark_shim_")
+        procs = []
+        for index, items in enumerate(self._partitions):
+            payload_path = os.path.join(workdir, f"task{index}.in")
+            result_path = os.path.join(workdir, f"task{index}.out")
+            with open(payload_path, "wb") as f:
+                f.write(cloudpickle.dumps((self._f, index, list(items))))
+            env = dict(os.environ)
+            shim_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            env["PYTHONPATH"] = (shim_root + os.pathsep
+                                 + env.get("PYTHONPATH", ""))
+            procs.append((subprocess.Popen(
+                [sys.executable, "-m", "pyspark._worker",
+                 payload_path, result_path], env=env), result_path))
+
+        results = [None] * len(procs)
+        error = None
+        pending = set(range(len(procs)))
+        while pending and error is None:
+            progressed = False
+            for index in sorted(pending):
+                proc, result_path = procs[index]
+                if proc.poll() is None:
+                    continue
+                progressed = True
+                pending.discard(index)
+                try:
+                    with open(result_path, "rb") as f:
+                        status, data = pickle.loads(f.read())
+                except (OSError, EOFError, pickle.UnpicklingError):
+                    status, data = "error", (
+                        f"task {index} died without reporting "
+                        f"(exitcode {proc.returncode})")
+                if status == "ok":
+                    results[index] = pickle.loads(data)
+                else:
+                    error = (index, data)
+                    if self._barrier:
+                        # barrier stages abort the whole gang on first
+                        # failure (Spark: "Stage failed because barrier
+                        # task ... finished unsuccessfully") — a peer
+                        # blocked in a collective on the dead rank must
+                        # be killed, not waited on
+                        for other, _ in procs:
+                            if other.poll() is None:
+                                other.terminate()
+                    break
+            if not progressed:
+                time.sleep(0.05)
+        for proc, _ in procs:
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if error is not None:
+            index, data = error
+            kind = ("barrier stage" if self._barrier else "stage")
+            raise RuntimeError(
+                f"Job aborted due to {kind} failure: task {index} "
+                f"failed:\n{data}")
+        flat = []
+        for r in results:
+            flat.extend(r)
+        return flat
+
+
+class _RDD:
+    def __init__(self, partitions, barrier=False):
+        self._partitions = partitions
+        self._is_barrier = barrier
+
+    def barrier(self):
+        return _RDD(self._partitions, barrier=True)
+
+    def mapPartitionsWithIndex(self, f):  # noqa: N802 — pyspark API
+        return _MappedRDD(self._partitions, f, self._is_barrier)
+
+
+class SparkContext:
+    def __init__(self, parallelism):
+        self.defaultParallelism = parallelism
+        self._local_properties = {}
+
+    def parallelize(self, seq, numSlices=None):  # noqa: N803 — pyspark API
+        seq = list(seq)
+        n = numSlices or self.defaultParallelism
+        parts = [[] for _ in range(n)]
+        for i, item in enumerate(seq):
+            parts[i * n // max(len(seq), 1)].append(item)
+        return _RDD(parts)
+
+    def setLocalProperty(self, key, value):  # noqa: N802 — pyspark API
+        self._local_properties[key] = value
+
+
+class _Session:
+    def __init__(self):
+        self.sparkContext = SparkContext(
+            int(os.environ.get("SPARK_SHIM_PARALLELISM", "2")))
+
+    def stop(self):
+        pass
+
+
+class _Builder:
+    _session = None
+
+    def getOrCreate(self):  # noqa: N802 — pyspark API
+        if _Builder._session is None:
+            _Builder._session = _Session()
+        return _Builder._session

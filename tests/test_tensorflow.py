@@ -351,3 +351,141 @@ def test_tf_gradient_through_allreduce_and_sparse_scaling():
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-4000:]}"
     assert result.stdout.count("TF_GRAD_OK") == 2
+
+
+MATRIX_WORKER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import tensorflow as tf
+import horovod_tpu.tensorflow as hvd
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+# full dtype x op matrix (reference: test_tensorflow.py's exhaustive
+# dtype/dim sweeps over Sum/Average)
+DTYPES = (tf.float16, tf.bfloat16, tf.float32, tf.float64,
+          tf.int32, tf.int64, tf.uint8, tf.int8)
+for dtype in DTYPES:
+    ops = ((hvd.Sum, "s"), (hvd.Average, "a")) \
+        if dtype.is_floating else ((hvd.Sum, "s"),)
+    for op, tag in ops:
+        t = tf.cast(tf.fill([3, 2], r + 1), dtype)
+        out = hvd.allreduce(t, op=op, name=f"mx_{dtype.name}_{tag}")
+        assert out.dtype == dtype, (out.dtype, dtype)
+        expect = float(sum(range(1, n + 1)))
+        if op == hvd.Average:
+            expect /= n
+        np.testing.assert_allclose(
+            np.asarray(out, np.float64), np.full((3, 2), expect),
+            rtol=0.05 if dtype in (tf.float16, tf.bfloat16) else 1e-9)
+
+# allgather / broadcast dtype sweep
+for dtype in (tf.float32, tf.float64, tf.int64):
+    g = hvd.allgather(tf.cast(tf.fill([r + 1, 2], r), dtype),
+                      name=f"mxg_{dtype.name}")
+    assert g.shape[0] == sum(range(1, n + 1))
+    b = hvd.broadcast(tf.cast(tf.fill([3], r + 5), dtype), root_rank=1,
+                      name=f"mxb_{dtype.name}")
+    np.testing.assert_allclose(np.asarray(b, np.float64),
+                               np.full((3,), 6.0))
+
+# cross-rank error cases surface as clean exceptions on every rank
+from horovod_tpu.common.handles import HvdError
+for bad, kwargs, frag in (
+        (tf.ones([2 + r % 2]), {"op": hvd.Sum}, "shape"),
+        (tf.cast(tf.ones([3]), tf.float32 if r % 2 == 0 else tf.float64),
+         {"op": hvd.Sum}, "dtype"),
+        (tf.ones([3]), {"op": hvd.Sum if r % 2 == 0 else hvd.Average},
+         "op")):
+    try:
+        hvd.allreduce(bad, name=f"mxe_{frag}", **kwargs)
+        raise SystemExit(f"expected HvdError for {frag}")
+    except HvdError as exc:
+        assert frag in str(exc).lower(), (frag, str(exc))
+
+# the poisoned names recover
+out = hvd.allreduce(tf.ones([3]), op=hvd.Sum, name="mxe_shape")
+np.testing.assert_allclose(out.numpy(), np.full((3,), float(n)))
+
+print(f"rank {r} TF_MATRIX_OK", flush=True)
+"""
+
+
+def test_tf_dtype_op_matrix_and_errors_2proc():
+    result = _run_hvdrun(2, MATRIX_WORKER)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-3000:]}"
+    assert result.stdout.count("TF_MATRIX_OK") == 2
+
+
+SAVEDMODEL_WORKER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import tensorflow as tf
+import horovod_tpu.tensorflow as hvd
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+# The graph-mode bridge executes through tf.py_function, which CANNOT
+# serialize into a SavedModel (the reference's custom C++ op can).
+# Scope cut documented in the binding; this asserts the failure mode is
+# a clean, understandable error — not silent corruption.
+class M(tf.Module):
+    @tf.function(input_signature=[tf.TensorSpec([4], tf.float32)])
+    def __call__(self, x):
+        return hvd.allreduce(x, op=hvd.Sum, name="sm")
+
+m = M()
+# executes fine inside tf.function (the py_function bridge)...
+out = m(tf.ones([4]))
+np.testing.assert_allclose(out.numpy(), np.full((4,), float(n)))
+
+# ...and save/reload works WITHIN the process (the py_function token
+# resolves against the live registry)...
+import subprocess
+import sys
+import tempfile
+d = tempfile.mkdtemp(prefix=f"hvd_sm_{r}_")
+tf.saved_model.save(m, d)
+reloaded = tf.saved_model.load(d)
+np.testing.assert_allclose(reloaded(tf.ones([4])).numpy(),
+                           np.full((4,), float(n)))
+
+# ...but a FRESH process (a model server) cannot run it: py_function
+# bodies are not serialized, so the call must fail with the registry
+# error — the documented serving boundary of the bridge (the reference
+# ships a custom C++ op precisely to cross it)
+probe = (
+    "import os; os.environ['TF_CPP_MIN_LOG_LEVEL']='2'\n"
+    "import tensorflow as tf\n"
+    f"r = tf.saved_model.load({d!r})\n"
+    "try:\n"
+    "    r(tf.ones([4]))\n"
+    "    print('UNEXPECTED-OK')\n"
+    "except Exception as exc:\n"
+    "    ok = 'pyfunc' in str(exc).lower() or 'callback' in str(exc).lower()\n"
+    "    print('CLEAN-FAIL' if ok else f'WRONG-ERROR {exc!r}')\n")
+p = subprocess.run([sys.executable, "-c", probe], capture_output=True,
+                   text=True, timeout=240)
+assert "CLEAN-FAIL" in p.stdout, (p.stdout, p.stderr[-500:])
+
+# and the export round must not break subsequent collectives
+out = hvd.allreduce(tf.ones([2]), op=hvd.Sum, name="after")
+np.testing.assert_allclose(out.numpy(), np.full((2,), float(n)))
+print(f"rank {r} TF_SAVEDMODEL_OK", flush=True)
+"""
+
+
+def test_tf_savedmodel_serving_boundary_2proc():
+    """TF2-only scope cut (VERDICT r2 item 5): a SavedModel containing
+    the py_function bridge saves and reloads in-process, but a fresh
+    process (a model server) fails cleanly at call time — py_function
+    bodies are not serialized."""
+    result = _run_hvdrun(2, SAVEDMODEL_WORKER)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-3000:]}"
+    assert result.stdout.count("TF_SAVEDMODEL_OK") == 2
