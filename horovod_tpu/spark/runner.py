@@ -57,9 +57,21 @@ def _task_fn(index, num_proc, fn, args, kwargs, rendezvous_addr,
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     # register this task's start with the driver (start_timeout watches
     # for the full gang; reference: task-to-driver registration,
-    # spark/driver_service.py)
+    # spark/driver_service.py).  A rank that is ALREADY registered is a
+    # Spark task retry — a retried rank cannot rejoin a gang whose
+    # peers are mid-collective (or torn down), so fail the stage fast
+    # instead of hanging on a half-dead rendezvous.
     from horovod_tpu.run import http_client
 
+    try:
+        http_client.get(rendezvous_addr, int(rendezvous_port),
+                        "spark-start", str(index))
+        raise RuntimeError(
+            f"task for rank {index} appears to be a Spark retry; "
+            f"horovod jobs cannot retry individual ranks — fail the "
+            f"whole job and resubmit")
+    except KeyError:
+        pass  # first attempt: expected
     http_client.put(rendezvous_addr, int(rendezvous_port),
                     "spark-start", str(index), b"1")
     os.environ[env_util.HVD_RANK] = str(index)
@@ -146,7 +158,8 @@ def run(fn, args=(), kwargs=None, num_proc=None, start_timeout=None,
                 if i not in started and rendezvous.get(
                         "spark-start", str(i)) is not None:
                     started.add(i)
-            if time_mod.monotonic() > deadline:
+            if (len(started) < num_proc
+                    and time_mod.monotonic() > deadline):
                 raise RuntimeError(
                     f"Spark could not start all {num_proc} training "
                     f"tasks within start_timeout={start_timeout}s "

@@ -8,8 +8,6 @@ their numpy views, so the copies are torch-side only where semantically
 required (in-place variants).
 """
 
-import threading
-
 import numpy as np
 import torch
 
@@ -99,39 +97,49 @@ def _register(core_handle, finalize) -> int:
     return _handle_manager.allocate(_TorchHandle(core_handle, finalize))
 
 
-# grouped ops hand back ONE handle for the whole group (reference
-# contract: synchronize(grouped_allreduce_async(...)) -> list of
-# tensors).  Group ids are negative so they can never collide with the
-# HandleManager's per-tensor ints.
-_group_handles = {}
-_group_lock = threading.Lock()
-_group_next = [-1]
+class _GroupHandle:
+    """Handle protocol over a grouped submission's member int handles
+    (reference contract: ONE handle per group; ``synchronize`` on it
+    returns the list of results).  ``wait`` drains EVERY member before
+    re-raising the first error, so a partial failure cannot leak the
+    surviving members' HandleManager entries; it lives in the normal
+    ``_handle_manager`` id space, whose pop-on-terminal-error then
+    cleans up the group entry itself."""
 
+    def __init__(self, members):
+        self._members = list(members)
+        self.name = "grouped"
 
-def _register_group(handles) -> int:
-    with _group_lock:
-        gh = _group_next[0]
-        _group_next[0] -= 1
-        _group_handles[gh] = list(handles)
-    return gh
+    def poll(self) -> bool:
+        return all(_handle_manager.poll(h) for h in self._members)
+
+    def wait(self, timeout=None):
+        results = []
+        first_error = None
+        for h in self._members:
+            try:
+                results.append(_handle_manager.wait(h, timeout))
+            except TimeoutError:
+                # members stay registered (the manager keeps them on
+                # timeout); the group stays retryable — re-raise now
+                raise
+            except Exception as exc:  # noqa: BLE001 — drain, then raise
+                if first_error is None:
+                    first_error = exc
+                results.append(None)
+        if first_error is not None:
+            raise first_error
+        return results
 
 
 def synchronize(handle: int):
     """Block until the async op completes and return the torch result —
     a list of results for a group handle (reference:
     mpi_ops.synchronize)."""
-    with _group_lock:
-        members = _group_handles.pop(handle, None)
-    if members is not None:
-        return [_handle_manager.wait(h) for h in members]
     return _handle_manager.wait(handle)
 
 
 def poll(handle: int) -> bool:
-    with _group_lock:
-        members = _group_handles.get(handle)
-    if members is not None:
-        return all(_handle_manager.poll(h) for h in members)
     return _handle_manager.poll(handle)
 
 
@@ -201,10 +209,10 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
     runs."""
     op = eager._resolve_op(op, average)
     base = name or eager._auto_name("torch_grouped")
-    return _register_group([
+    return _handle_manager.allocate(_GroupHandle([
         _allreduce_async_impl(t, f"{base}.{i}", op, prescale_factor,
                               postscale_factor, None, None)
-        for i, t in enumerate(tensors)])
+        for i, t in enumerate(tensors)]))
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,
@@ -221,10 +229,10 @@ def grouped_allreduce_async_(tensors, average=None, name=None, op=None,
     """In-place grouped variant: results copy back into ``tensors``."""
     op = eager._resolve_op(op, average)
     base = name or eager._auto_name("torch_grouped")
-    return _register_group([
+    return _handle_manager.allocate(_GroupHandle([
         _allreduce_async_impl(t, f"{base}.{i}", op, prescale_factor,
                               postscale_factor, None, t)
-        for i, t in enumerate(tensors)])
+        for i, t in enumerate(tensors)]))
 
 
 def grouped_allreduce_(tensors, average=None, name=None, op=None,

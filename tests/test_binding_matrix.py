@@ -258,3 +258,27 @@ def test_grouped_allreduce_single_and_empty_edge():
         return True
 
     assert all(_per_rank(fn))
+
+
+def test_grouped_partial_failure_drains_members():
+    """One member of a group mismatches across ranks: synchronize must
+    raise HvdError AFTER draining every member — the surviving members'
+    HandleManager entries must not leak."""
+    from horovod_tpu.torch.mpi_ops import _handle_manager
+
+    def fn(r):
+        h = hvd_t.grouped_allreduce_async(
+            [torch.ones(2 + r % 2),   # shape mismatch -> error
+             torch.ones(3) * (r + 1)],  # healthy member
+            op=hvd_t.Sum, name="gmx.partial")
+        try:
+            hvd_t.synchronize(h)
+            return False
+        except HvdError as exc:
+            assert "shape" in str(exc).lower()
+            return True
+
+    assert all(_per_rank(fn))
+    # every member (and every group) drained on every rank: the shared
+    # manager holds no leaked entries once the round is over
+    assert len(_handle_manager._handles) == 0, _handle_manager._handles

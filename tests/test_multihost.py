@@ -466,3 +466,58 @@ def test_global_mesh_grouped_fused_edges():
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-3000:]}"
     for p in range(2):
         assert f"proc {p} GMESH_GROUPED_OK" in result.stdout
+
+
+ERROR_SWEEP_GMESH = r"""
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.common.basics import run_parallel
+from horovod_tpu.common.handles import HvdError
+
+hvd.init()
+pid = hvd.cross_rank()
+n = hvd.size()
+
+def per_rank(_local):
+    r = hvd.rank()
+    cases = [
+        (lambda: hvd.allreduce(np.ones(2 + r % 2, np.float32),
+                               op=hvd.Sum, name="ge.shape"), "shape"),
+        (lambda: hvd.allreduce(
+            np.ones(3, np.float32 if r % 2 == 0 else np.int32),
+            op=hvd.Sum, name="ge.dtype"), "dtype"),
+        (lambda: hvd.allreduce(
+            np.ones(3, np.float32),
+            op=hvd.Sum if r % 2 == 0 else hvd.Average,
+            name="ge.op"), "op"),
+        (lambda: hvd.broadcast(np.ones(3, np.float32), root_rank=r % 2,
+                               name="ge.root"), "root"),
+        (lambda: hvd.allgather(
+            np.ones((2, 3 + r % 2), np.float32), name="ge.trail"),
+         "trailing"),
+    ]
+    for submit, frag in cases:
+        try:
+            submit()
+            raise AssertionError(f"expected HvdError for {frag}")
+        except HvdError as exc:
+            assert frag in str(exc).lower(), (frag, str(exc))
+    # recovery: the names work again after the error rounds
+    out = np.asarray(hvd.allreduce(np.ones(3, np.float32), op=hvd.Sum,
+                                   name="ge.shape"))
+    np.testing.assert_allclose(out, np.full(3, float(n)))
+    return True
+
+assert all(run_parallel(per_rank))
+print(f"proc {pid} GMESH_ERRORS_OK", flush=True)
+"""
+
+
+def test_global_mesh_error_sweep():
+    """Per-op cross-rank mismatch sweep + recovery through the global
+    sequence log (errors must surface on EVERY process identically)."""
+    result = _run_gmesh(ERROR_SWEEP_GMESH)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-3000:]}"
+    for p in range(2):
+        assert f"proc {p} GMESH_ERRORS_OK" in result.stdout

@@ -244,3 +244,81 @@ def test_tcp_joined_rank_does_not_satisfy_live_rank():
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
     assert result.stdout.count("JOINED_COUNT_OK") == 3
+
+
+ERROR_SWEEP_WORKER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.common.handles import HvdError
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+# per-op cross-rank mismatch sweep over the tcp coordinator
+# (reference: the error-path coverage test_torch.py runs per backend)
+cases = [
+    # (submit, error fragment)
+    (lambda: hvd.allreduce(np.ones(2 + r % 2, np.float32), op=hvd.Sum,
+                           name="e.shape"), "shape"),
+    (lambda: hvd.allreduce(
+        np.ones(3, np.float32 if r % 2 == 0 else np.int32), op=hvd.Sum,
+        name="e.dtype"), "dtype"),
+    (lambda: hvd.allreduce(np.ones(3, np.float32),
+                           op=hvd.Sum if r % 2 == 0 else hvd.Average,
+                           name="e.op"), "op"),
+    (lambda: (hvd.allreduce(np.ones(3, np.float32), op=hvd.Sum,
+                            name="e.type") if r % 2 == 0 else
+              hvd.broadcast(np.ones(3, np.float32), root_rank=0,
+                            name="e.type")), "type"),
+    (lambda: hvd.broadcast(np.ones(3, np.float32), root_rank=r % 2,
+                           name="e.root"), "root"),
+    (lambda: hvd.allgather(
+        np.ones((2, 3 + r % 2), np.float32), name="e.trail"),
+     "trailing"),
+    (lambda: hvd.alltoall(np.ones((4, 2), np.float32),
+                          splits=[2] * n, name="e.split"), "split"),
+]
+for submit, frag in cases:
+    try:
+        submit()
+        raise SystemExit(f"expected HvdError for {frag}")
+    except HvdError as exc:
+        assert frag in str(exc).lower(), (frag, str(exc))
+
+# every poisoned name recovers (error responses clear the entry)
+out = np.asarray(hvd.allreduce(np.ones(3, np.float32), op=hvd.Sum,
+                               name="e.shape"))
+np.testing.assert_allclose(out, np.full(3, float(n)))
+
+# torch binding over the SAME tcp plane (reference: horovodrun --gloo
+# pytest test_torch.py)
+import torch
+import horovod_tpu.torch as hvd_t
+h = hvd_t.grouped_allreduce_async(
+    [torch.ones(4) * (r + 1), torch.ones(2) * 10 * (r + 1)],
+    op=hvd_t.Sum, name="e.tg")
+outs = hvd_t.synchronize(h)
+total = float(sum(range(1, n + 1)))
+assert torch.allclose(outs[0], torch.full((4,), total))
+assert torch.allclose(outs[1], torch.full((2,), 10 * total))
+try:
+    hvd_t.allreduce(torch.ones(2 + r % 2), op=hvd_t.Sum, name="e.tshape")
+    raise SystemExit("expected HvdError (torch over tcp)")
+except HvdError as exc:
+    assert "shape" in str(exc).lower()
+
+print(f"rank {r} TCP_ERRORS_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_tcp_error_sweep_and_torch_binding_4proc():
+    """Cross-rank mismatch sweep per op over the tcp coordinator, error
+    recovery, and the torch binding (incl. the grouped one-handle
+    contract) riding the same process-mode plane."""
+    result = _run_hvdrun(4, ERROR_SWEEP_WORKER, timeout=420)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-3000:]}"
+    assert result.stdout.count("TCP_ERRORS_OK") == 4
