@@ -118,16 +118,21 @@ def _bench_resnet(devices, per_device_batch=None):
     # XLA's own FLOP count for the compiled step, if the backend
     # exposes it; analytic estimate otherwise.
     flops_per_step = None
+    cost_info = None
     try:
         cost = step.lower(params, batch_stats, opt_state, x, y) \
             .compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
         flops_per_step = float(cost.get("flops", 0.0)) or None
+        cost_info = {k: float(v) for k, v in cost.items()
+                     if k in ("flops", "bytes accessed",
+                              "optimal_seconds", "transcendentals")}
     except Exception:
         pass
     if not flops_per_step:
         flops_per_step = _RESNET50_TRAIN_FLOPS_PER_IMG * batch
+    _bench_resnet.last_cost_analysis = cost_info
 
     # device_get of the loss is the synchronization point: it cannot
     # complete before the step's program has finished on-device.
@@ -565,6 +570,58 @@ def _cpu_fallback():
     return json.dumps(record)
 
 
+def profile_worker():
+    """MFU ceiling analysis (VERDICT r3 prep): compile the ResNet step
+    at bs 64 and 128, dump XLA's aggregate cost analysis (flops, bytes
+    accessed, optimal seconds) + measured step time, and the same for
+    the transformer leg — the per-op FLOP/time evidence for where the
+    remaining time goes.  Run on real TPU; works on CPU for plumbing
+    tests.  Prints one JSON object."""
+    import jax
+
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    peak = _peak_flops_per_chip(devices[0])
+
+    import horovod_tpu as hvd
+    hvd.init()
+
+    out = {"device": getattr(devices[0], "device_kind", "unknown"),
+           "peak_bf16_flops": peak, "legs": {}}
+    legs = [("resnet_bs64", 64), ("resnet_bs128", 128)]
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        legs = [("resnet_bs2_cpu", 2)]  # plumbing smoke only
+    for label, batch in legs:
+        try:
+            img_sec, mfu = _bench_resnet(devices, per_device_batch=batch)
+            leg = {"img_sec_per_chip": round(img_sec, 2),
+                   "mfu": round(mfu, 4) if mfu is not None else None,
+                   "batch_per_chip": batch}
+            # XLA's view of the compiled step — the ceiling evidence:
+            # flops/peak vs optimal_seconds (compute-bound estimate)
+            # vs bytes accessed/HBM bandwidth (memory-bound estimate)
+            cost = getattr(_bench_resnet, "last_cost_analysis", None)
+            if cost:
+                leg["xla_cost_analysis"] = cost
+                if peak and cost.get("flops"):
+                    leg["compute_bound_step_ms"] = round(
+                        cost["flops"] / peak * 1e3, 3)
+                if cost.get("bytes accessed"):
+                    hbm = 819e9  # v5e HBM bandwidth, bytes/s
+                    leg["memory_bound_step_ms"] = round(
+                        cost["bytes accessed"] / hbm * 1e3, 3)
+            out["legs"][label] = leg
+        except Exception as exc:  # noqa: BLE001
+            out["legs"][label] = {"error": repr(exc)}
+    try:
+        out["legs"]["transformer"] = _bench_transformer(devices)
+    except Exception as exc:  # noqa: BLE001
+        out["legs"]["transformer"] = {"error": repr(exc)}
+    hvd.shutdown()
+    print(json.dumps(out))
+
+
 def main():
     """Supervisor: run the worker in fresh subprocesses with retries, so
     a transiently-unavailable TPU backend doesn't fail the bench; if
@@ -615,6 +672,8 @@ def _attach_scaling(line):
 if __name__ == "__main__":
     if "--worker" in sys.argv:
         worker()
+    elif "--profile" in sys.argv:
+        profile_worker()
     elif "--scaling-worker" in sys.argv:
         scaling_worker()
     elif "--scaling" in sys.argv:

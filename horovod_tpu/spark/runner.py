@@ -61,17 +61,29 @@ def _task_fn(index, num_proc, fn, args, kwargs, rendezvous_addr,
     # Spark task retry — a retried rank cannot rejoin a gang whose
     # peers are mid-collective (or torn down), so fail the stage fast
     # instead of hanging on a half-dead rendezvous.
+    import time as time_mod
+
     from horovod_tpu.run import http_client
 
-    try:
-        http_client.get(rendezvous_addr, int(rendezvous_port),
-                        "spark-start", str(index))
-        raise RuntimeError(
-            f"task for rank {index} appears to be a Spark retry; "
-            f"horovod jobs cannot retry individual ranks — fail the "
-            f"whole job and resubmit")
-    except KeyError:
-        pass  # first attempt: expected
+    probe_deadline = time_mod.monotonic() + 15.0
+    while True:
+        try:
+            http_client.get(rendezvous_addr, int(rendezvous_port),
+                            "spark-start", str(index))
+            raise RuntimeError(
+                f"task for rank {index} appears to be a Spark retry; "
+                f"horovod jobs cannot retry individual ranks — fail "
+                f"the whole job and resubmit")
+        except KeyError:
+            break  # key absent: first attempt, expected
+        except OSError:
+            # transient transport blip must not kill a healthy first
+            # attempt (same rationale as http_client.put's retry);
+            # fail OPEN after the budget — if the rendezvous is truly
+            # dead the job fails at the next contact anyway
+            if time_mod.monotonic() > probe_deadline:
+                break
+            time_mod.sleep(0.25)
     http_client.put(rendezvous_addr, int(rendezvous_port),
                     "spark-start", str(index), b"1")
     os.environ[env_util.HVD_RANK] = str(index)

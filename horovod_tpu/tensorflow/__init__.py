@@ -442,9 +442,46 @@ def DistributedOptimizer(optimizer, name=None, op=Average,
 
 
 def broadcast_global_variables(root_rank):
-    """TF1 global-collection broadcast has no TF2 analog; directs users
-    to ``broadcast_variables`` (reference API parity stub)."""
+    """Documented scope cut (reference API parity stub): TF2 eager has
+    no global collections, and in a TF1 frozen graph the broadcast
+    needs a session — which the reference's custom C++ op provides and
+    the py_function bridge cannot.  Both real workflows are covered:
+    ``broadcast_variables(model.variables, root_rank)`` on TF2, and
+    :class:`BroadcastGlobalVariablesHook` for TF1 sessions."""
     _require_tf()
     raise NotImplementedError(
-        "TF1 global collections do not exist on TF2; use "
-        "broadcast_variables(model.variables, root_rank)")
+        "broadcast_global_variables needs TF1 global collections plus "
+        "an in-graph op; use broadcast_variables(model.variables, "
+        "root_rank) on TF2, or BroadcastGlobalVariablesHook inside a "
+        "TF1 MonitoredTrainingSession")
+
+
+class BroadcastGlobalVariablesHook(
+        object if _tf is None else _tf.compat.v1.train.SessionRunHook):
+    """TF1-era session hook (reference: ``tensorflow/__init__.py:210``):
+    after session creation, every global variable takes rank
+    ``root_rank``'s value — the MonitoredTrainingSession / Estimator
+    workflow's initialization broadcast.
+
+    The broadcast rides the eager numpy plane OUTSIDE the session graph
+    (values read with ``session.run``, assigned back per variable), so
+    it composes with frozen TF1 graphs the py_function bridge cannot
+    live in."""
+
+    def __init__(self, root_rank, device=""):
+        _require_tf()
+        super().__init__()
+        self.root_rank = root_rank
+        del device  # accepted for reference API parity; single plane
+
+    def after_create_session(self, session, coord):
+        del coord
+        variables = _tf.compat.v1.global_variables()
+        values = session.run(variables)
+        handles = [
+            _eager.broadcast_async(_np.asarray(value), self.root_rank,
+                                   name=f"bcast_hook.{i}")
+            for i, value in enumerate(values)]
+        for var, handle in zip(variables, handles):
+            var.load(_np.asarray(_eager.synchronize(handle))
+                     .astype(var.dtype.as_numpy_dtype), session)

@@ -108,25 +108,36 @@ class _GroupHandle:
 
     def __init__(self, members):
         self._members = list(members)
+        self._done = {}   # member index -> result (survives a timeout)
         self.name = "grouped"
 
     def poll(self) -> bool:
-        return all(_handle_manager.poll(h) for h in self._members)
+        return all(i in self._done or _handle_manager.poll(h)
+                   for i, h in enumerate(self._members))
 
     def wait(self, timeout=None):
         results = []
         first_error = None
-        for h in self._members:
+        for i, h in enumerate(self._members):
+            if i in self._done:
+                # completed on a previous (timed-out) wait: its manager
+                # entry is already popped — reuse the memoized result
+                # so a retry stays correct
+                results.append(self._done[i])
+                continue
             try:
-                results.append(_handle_manager.wait(h, timeout))
+                result = _handle_manager.wait(h, timeout)
             except TimeoutError:
-                # members stay registered (the manager keeps them on
-                # timeout); the group stays retryable — re-raise now
+                # the pending member stays registered; completed ones
+                # are memoized above — re-raise, the group is retryable
                 raise
             except Exception as exc:  # noqa: BLE001 — drain, then raise
                 if first_error is None:
                     first_error = exc
                 results.append(None)
+                continue
+            self._done[i] = result
+            results.append(result)
         if first_error is not None:
             raise first_error
         return results
