@@ -1,0 +1,114 @@
+"""MNIST-style training on Spark (reference:
+``examples/keras_spark_mnist.py`` / ``pytorch_spark_mnist.py`` — an
+estimator fit whose per-rank training runs inside Spark barrier tasks).
+
+Two surfaces in one example:
+
+1. ``horovod_tpu.spark.run(fn)`` — the raw fn-per-task API, gradients
+   allreduced across the barrier tasks;
+2. ``JaxEstimator`` + ``SparkBackend`` — the estimator workflow placing
+   one training task per rank through Spark.
+
+Runs against real PySpark or the test shim
+(``PYTHONPATH=tests/_pyspark_shim`` for CI images without pyspark).
+
+Usage:
+    python examples/spark_mnist.py --num-proc 2 --epochs 4
+"""
+
+import argparse
+
+import numpy as np
+
+
+def synthetic_mnist(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w + 0.05 * rng.randn(n, 10), axis=1)
+    return x, y.astype(np.int32)
+
+
+def train_fn(epochs, lr):
+    """Runs inside one Spark task (= one horovod rank)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r, n = hvd.rank(), hvd.size()
+    x, y = synthetic_mnist(seed=0)
+    shard = slice(r * len(x) // n, (r + 1) * len(x) // n)
+    xs, ys = x[shard], y[shard]
+
+    rng = np.random.RandomState(0)  # identical init on every rank
+    w = (rng.randn(784, 10) * 0.01).astype(np.float32)
+    onehot = np.eye(10, dtype=np.float32)[ys]
+
+    def softmax(logits):
+        p = np.exp(logits - logits.max(axis=1, keepdims=True))
+        return p / p.sum(axis=1, keepdims=True)
+
+    def xent(w):
+        p = softmax(xs @ w)
+        return float(-np.log(p[np.arange(len(ys)), ys]).mean())
+
+    first_loss = xent(w)
+    for _ in range(epochs):
+        p = softmax(xs @ w)
+        grad = xs.T @ (p - onehot) / len(xs)
+        grad = np.asarray(hvd.allreduce(grad, op=hvd.Average,
+                                        name="grad.w"))
+        w -= lr * grad
+    # measured AFTER the final update, so even --epochs 1 shows it
+    return {"rank": r, "first_loss": first_loss, "last_loss": xent(w)}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-proc", type=int, default=2)
+    parser.add_argument("--epochs", type=int, default=4)
+    parser.add_argument("--lr", type=float, default=0.5)
+    args = parser.parse_args()
+
+    # the driver does a little jax work (estimator template init);
+    # the training itself runs inside the Spark tasks — pin the
+    # driver to CPU so it never grabs an accelerator
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import horovod_tpu.spark as spark
+
+    # 1. raw run(fn): one barrier task per rank
+    results = spark.run(train_fn, args=(args.epochs, args.lr),
+                        num_proc=args.num_proc,
+                        env={"JAX_PLATFORMS": "cpu"})
+    for res in results:
+        print(f"rank {res['rank']}: loss {res['first_loss']:.3f} -> "
+              f"{res['last_loss']:.3f}")
+        assert res["last_loss"] < res["first_loss"]
+
+    # 2. estimator through the Spark backend
+    from horovod_tpu.cluster import JaxEstimator, LocalStore
+    from horovod_tpu.models import MLP
+    from horovod_tpu.spark import SparkBackend
+    import tempfile
+
+    x, y = synthetic_mnist()
+    onehot = np.eye(10, dtype=np.float32)[y]
+    est = JaxEstimator(
+        MLP(features=(32, 10)), epochs=args.epochs, batch_size=32,
+        learning_rate=0.1,
+        store=LocalStore(tempfile.mkdtemp(prefix="spark_mnist_")),
+        backend=SparkBackend(num_proc=args.num_proc,
+                             jax_platform="cpu"))
+    model, metrics = est.fit(x, onehot)
+    pred = np.asarray(model.predict(x[:64]))
+    acc = float((np.argmax(pred, axis=1) == y[:64]).mean())
+    print(f"estimator fit through {args.num_proc} Spark tasks; "
+          f"train-set acc on 64 samples: {acc:.2f}")
+    print("SPARK_MNIST_OK")
+
+
+if __name__ == "__main__":
+    main()

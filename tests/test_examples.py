@@ -180,3 +180,16 @@ def test_keras_imagenet_resnet50_train_and_resume(tmp_path):
     assert second.returncode == 0, \
         f"stdout:\n{second.stdout}\nstderr:\n{second.stderr[-3000:]}"
     assert second.stdout.count("KERAS RESNET50 DONE") == 2
+
+
+def test_spark_mnist_example():
+    """Spark example (reference: keras_spark_mnist.py family) through
+    the pyspark shim: run(fn) + estimator-over-SparkBackend."""
+    from tests.test_spark import shim_env
+    result = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "spark_mnist.py"),
+         "--num-proc", "2", "--epochs", "3"],
+        env=shim_env(), capture_output=True, text=True, timeout=600)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-3000:]}"
+    assert "SPARK_MNIST_OK" in result.stdout

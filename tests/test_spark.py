@@ -21,10 +21,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SHIM = os.path.join(REPO, "tests", "_pyspark_shim")
 
 
-def _run_driver(script, extra_env=None, timeout=420):
-    path = "/tmp/hvd_spark_driver.py"
-    with open(path, "w") as f:
-        f.write(script)
+def shim_env(extra_env=None):
+    """Env contract for running a Spark driver against the shim —
+    shared with test_examples.py so the plumbing cannot drift."""
     env = dict(os.environ)
     env["PYTHONPATH"] = (SHIM + os.pathsep + REPO + os.pathsep
                          + env.get("PYTHONPATH", ""))
@@ -32,7 +31,14 @@ def _run_driver(script, extra_env=None, timeout=420):
     env.setdefault("SPARK_SHIM_PARALLELISM", "2")
     if extra_env:
         env.update(extra_env)
-    return subprocess.run([sys.executable, path], env=env,
+    return env
+
+
+def _run_driver(script, extra_env=None, timeout=420):
+    path = "/tmp/hvd_spark_driver.py"
+    with open(path, "w") as f:
+        f.write(script)
+    return subprocess.run([sys.executable, path], env=shim_env(extra_env),
                           capture_output=True, text=True, timeout=timeout)
 
 
