@@ -41,7 +41,9 @@ def train_fn(epochs, lr):
     shard = slice(r * len(x) // n, (r + 1) * len(x) // n)
     xs, ys = x[shard], y[shard]
 
-    rng = np.random.RandomState(0)  # identical init on every rank
+    rng = np.random.RandomState(1)  # identical init on every rank
+    # (seed differs from the DATA seed: init must be rank-identical,
+    # not correlated with the training pixels)
     w = (rng.randn(784, 10) * 0.01).astype(np.float32)
     onehot = np.eye(10, dtype=np.float32)[ys]
 
@@ -69,6 +71,12 @@ def main():
     parser.add_argument("--num-proc", type=int, default=2)
     parser.add_argument("--epochs", type=int, default=4)
     parser.add_argument("--lr", type=float, default=0.5)
+    parser.add_argument("--store-dir", default=None,
+                        help="estimator store prefix; on a REAL "
+                             "multi-node Spark cluster this must be a "
+                             "shared filesystem (rank 0 writes the "
+                             "checkpoint there) — the default temp dir "
+                             "only works in local mode")
     args = parser.parse_args()
 
     # the driver does a little jax work (estimator template init);
@@ -92,21 +100,28 @@ def main():
     from horovod_tpu.cluster import JaxEstimator, LocalStore
     from horovod_tpu.models import MLP
     from horovod_tpu.spark import SparkBackend
+    import shutil
     import tempfile
 
+    store_dir = args.store_dir or tempfile.mkdtemp(prefix="spark_mnist_")
     x, y = synthetic_mnist()
     onehot = np.eye(10, dtype=np.float32)[y]
-    est = JaxEstimator(
-        MLP(features=(32, 10)), epochs=args.epochs, batch_size=32,
-        learning_rate=0.1,
-        store=LocalStore(tempfile.mkdtemp(prefix="spark_mnist_")),
-        backend=SparkBackend(num_proc=args.num_proc,
-                             jax_platform="cpu"))
-    model, metrics = est.fit(x, onehot)
-    pred = np.asarray(model.predict(x[:64]))
-    acc = float((np.argmax(pred, axis=1) == y[:64]).mean())
-    print(f"estimator fit through {args.num_proc} Spark tasks; "
-          f"train-set acc on 64 samples: {acc:.2f}")
+    try:
+        est = JaxEstimator(
+            MLP(features=(32, 10)), epochs=args.epochs, batch_size=32,
+            learning_rate=0.1, store=LocalStore(store_dir),
+            backend=SparkBackend(num_proc=args.num_proc,
+                                 jax_platform="cpu"))
+        model, metrics = est.fit(x, onehot)
+        assert len(metrics) == args.num_proc
+        pred = np.asarray(model.predict(x[:64]))
+        acc = float((np.argmax(pred, axis=1) == y[:64]).mean())
+        print(f"estimator fit through {args.num_proc} Spark tasks; "
+              f"train-set acc on 64 samples: {acc:.2f}")
+        assert acc > 0.3, acc   # far above the 0.1 random baseline
+    finally:
+        if args.store_dir is None:
+            shutil.rmtree(store_dir, ignore_errors=True)
     print("SPARK_MNIST_OK")
 
 

@@ -62,3 +62,19 @@ def hvd():
 def hvd_init(hvd):
     """Alias fixture for tests that import horovod_tpu directly."""
     return hvd
+
+
+PYSPARK_SHIM = os.path.join(_REPO, "tests", "_pyspark_shim")
+
+
+def pyspark_shim_env(extra_env=None):
+    """Env contract for running a Spark driver against the local-mode
+    pyspark shim (shared by test_spark.py and test_examples.py)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (PYSPARK_SHIM + os.pathsep + _REPO + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env.pop("JAX_PLATFORMS", None)
+    env.setdefault("SPARK_SHIM_PARALLELISM", "2")
+    if extra_env:
+        env.update(extra_env)
+    return env

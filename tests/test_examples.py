@@ -185,7 +185,7 @@ def test_keras_imagenet_resnet50_train_and_resume(tmp_path):
 def test_spark_mnist_example():
     """Spark example (reference: keras_spark_mnist.py family) through
     the pyspark shim: run(fn) + estimator-over-SparkBackend."""
-    from tests.test_spark import shim_env
+    from tests.conftest import pyspark_shim_env as shim_env
     result = subprocess.run(
         [sys.executable, os.path.join(EXAMPLES, "spark_mnist.py"),
          "--num-proc", "2", "--epochs", "3"],

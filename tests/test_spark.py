@@ -21,17 +21,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SHIM = os.path.join(REPO, "tests", "_pyspark_shim")
 
 
-def shim_env(extra_env=None):
-    """Env contract for running a Spark driver against the shim —
-    shared with test_examples.py so the plumbing cannot drift."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = (SHIM + os.pathsep + REPO + os.pathsep
-                         + env.get("PYTHONPATH", ""))
-    env.pop("JAX_PLATFORMS", None)
-    env.setdefault("SPARK_SHIM_PARALLELISM", "2")
-    if extra_env:
-        env.update(extra_env)
-    return env
+from tests.conftest import pyspark_shim_env as shim_env  # noqa: E402
 
 
 def _run_driver(script, extra_env=None, timeout=420):
