@@ -6,9 +6,10 @@ controller + data plane as the torch/TF bindings instead of
 ``MXEnginePushAsync`` C shims (``mxnet/mpi_ops.cc:135``).
 
 Per-symbol import guard: imports cleanly without MXNet (which is EOL
-upstream and absent from this image — the binding activates when MXNet
-is installed; it is exercised by inspection, not CI, a documented scope
-note in README).
+upstream and absent from this image; the binding activates when MXNet
+is installed).  Executed end-to-end by ``tests/test_mxnet.py`` against
+``tests/_mxnet_shim`` — a stand-in reproducing exactly the
+NDArray/optimizer/gluon surface this module touches.
 """
 
 try:
@@ -66,7 +67,9 @@ def allreduce_(tensor, average=True, name=None, priority=0):
     _require_mx()
     del priority
     out = _eager.allreduce(tensor.asnumpy(), average=average, name=name)
-    tensor[:] = _to_mx(out, tensor)
+    # assign the numpy result directly: a throwaway NDArray (plus a
+    # device copy under real MXNet) would double the hot-path copies
+    tensor[:] = _np.asarray(out)
     return tensor
 
 
@@ -84,7 +87,7 @@ def broadcast(tensor, root_rank, name=None):
 def broadcast_(tensor, root_rank, name=None):
     _require_mx()
     out = _eager.broadcast(tensor.asnumpy(), root_rank, name=name)
-    tensor[:] = _to_mx(out, tensor)
+    tensor[:] = _np.asarray(out)
     return tensor
 
 
@@ -103,6 +106,12 @@ def DistributedOptimizer(optimizer):
     cheaper than — averaging in the allreduce (reference:
     ``mxnet/__init__.py:40-85``)."""
     _require_mx()
+    if getattr(optimizer, "_hvd_wrapped", False):
+        # double wrapping would allreduce twice AND divide rescale_grad
+        # twice — hard error, not silent wrong step sizes
+        raise ValueError(
+            "optimizer is already a DistributedOptimizer; wrapping "
+            "twice would double-allreduce gradients")
 
     class _Distributed(_mx.optimizer.Optimizer):
         _hvd_wrapped = True
@@ -164,6 +173,11 @@ if _mx is not None:
                 raise ValueError(
                     "DistributedTrainer wraps a plain optimizer; do not "
                     "pass a DistributedOptimizer")
+            # kvstore=None is REQUIRED (reference: mxnet/__init__.py:87
+            # passes it explicitly): gluon's default 'device' kvstore
+            # would route updates through a store _allreduce_grads never
+            # feeds, silently applying stale gradients
+            kwargs.setdefault("kvstore", None)
             super().__init__(params, optimizer,
                              optimizer_params=optimizer_params, **kwargs)
             self._scale /= size()
@@ -195,8 +209,28 @@ def broadcast_parameters(params, root_rank=0):
                 tensors.append(param.data())
                 names.append(name)
             except _mx.gluon.parameter.DeferredInitializationError:
-                continue
+                # shape-deferred parameter: hook its initialization so
+                # the broadcast happens the moment data exists
+                # (reference: mxnet/__init__.py:120 wraps _init_impl —
+                # silently skipping would leave each rank its own
+                # random init after the first forward)
+                _hook_deferred_broadcast(param, name, root_rank)
     else:
         raise ValueError(f"invalid params of type {type(params)}")
     for name, tensor in zip(names, tensors):
         broadcast_(tensor, root_rank, name=f"param.{name}")
+
+
+def _hook_deferred_broadcast(param, name, root_rank):
+    """Wrap ``param._init_impl`` so a deferred parameter broadcasts
+    right after gluon initializes it (reference: the post-init
+    broadcast wrapper in ``mxnet/__init__.py:120``)."""
+    original = param._init_impl
+
+    def wrapped(*args, **kwargs):
+        result = original(*args, **kwargs)
+        param._init_impl = original   # fire once
+        broadcast_(param.data(), root_rank, name=f"param.{name}")
+        return result
+
+    param._init_impl = wrapped

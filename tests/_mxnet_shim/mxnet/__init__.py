@@ -1,0 +1,214 @@
+"""Minimal MXNet stand-in for executing ``horovod_tpu.mxnet`` for real
+(MXNet is EOL upstream and uninstallable in this image — no egress to
+PyPI, and modern images lack its binary wheels).  Reproduces exactly
+the API surface the binding touches:
+
+- ``mx.nd.NDArray`` over numpy: ``asnumpy``, ``dtype``, ``context``,
+  ``as_in_context``, in-place ``tensor[:] = ...``, arithmetic the
+  examples use;
+- ``mx.nd.array(data, dtype=)``;
+- ``mx.optimizer.Optimizer`` base with ``rescale_grad`` + a concrete
+  ``SGD`` whose ``update`` applies ``-lr * rescale_grad * grad``
+  (the semantics the binding's sum+1/size trick relies on);
+- ``mx.gluon.Trainer`` with ``_params`` / ``_scale`` /
+  ``_allreduce_grads`` / ``step``, gluon ``Parameter`` with
+  ``grad_req`` / ``list_grad()`` / ``data()``, and
+  ``gluon.parameter.DeferredInitializationError``.
+
+What it does NOT reproduce: the MXNet engine, symbolic graphs, GPUs.
+The binding uses none of those (it routes through the framework's own
+controller instead of ``MXEnginePushAsync``)."""
+
+import numpy as np
+
+__version__ = "0.0-shim"
+
+
+class Context:
+    def __init__(self, device_type="cpu", device_id=0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and (self.device_type, self.device_id)
+                == (other.device_type, other.device_id))
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+class _ND:
+    """mx.nd namespace."""
+
+    class NDArray:
+        def __init__(self, data, ctx=None):
+            self._data = np.asarray(data)
+            self.context = ctx or cpu()
+
+        # --- surface the binding touches -----------------------------
+        def asnumpy(self):
+            return np.array(self._data, copy=True)
+
+        @property
+        def dtype(self):
+            return self._data.dtype
+
+        @property
+        def shape(self):
+            return self._data.shape
+
+        def as_in_context(self, ctx):
+            self.context = ctx
+            return self
+
+        def __setitem__(self, key, value):
+            if isinstance(value, _ND.NDArray):
+                value = value._data
+            self._data[key] = value
+
+        def __getitem__(self, key):
+            return _ND.NDArray(self._data[key], self.context)
+
+        # --- conveniences for examples/tests -------------------------
+        def __iadd__(self, other):
+            self._data += (other._data if isinstance(other, _ND.NDArray)
+                           else other)
+            return self
+
+        def __mul__(self, other):
+            return _ND.NDArray(self._data * (
+                other._data if isinstance(other, _ND.NDArray) else other),
+                self.context)
+
+        def __repr__(self):
+            return f"NDArray({self._data!r})"
+
+    @staticmethod
+    def array(data, dtype=None, ctx=None):
+        arr = np.asarray(data, dtype=dtype)
+        return _ND.NDArray(arr, ctx)
+
+    @staticmethod
+    def zeros(shape, dtype=np.float32, ctx=None):
+        return _ND.NDArray(np.zeros(shape, dtype), ctx)
+
+
+nd = _ND
+
+
+class _OptimizerModule:
+    class Optimizer:
+        def __init__(self, learning_rate=0.01, rescale_grad=1.0):
+            self.lr = learning_rate
+            self.rescale_grad = rescale_grad
+
+        def create_state(self, index, weight):
+            return None
+
+        def create_state_multi_precision(self, index, weight):
+            return self.create_state(index, weight)
+
+        def update(self, index, weight, grad, state):
+            raise NotImplementedError
+
+        def update_multi_precision(self, index, weight, grad, state):
+            self.update(index, weight, grad, state)
+
+        def set_learning_rate(self, lr):
+            self.lr = lr
+
+        def set_lr_mult(self, args_lr_mult):
+            self._lr_mult = args_lr_mult
+
+        def set_wd_mult(self, args_wd_mult):
+            self._wd_mult = args_wd_mult
+
+    class SGD(Optimizer):
+        def update(self, index, weight, grad, state):
+            if isinstance(index, (tuple, list)):
+                # real mx optimizers accept aggregated lists
+                for idx, w, g, s in zip(index, weight, grad, state):
+                    self.update(idx, w, g, s)
+                return
+            weight[:] = weight.asnumpy() - self.lr * (
+                self.rescale_grad * grad.asnumpy())
+
+
+optimizer = _OptimizerModule
+
+
+class _ParameterModule:
+    class DeferredInitializationError(RuntimeError):
+        pass
+
+    class Parameter:
+        def __init__(self, name, data=None, grad_req="write"):
+            self.name = name
+            self.grad_req = grad_req
+            self._data = data            # NDArray | None (deferred)
+            self.grad = (nd.zeros(data.shape, data.dtype)
+                         if data is not None else None)
+
+        def data(self):
+            if self._data is None:
+                raise _ParameterModule.DeferredInitializationError(
+                    f"parameter {self.name} not initialized")
+            return self._data
+
+        def list_grad(self):
+            return [self.grad]
+
+        # gluon's deferred-init protocol: initialize() routes through
+        # _init_impl, which horovod's broadcast_parameters hooks
+        def _init_impl(self, data):
+            self._data = data
+            self.grad = nd.zeros(data.shape, data.dtype)
+
+        def initialize(self, data):
+            self._init_impl(data)
+
+
+class _GluonModule:
+    parameter = _ParameterModule
+    Parameter = _ParameterModule.Parameter
+
+    class Trainer:
+        """The gluon.Trainer subset DistributedTrainer extends: holds
+        params + a (possibly kvstore-rescaled) optimizer, steps by
+        allreducing grads then updating each parameter."""
+
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     kvstore="device", **kwargs):
+            if hasattr(params, "values"):
+                params = list(params.values())
+            self._params = list(params)
+            if isinstance(optimizer, str):
+                optimizer = {"sgd": _OptimizerModule.SGD}[optimizer](
+                    **(optimizer_params or {}))
+            self._optimizer = optimizer
+            self._scale = optimizer.rescale_grad
+            # recorded so tests can assert horovod forces kvstore=None
+            # (real gluon would otherwise route updates through a
+            # 'device' KVStore that _allreduce_grads never feeds)
+            self._kvstore = kvstore
+            self._kwargs = kwargs
+
+        def step(self, batch_size, ignore_stale_grad=False):
+            del ignore_stale_grad
+            self._allreduce_grads()
+            self._optimizer.rescale_grad = self._scale / batch_size
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._optimizer.update(i, param.data(), param.grad,
+                                           None)
+
+        def _allreduce_grads(self):
+            pass  # plain trainer: no exchange (single process)
+
+
+gluon = _GluonModule
