@@ -251,3 +251,19 @@ def test_packed_lse_layout_engaged_and_dense():
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_long_sequence_backward_packed():
+    """T=4096 causal backward through the packed lse/delta layout — the
+    long-sequence regime the round-2 broadcast layout capped (its dkv
+    kernel held full-T 128-lane tiles of both operands).  Both backward
+    kernels (dq; dk/dv) must produce finite, non-trivial gradients."""
+    q, k, v = _rand(b=1, t=4096, h=1, seed=0)
+    gq, gk, gv = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for name, g in (("dq", gq), ("dk", gk), ("dv", gv)):
+        arr = np.asarray(g)
+        assert np.isfinite(arr).all(), name
+        assert np.abs(arr).max() > 0, name
