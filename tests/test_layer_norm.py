@@ -127,3 +127,17 @@ def test_block_n_budgeted_by_feature_dim():
     ref = layer_norm_reference(x, g, b)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_large_d_backward_budgeted_blocks():
+    """d=8192 BACKWARD through the VMEM-budgeted block pick (3 slabs);
+    round-3's budget fix covered the forward — lock the bwd too."""
+    x, g, b = _data((16, 8192), seed=5)
+    gp = jax.grad(lambda x, g, b: jnp.mean(
+        layer_norm(x, g, b, 1e-6, True) ** 2), argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(lambda x, g, b: jnp.mean(
+        layer_norm_reference(x, g, b) ** 2), argnums=(0, 1, 2))(x, g, b)
+    for a, c, nm in zip(gp, gr, "xgb"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-4, atol=1e-6,
+                                   err_msg=f"d{nm} mismatch at d=8192")

@@ -74,3 +74,22 @@ def test_odd_row_count_pads_and_slices():
         lambda x: jnp.sum(softmax_xent_reference(x, labels)))(logits)
     np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_large_vocab_fwd_bwd():
+    """vocab=32768 (production LM scale) through the VMEM-chunked
+    streaming path, forward + backward vs optax."""
+    logits, labels = _data((16,), 32768, seed=9, scale=1.0)
+
+    got = softmax_xent(logits, labels, True)
+    ref = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    gf = jax.grad(lambda l: jnp.mean(softmax_xent(l, labels, True)))(
+        logits)
+    gr = jax.grad(lambda l: jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(l, labels)))(
+        logits)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                               rtol=1e-5, atol=1e-7)
