@@ -164,7 +164,9 @@ double hvd_bo_best_y(void* bo) {
 
 void* hvd_pm_create(int warmup, int steady_state, int bayes_max,
                     double gp_noise, const char* log_path,
-                    int64_t fusion_bytes, double cycle_ms) {
+                    int64_t fusion_bytes, double cycle_ms,
+                    int hier_allreduce, int hier_allgather,
+                    int cache_enabled) {
   hvd::ParameterManager::Options o;
   o.active = true;
   o.warmup_samples = warmup;
@@ -174,6 +176,14 @@ void* hvd_pm_create(int warmup, int steady_state, int bayes_max,
   if (log_path) o.log_path = log_path;
   o.fusion_threshold_bytes = fusion_bytes;
   o.cycle_time_ms = cycle_ms;
+  // Seed the categorical walk and the fallback best from the configured
+  // values so tuning starts from — and on no-improvement converges back
+  // to — the operator's explicit hierarchical/cache choices, matching
+  // the reference's SetHierarchicalAllreduce/SetCacheEnabled seeding
+  // before tuning begins.
+  o.hierarchical_allreduce = hier_allreduce != 0;
+  o.hierarchical_allgather = hier_allgather != 0;
+  o.cache_enabled = cache_enabled != 0;
   return new hvd::ParameterManager(o);
 }
 

@@ -45,6 +45,20 @@ ParameterManager::ParameterManager(const Options& opts)
       {true, true, true},
       {false, false, false},
   };
+  // The walk starts at the CONFIGURED categorical so the first tuning
+  // samples — and everything published before the walk advances —
+  // respect the operator's explicit hierarchical/cache choices instead
+  // of silently flipping them off (the reference seeds its parameter
+  // manager from the configured values before tuning).
+  const Categorical seed{opts.hierarchical_allreduce,
+                         opts.hierarchical_allgather, opts.cache_enabled};
+  auto same = [&seed](const Categorical& c) {
+    return c.hier_allreduce == seed.hier_allreduce &&
+           c.hier_allgather == seed.hier_allgather &&
+           c.cache_enabled == seed.cache_enabled;
+  };
+  walk_.erase(std::remove_if(walk_.begin(), walk_.end(), same), walk_.end());
+  walk_.insert(walk_.begin(), seed);
   if (!opts.log_path.empty()) {
     log_ = std::fopen(opts.log_path.c_str(), "w");
     if (log_) {

@@ -122,12 +122,17 @@ class ParameterManager:
 
     def __init__(self, warmup_samples=3, steady_state_samples=10,
                  bayes_opt_max_samples=20, gp_noise=0.8, log_path=None,
-                 fusion_threshold_bytes=64 * 1024 * 1024, cycle_time_ms=1.0):
+                 fusion_threshold_bytes=64 * 1024 * 1024, cycle_time_ms=1.0,
+                 hierarchical_allreduce=False, hierarchical_allgather=False,
+                 cache_enabled=True):
         self._lib = _lib()
         self._h = self._lib.hvd_pm_create(
             warmup_samples, steady_state_samples, bayes_opt_max_samples,
             gp_noise, log_path.encode() if log_path else None,
-            fusion_threshold_bytes, cycle_time_ms)
+            fusion_threshold_bytes, cycle_time_ms,
+            1 if hierarchical_allreduce else 0,
+            1 if hierarchical_allgather else 0,
+            1 if cache_enabled else 0)
 
     def record(self, nbytes):
         self._lib.hvd_pm_record(self._h, int(nbytes))

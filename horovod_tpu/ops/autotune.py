@@ -72,7 +72,9 @@ class AutotuneManager:
                 getattr(config, "autotune_steady_state_samples", 10)),
             log_path=config.autotune_log or None,
             fusion_threshold_bytes=int(config.fusion_threshold_bytes),
-            cycle_time_ms=float(config.cycle_time_ms))
+            cycle_time_ms=float(config.cycle_time_ms),
+            hierarchical_allreduce=bool(config.hierarchical_allreduce),
+            hierarchical_allgather=bool(config.hierarchical_allgather))
         self._start = time.monotonic()
         self._lock = threading.Lock()
         self._seq = 0

@@ -149,7 +149,8 @@ def _load_lib():
     lib.hvd_pm_create.restype = ctypes.c_void_p
     lib.hvd_pm_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                   ctypes.c_double, ctypes.c_char_p,
-                                  ctypes.c_int64, ctypes.c_double]
+                                  ctypes.c_int64, ctypes.c_double,
+                                  ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.hvd_pm_destroy.argtypes = [ctypes.c_void_p]
     lib.hvd_pm_record.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.hvd_pm_update.restype = ctypes.c_int

@@ -178,6 +178,7 @@ class CoordinatorService(network.MuxService):
         self._ring_seq = 0               # unique id per ring round
         self._autotune = autotune        # rank-0-owned manager | None
         self._published = None           # (seq, tuned knob dict)
+        self._publish_lock = threading.Lock()
         self._log = get_logger()
         super().__init__(self.NAME, key)
 
@@ -303,14 +304,23 @@ class CoordinatorService(network.MuxService):
             if upd is not None:
                 # publish: result messages carry the new values
                 # (reference: SynchronizeParameters — rank 0 tunes,
-                # winners ride the coordinator's responses)
-                self._published = upd
-                self._sig_cache.enabled = upd[1]["cache_enabled"]
-        if self._published is not None:
+                # winners ride the coordinator's responses).  Today both
+                # _complete call sites already hold self._cv, so stores
+                # are serialized; the lock + newer-seq guard are
+                # DEFENSIVE, so a future call site outside _cv cannot
+                # roll a later stamp back and leave ranks on stale
+                # knobs until the next value change.
+                with self._publish_lock:
+                    if (self._published is None
+                            or upd[0] > self._published[0]):
+                        self._published = upd
+                        self._sig_cache.enabled = upd[1]["cache_enabled"]
+        stamped = self._published
+        if stamped is not None:
             # stamp HERE (one point per entry), not at each rank's
             # return: every rank of the same collective must see the
             # same (seq, params) — the "same cycle boundary" contract
-            seq, params = self._published
+            seq, params = stamped
             for resp in results.values():
                 resp.params_seq, resp.params = seq, params
         entry.results = results

@@ -8,6 +8,8 @@ their numpy views, so the copies are torch-side only where semantically
 required (in-place variants).
 """
 
+import time
+
 import numpy as np
 import torch
 
@@ -116,27 +118,48 @@ class _GroupHandle:
                    for i, h in enumerate(self._members))
 
     def wait(self, timeout=None):
+        # The timeout is a deadline over the WHOLE group, not a per-member
+        # allowance — otherwise a group of n members could block for up
+        # to n * timeout.  An expired deadline still calls each remaining
+        # member with wait(0): already-completed members drain for free,
+        # only a genuinely pending one raises.
+        deadline = None if timeout is None else time.monotonic() + timeout
         results = []
         first_error = None
         for i, h in enumerate(self._members):
-            if i in self._done:
-                # completed on a previous (timed-out) wait: its manager
-                # entry is already popped — reuse the memoized result
-                # so a retry stays correct
-                results.append(self._done[i])
+            memo = self._done.get(i)
+            if memo is not None:
+                # resolved on a previous (timed-out) wait: its manager
+                # entry is already popped — replay the memoized outcome
+                # (result OR terminal error) so a retry stays correct
+                kind, val = memo
+                if kind == "err":
+                    if first_error is None:
+                        first_error = val
+                    results.append(None)
+                else:
+                    results.append(val)
                 continue
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
             try:
-                result = _handle_manager.wait(h, timeout)
+                result = _handle_manager.wait(h, remaining)
             except TimeoutError:
-                # the pending member stays registered; completed ones
-                # are memoized above — re-raise, the group is retryable
+                # re-raise the TIMEOUT even when a member already failed
+                # terminally: a terminal raise here would make the
+                # manager pop the group entry while a member is still
+                # pending (stranding its handle forever).  The pending
+                # member stays registered; resolved members — results
+                # AND terminal errors — are memoized, so a retry drains
+                # the rest and then surfaces the real error.
                 raise
             except Exception as exc:  # noqa: BLE001 — drain, then raise
                 if first_error is None:
                     first_error = exc
+                self._done[i] = ("err", exc)
                 results.append(None)
                 continue
-            self._done[i] = result
+            self._done[i] = ("ok", result)
             results.append(result)
         if first_error is not None:
             raise first_error

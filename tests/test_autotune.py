@@ -457,3 +457,47 @@ def test_gmesh_autotune_synchronized(tmp_path):
     for p in range(2):
         assert f"proc {p} GMESH_AUTOTUNE_OK" in result.stdout
     assert log.exists(), "pid-0 autotune CSV log not written"
+
+
+# ------------------------------------------------- configured-value seeding
+
+def test_parameter_manager_seeds_hierarchical_from_config():
+    """ADVICE r3 (medium): the standalone PM must start from — and on a
+    no-improvement walk converge back to — the operator's explicit
+    hierarchical/cache choices (reference seeds SetHierarchicalAllreduce
+    etc. before tuning begins)."""
+    pm = autotune.ParameterManager(hierarchical_allreduce=True,
+                                   hierarchical_allgather=True,
+                                   cache_enabled=False)
+    assert pm.hierarchical_allreduce is True
+    assert pm.hierarchical_allgather is True
+    assert pm.cache_enabled is False
+    # default ctor keeps the old defaults
+    pm2 = autotune.ParameterManager()
+    assert pm2.hierarchical_allreduce is False
+    assert pm2.cache_enabled is True
+
+
+def test_autotune_manager_first_publication_respects_hierarchical():
+    """With HVD_HIERARCHICAL_ALLREDUCE=1 + HVD_AUTOTUNE=1 the FIRST
+    published knob set must not silently flip the hierarchical paths
+    off (the bug: hvd_pm_create never passed the seeds, so Options
+    defaulted false and _apply_tuned overrode the operator's choice)."""
+    import types
+
+    from horovod_tpu.ops.autotune import AutotuneManager
+
+    config = types.SimpleNamespace(
+        autotune=True, autotune_warmup_samples=1,
+        autotune_steady_state_samples=2, autotune_log="",
+        fusion_threshold_bytes=64 * 1024 * 1024, cycle_time_ms=1.0,
+        hierarchical_allreduce=True, hierarchical_allgather=True)
+    mgr = AutotuneManager(config)
+    try:
+        upd = mgr.maybe_update()  # first call always publishes
+        assert upd is not None
+        _, params = upd
+        assert params["hierarchical_allreduce"] is True
+        assert params["hierarchical_allgather"] is True
+    finally:
+        mgr.close()

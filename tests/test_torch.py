@@ -772,3 +772,112 @@ def test_sync_batch_norm_momentum_none_cumulative():
     for nbt, rmean in _per_rank(fn):
         assert int(nbt) == 2
         assert torch.allclose(rmean, expected, atol=1e-5)
+
+
+def test_group_wait_timeout_is_a_deadline():
+    """ADVICE r3 (low): a group synchronize with timeout=T must give up
+    after ~T total, not len(members) * T — the timeout is a deadline
+    over the whole group."""
+    import time as _time
+
+    import pytest
+
+    from horovod_tpu.torch import mpi_ops
+
+    class _NeverDone:
+        def poll(self):
+            return False
+
+        def wait(self, timeout=None):
+            assert timeout is not None
+            _time.sleep(min(timeout, 5.0))
+            raise TimeoutError("member never completes")
+
+    members = [mpi_ops._register(_NeverDone(), lambda r: r)
+               for _ in range(4)]
+    group = mpi_ops._GroupHandle(members)
+    start = _time.monotonic()
+    with pytest.raises(TimeoutError):
+        group.wait(timeout=0.5)
+    elapsed = _time.monotonic() - start
+    # pre-fix behavior: first member consumes the full 0.5s, then each
+    # remaining member gets a fresh 0.5s => ~2.0s total
+    assert elapsed < 1.2, f"group wait overshot its deadline: {elapsed:.2f}s"
+    for h in members:  # drop the never-done handles from the manager
+        mpi_ops._handle_manager._handles.pop(h, None)
+
+
+def test_group_wait_drains_completed_members_after_deadline():
+    """An expired deadline must still collect members that already
+    completed (wait(0) on a done member is free) instead of failing a
+    fully-finished group."""
+    import time as _time
+
+    from horovod_tpu.torch import mpi_ops
+
+    class _SlowButDone:
+        def __init__(self, delay):
+            self._delay = delay
+
+        def poll(self):
+            return True
+
+        def wait(self, timeout=None):
+            _time.sleep(self._delay)
+            return "ok"
+
+    # member 0 eats essentially the whole budget; member 1 is instant —
+    # the group must still succeed
+    members = [mpi_ops._register(_SlowButDone(0.5), lambda r: r),
+               mpi_ops._register(_SlowButDone(0.0), lambda r: r)]
+    group = mpi_ops._GroupHandle(members)
+    assert group.wait(timeout=0.5) == ["ok", "ok"]
+
+
+def test_group_wait_memoizes_terminal_error_across_retries():
+    """A partial failure with a still-pending member must stay RETRYABLE
+    (TimeoutError through the manager keeps the group registered), and
+    once the group drains, the retry replays the memoized terminal error
+    instead of hitting 'unknown handle' (the manager pops member entries
+    on terminal failure)."""
+    import time as _time
+
+    import pytest
+
+    from horovod_tpu.torch import mpi_ops
+
+    class _Fails:
+        def poll(self):
+            return True
+
+        def wait(self, timeout=None):
+            raise RuntimeError("collective exploded")
+
+    class _DoneOnSecondTry:
+        def __init__(self):
+            self.calls = 0
+
+        def poll(self):
+            return self.calls > 0
+
+        def wait(self, timeout=None):
+            self.calls += 1
+            if self.calls == 1:
+                assert timeout is not None
+                _time.sleep(min(timeout, 5.0))
+                raise TimeoutError("still pending")
+            return "late"
+
+    members = [mpi_ops._register(_Fails(), lambda r: r),
+               mpi_ops._register(_DoneOnSecondTry(), lambda r: r)]
+    group_id = mpi_ops._handle_manager.allocate(
+        mpi_ops._GroupHandle(members))
+    # member 0 fails terminally, member 1 is pending at the deadline:
+    # the group must raise TIMEOUT (retryable) — a terminal raise here
+    # would pop the group entry and strand member 1's handle forever
+    with pytest.raises(TimeoutError):
+        mpi_ops._handle_manager.wait(group_id, timeout=0.3)
+    # retry through the manager: member 1 drains, then the memoized
+    # terminal error surfaces (not ValueError("unknown handle"))
+    with pytest.raises(RuntimeError, match="collective exploded"):
+        mpi_ops._handle_manager.wait(group_id, timeout=0.3)
