@@ -7,6 +7,10 @@ the same Store/Params/Estimator shape, so a Spark backend is one subclass
 away."""
 
 from horovod_tpu.cluster.store import LocalStore, Store  # noqa: F401
+from horovod_tpu.cluster.parquet_store import (  # noqa: F401
+    FilesystemStore,
+    ParquetStore,
+)
 from horovod_tpu.cluster.backend import (  # noqa: F401
     Backend,
     InProcessBackend,

@@ -22,16 +22,20 @@ def _default_loss(preds, y):
 
 
 def _train_one_rank(rank, model, loss_fn, store, epochs, batch_size,
-                    learning_rate, seed):
-    """Runs inside a rank context (thread or process)."""
+                    learning_rate, seed, num_ranks):
+    """Runs inside a rank context (thread or process).  ``num_ranks`` is
+    the backend's process count — the shard partition the dataset was
+    materialized for (NOT hvd.size(), which can exceed it in multi-host
+    device-rank mode and would silently drop row groups)."""
     import jax
     import jax.numpy as jnp
     import optax
 
     import horovod_tpu as hvd
+    from horovod_tpu.cluster.store import load_rank_shard
     from horovod_tpu.utils import checkpoint as ckpt
 
-    shard = store.load_shard(rank)
+    shard = load_rank_shard(store, rank, num_ranks)
     x, y = shard["x"], shard["y"]
 
     params = model.init(jax.random.PRNGKey(seed), jnp.asarray(x[:1]))
@@ -92,8 +96,11 @@ def _train_spmd(model, loss_fn, store, epochs, batch_size, learning_rate,
     from horovod_tpu.parallel._compat import shard_map
     from horovod_tpu.utils import checkpoint as ckpt
 
+    from horovod_tpu.cluster.store import load_rank_shard
+
     mesh = hvd.mesh()
-    shards = [store.load_shard(r) for r in range(num_ranks)]
+    shards = [load_rank_shard(store, r, num_ranks)
+              for r in range(num_ranks)]
     per = min(len(s["x"]) for s in shards)
 
     params = model.init(jax.random.PRNGKey(seed),
@@ -207,7 +214,7 @@ class JaxEstimator:
             metrics = backend.run(
                 _train_one_rank,
                 args=(self.model, self.loss, store, self.epochs,
-                      self.batch_size, self.learning_rate, self.seed))
+                      self.batch_size, self.learning_rate, self.seed, n))
 
         from horovod_tpu.utils import checkpoint as ckpt
 

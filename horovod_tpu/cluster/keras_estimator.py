@@ -17,14 +17,18 @@ from horovod_tpu.cluster.store import LocalStore
 
 
 def _train_keras_rank(rank, model_config, weights, compile_kwargs,
-                      store, epochs, batch_size, learning_rate):
-    """Runs in a worker process (ProcessBackend) or rank thread."""
+                      store, epochs, batch_size, learning_rate,
+                      num_ranks):
+    """Runs in a worker process (ProcessBackend) or rank thread.
+    ``num_ranks`` is the shard partition the dataset was materialized
+    for (the backend's process count, NOT hvd.size())."""
     import keras
 
     import horovod_tpu.keras as hvd_keras
+    from horovod_tpu.cluster.store import load_rank_shard
 
     model = keras.saving.deserialize_keras_object(model_config)
-    shard = store.load_shard(rank)
+    shard = load_rank_shard(store, rank, num_ranks)
     x, y = shard["x"], shard["y"]
     if not model.built:
         model.build((None,) + tuple(np.asarray(x).shape[1:]))
@@ -114,7 +118,7 @@ class KerasEstimator:
         metrics = backend.run(
             _train_keras_rank,
             args=(model_config, weights, compile_kwargs, store,
-                  self.epochs, self.batch_size, self.learning_rate))
+                  self.epochs, self.batch_size, self.learning_rate, n))
 
         trained = keras.saving.deserialize_keras_object(model_config)
         if not trained.built:

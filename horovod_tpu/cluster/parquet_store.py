@@ -1,0 +1,360 @@
+"""Parquet/Arrow shard store — the reference's estimator data path
+(DataFrame -> Parquet intermediate store -> per-rank sharded reads),
+redesigned TPU-first.
+
+Reference: ``horovod/spark/common/store.py:30,149`` (``Store`` /
+``FilesystemStore`` / ``HDFSStore`` with ``intermediate_train_data`` /
+``intermediate_val_data`` Parquet directories), consumed per rank by
+``horovod/spark/keras/remote.py`` via Petastorm readers with
+``cur_shard=hvd.rank(), shard_count=hvd.size()``.
+
+TPU-first redesign (NOT a Petastorm translation):
+
+- **Row groups are the sharding unit.**  The dataset is written with many
+  equal-size row groups; rank *r* of *n* owns row groups where
+  ``rg % n == r``.  Shard selection is **metadata-only** — a rank reads
+  the footer, picks its groups, and streams exactly those byte ranges;
+  no rank ever touches another rank's rows (the reference gets the same
+  property from Petastorm's ``cur_shard``/``shard_count`` row-group
+  filter).
+- **Static shapes end to end.**  Tensor columns (ndim >= 2) are stored as
+  Arrow ``FixedSizeList`` with the trailing shape recorded in file
+  metadata, so every rank rebuilds dense C-contiguous numpy arrays of
+  identical static shape — these feed ``jax.device_put`` directly and
+  never trigger an XLA recompile from shape drift.
+- **Equal shards by construction.**  Per-shard row counts are computed
+  from footer metadata alone and every shard trims to the global
+  minimum, so all ranks run identical per-epoch step counts (unequal
+  shards would pair gradients from different steps in the name-matched
+  eager exchange, then deadlock on the remainder).
+- dtypes round-trip exactly: the source numpy dtype of every column is
+  recorded in metadata and restored on read (bfloat16 — which Parquet
+  cannot hold — travels as float32 and is cast back on the way out).
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+
+from horovod_tpu.cluster.store import Store
+
+_TRAIN_DIR = "intermediate_train_data"
+_VAL_DIR = "intermediate_val_data"
+_PART = "part-00000.parquet"
+_META_PREFIX = "hvd_tpu."
+
+
+def _require_pyarrow():
+    try:
+        import pyarrow  # noqa: F401
+        import pyarrow.parquet  # noqa: F401
+    except ImportError as exc:  # pragma: no cover - image always has it
+        raise ImportError(
+            "ParquetStore requires pyarrow; install it or use LocalStore "
+            "(npz shards)") from exc
+
+
+class ParquetStore(Store):
+    """Filesystem Parquet store with per-rank disjoint row-group reads.
+
+    ``rows_per_row_group`` fixes the sharding granularity at write time;
+    the default targets ``default_row_groups`` groups (64 — divides
+    evenly across 2/4/8/16/32-rank jobs) with at least one row each.
+    """
+
+    #: default number of row groups a materialized split aims for
+    default_row_groups = 64
+
+    def __init__(self, prefix_path, rows_per_row_group=None):
+        _require_pyarrow()
+        self.prefix_path = prefix_path
+        self.rows_per_row_group = rows_per_row_group
+        os.makedirs(prefix_path, exist_ok=True)
+
+    # ------------------------------------------------------------- paths --
+    def train_data_path(self, idx=None):
+        d = _TRAIN_DIR if idx is None else f"{_TRAIN_DIR}.{idx}"
+        return os.path.join(self.prefix_path, d)
+
+    def val_data_path(self, idx=None):
+        d = _VAL_DIR if idx is None else f"{_VAL_DIR}.{idx}"
+        return os.path.join(self.prefix_path, d)
+
+    def runs_path(self):
+        return os.path.join(self.prefix_path, "runs")
+
+    def run_path(self, run_id):
+        return os.path.join(self.runs_path(), str(run_id))
+
+    def checkpoint_path(self, run_id=None):
+        if run_id is None:
+            return os.path.join(self.prefix_path, "checkpoints")
+        return os.path.join(self.run_path(run_id), "checkpoints")
+
+    def logs_path(self, run_id):
+        return os.path.join(self.run_path(run_id), "logs")
+
+    def exists(self, path):
+        return os.path.exists(path)
+
+    # ------------------------------------------------- dataset inspection --
+    def is_parquet_dataset(self, path):
+        return os.path.isfile(os.path.join(path, _PART))
+
+    def get_parquet_dataset(self, path):
+        import pyarrow.parquet as pq
+
+        return pq.ParquetFile(os.path.join(path, _PART))
+
+    # --------------------------------------------------------- write path --
+    def materialize(self, data, validation=None, idx=None,
+                    rows_per_row_group=None, num_ranks=None):
+        """Write ``data`` (a ``{column: ndarray}`` dict or a pandas
+        DataFrame) as the train split — and ``validation`` likewise as
+        the val split — each a Parquet dataset cut into many equal row
+        groups (the reference analog: ``prepare_data`` materializing the
+        DataFrame with ``df.repartition``).  Returns the train path.
+
+        Granularity: an explicit ``rows_per_row_group`` (argument or the
+        store's configured value) wins; otherwise ``num_ranks`` sizes
+        groups fine enough that every rank gets several and the
+        equal-shard trim stays small."""
+        if rows_per_row_group is None and self.rows_per_row_group is None \
+                and num_ranks:
+            n = len(next(iter(data.values()))) if isinstance(data, dict) \
+                else len(data)
+            rows_per_row_group = max(1, n // max(
+                num_ranks * 8, self.default_row_groups))
+        train = self._write_split(self.train_data_path(idx), data,
+                                  rows_per_row_group)
+        if validation is not None:
+            self._write_split(self.val_data_path(idx), validation,
+                              rows_per_row_group)
+        return train
+
+    def _write_split(self, path, data, rows_per_row_group=None):
+        import pyarrow.parquet as pq
+
+        table, schema, n, per_group = self._build_table(
+            data, rows_per_row_group)
+        os.makedirs(path, exist_ok=True)
+        tmp = os.path.join(path, _PART + ".tmp")
+        with pq.ParquetWriter(tmp, schema) as writer:
+            for start in range(0, n, per_group):
+                writer.write_table(table.slice(start, per_group))
+        os.replace(tmp, os.path.join(path, _PART))
+        with open(os.path.join(path, "_SUCCESS"), "w"):
+            pass  # completion marker, mirrors the Spark output contract
+        return path
+
+    def _build_table(self, data, rows_per_row_group=None):
+        import pyarrow as pa
+
+        if hasattr(data, "to_dict") and hasattr(data, "columns"):
+            data = {c: np.asarray(data[c]) for c in data.columns}
+        if not data:
+            raise ValueError("empty dataset")
+        n_rows = {k: len(v) for k, v in data.items()}
+        if len(set(n_rows.values())) != 1:
+            raise ValueError(f"column lengths differ: {n_rows}")
+        n = next(iter(n_rows.values()))
+        if n == 0:
+            raise ValueError("dataset has zero rows")
+
+        fields, arrays, meta = [], [], {}
+        for name, col in data.items():
+            col = np.asarray(col)
+            meta[f"{_META_PREFIX}dtype.{name}"] = str(col.dtype)
+            meta[f"{_META_PREFIX}shape.{name}"] = json.dumps(
+                list(col.shape[1:]))
+            if col.dtype == np.dtype("float16") or col.dtype.name == \
+                    "bfloat16":
+                col = col.astype(np.float32)  # parquet-safe carrier
+            if col.ndim == 1:
+                arr = pa.array(col)
+            else:
+                flat = np.ascontiguousarray(col).reshape(len(col), -1)
+                values = pa.array(flat.ravel())
+                arr = pa.FixedSizeListArray.from_arrays(
+                    values, flat.shape[1])
+            arrays.append(arr)
+            fields.append(pa.field(name, arr.type))
+
+        schema = pa.schema(fields, metadata={
+            k.encode(): str(v).encode() for k, v in meta.items()})
+        table = pa.Table.from_arrays(arrays, schema=schema)
+
+        per_group = rows_per_row_group or self.rows_per_row_group or max(
+            1, math.ceil(n / self.default_row_groups))
+        return table, schema, n, per_group
+
+    # ---------------------------------------------------------- read path --
+    def shard_row_counts(self, shard_count, split="train", idx=None,
+                         parquet_file=None):
+        """Per-shard row counts from footer metadata ALONE (no data
+        reads) — every rank derives the same global minimum.  Pass an
+        already-open ``parquet_file`` to reuse its footer instead of
+        re-opening the dataset."""
+        pf = parquet_file or self._open(split, idx)
+        counts = [0] * shard_count
+        for rg in range(pf.metadata.num_row_groups):
+            counts[rg % shard_count] += pf.metadata.row_group(rg).num_rows
+        return counts
+
+    def read_shard(self, cur_shard, shard_count, split="train", idx=None,
+                   columns=None, trim_to_min=True):
+        """Read THIS rank's disjoint row groups (``rg % shard_count ==
+        cur_shard``) and return ``{column: ndarray}`` with original
+        dtypes/shapes restored (reference:
+        ``horovod/spark/keras/remote.py`` — ``cur_shard=hvd.rank(),
+        shard_count=hvd.size()``)."""
+        if not 0 <= cur_shard < shard_count:
+            raise ValueError(
+                f"cur_shard {cur_shard} outside [0, {shard_count})")
+        pf = self._open(split, idx)
+        mine = [rg for rg in range(pf.metadata.num_row_groups)
+                if rg % shard_count == cur_shard]
+        counts = self.shard_row_counts(shard_count, split, idx,
+                                       parquet_file=pf)
+        min_rows = min(counts)
+        if min_rows == 0:
+            raise ValueError(
+                f"shard {counts.index(0)} of {shard_count} would be "
+                f"empty ({pf.metadata.num_row_groups} row groups, "
+                f"{pf.metadata.num_rows} rows) — rewrite with smaller "
+                f"rows_per_row_group or fewer ranks")
+        table = pf.read_row_groups(mine, columns=columns)
+        limit = min_rows if trim_to_min else table.num_rows
+        if trim_to_min and table.num_rows > limit:
+            from horovod_tpu.utils.logging import get_logger
+
+            get_logger().warning(
+                "shard %d/%d trims %d of %d rows to match the smallest "
+                "shard (%d rows) — rewrite with smaller "
+                "rows_per_row_group to reduce the loss",
+                cur_shard, shard_count, table.num_rows - limit,
+                table.num_rows, limit)
+        return self._to_numpy(table, pf.schema_arrow.metadata, limit)
+
+    def _open(self, split, idx):
+        path = {"train": self.train_data_path,
+                "val": self.val_data_path}[split](idx)
+        return self.get_parquet_dataset(path)
+
+    @staticmethod
+    def _to_numpy(table, metadata, limit):
+        metadata = metadata or {}
+        out = {}
+        for name in table.column_names:
+            col = table.column(name).combine_chunks()
+            shape_key = f"{_META_PREFIX}shape.{name}".encode()
+            dtype_key = f"{_META_PREFIX}dtype.{name}".encode()
+            trailing = json.loads(metadata.get(shape_key, b"[]"))
+            import pyarrow as pa
+
+            if isinstance(col.type, pa.FixedSizeListType):
+                arr = np.asarray(col.values)
+                arr = arr.reshape(len(col), *trailing) if trailing else \
+                    arr.reshape(len(col), -1)
+            else:
+                arr = np.asarray(col)
+            want = metadata.get(dtype_key)
+            if want is not None:
+                want = want.decode()
+                if arr.dtype.name != want:
+                    if want == "bfloat16":
+                        import ml_dtypes
+
+                        arr = arr.astype(ml_dtypes.bfloat16)
+                    else:
+                        arr = arr.astype(want)
+            out[name] = np.ascontiguousarray(arr[:limit])
+        return out
+
+    # --------------------------------------- legacy shard-file protocol --
+    # ParquetStore is also a drop-in Store for the npz per-rank protocol
+    # so existing callers (checkpoint-only use) keep working.
+    def save_shard(self, rank, arrays):
+        raise NotImplementedError(
+            "ParquetStore shards by row group — use materialize() + "
+            "read_shard() (per-rank npz files are the LocalStore "
+            "protocol)")
+
+    def load_shard(self, rank):
+        raise NotImplementedError(
+            "ParquetStore shards by row group — use read_shard(rank, n)")
+
+
+class FilesystemStore(ParquetStore):
+    """ParquetStore over a ``pyarrow.fs`` URI — the HDFS/S3 analog of the
+    reference's ``HDFSStore`` (``store.py:149``).  The data path runs
+    through the pyarrow filesystem; ``sync_run_dir`` uploads a local run
+    directory (checkpoints/logs) into the store the way the reference's
+    ``sync_fn`` pushes local output to HDFS.
+
+    With a ``file://`` URI this is exercised end-to-end in tests; hdfs://
+    and s3:// work wherever the corresponding pyarrow filesystem is
+    available in the runtime (none are reachable in this image).
+    """
+
+    def __init__(self, prefix_url, rows_per_row_group=None):
+        from pyarrow import fs as pafs
+
+        self._fs, prefix = pafs.FileSystem.from_uri(prefix_url)
+        self.prefix_url = prefix_url
+        if isinstance(self._fs, pafs.LocalFileSystem):
+            super().__init__(prefix, rows_per_row_group)
+        else:  # pragma: no cover - no remote fs reachable in this image
+            _require_pyarrow()
+            self.prefix_path = prefix
+            self.rows_per_row_group = rows_per_row_group
+            self._fs.create_dir(prefix, recursive=True)
+
+    def exists(self, path):
+        from pyarrow import fs as pafs
+
+        return self._fs.get_file_info(path).type != pafs.FileType.NotFound
+
+    def get_parquet_dataset(self, path):
+        import pyarrow.parquet as pq
+
+        return pq.ParquetFile(os.path.join(path, _PART),
+                              filesystem=self._fs)
+
+    def is_parquet_dataset(self, path):
+        return self.exists(os.path.join(path, _PART))
+
+    def _write_split(self, path, data, rows_per_row_group=None):
+        from pyarrow import fs as pafs
+
+        if isinstance(self._fs, pafs.LocalFileSystem):
+            return super()._write_split(path, data, rows_per_row_group)
+        # remote object stores have no atomic rename: write straight to
+        # the final name, then the _SUCCESS marker
+        import pyarrow.parquet as pq  # pragma: no cover - needs remote fs
+
+        table, schema, n, per_group = self._build_table(
+            data, rows_per_row_group)
+        self._fs.create_dir(path, recursive=True)
+        with pq.ParquetWriter(os.path.join(path, _PART), schema,
+                              filesystem=self._fs) as writer:
+            for start in range(0, n, per_group):
+                writer.write_table(table.slice(start, per_group))
+        with self._fs.open_output_stream(
+                os.path.join(path, "_SUCCESS")):
+            pass
+        return path
+
+    def sync_run_dir(self, local_dir, run_id):
+        """Recursively copy a local run directory into the store
+        (reference: ``Store.sync_fn`` — local training output pushed to
+        the remote store after each epoch)."""
+        from pyarrow import fs as pafs
+
+        dest = self.run_path(run_id)
+        self._fs.create_dir(dest, recursive=True)
+        pafs.copy_files(local_dir, dest,
+                        destination_filesystem=self._fs)
+        return dest

@@ -64,6 +64,16 @@ class LocalStore(Store):
         return os.path.exists(path)
 
 
+def load_rank_shard(store, rank, size):
+    """Rank-side shard fetch across both store protocols: disjoint
+    row-group reads on a sharded-dataset store (ParquetStore —
+    ``cur_shard=rank, shard_count=size``, the reference's Petastorm
+    reader contract), per-rank npz files otherwise."""
+    if hasattr(store, "read_shard"):
+        return store.read_shard(cur_shard=rank, shard_count=size)
+    return store.load_shard(rank)
+
+
 def materialize_shards(store, x, y, num_ranks):
     """Split (x, y) into per-rank shards and persist them to the store
     (the common front half of every estimator's ``fit``; reference: the
@@ -77,6 +87,13 @@ def materialize_shards(store, x, y, num_ranks):
         raise ValueError(
             f"need at least one sample per rank ({num_ranks}), "
             f"got {len(x)}")
+    if hasattr(store, "materialize"):
+        # sharded-dataset store: ONE dataset, ranks read disjoint
+        # partitions — per-rank equality comes from the reader's
+        # metadata-driven min-trim, not from pre-splitting.  The store
+        # owns its partition-granularity policy; num_ranks is the hint.
+        store.materialize({"x": x, "y": y}, num_ranks=num_ranks)
+        return x, y
     # EQUAL shard lengths: uneven shards would give ranks different
     # per-epoch step counts, silently pairing gradients from different
     # optimization steps in the name-matched eager exchange and then

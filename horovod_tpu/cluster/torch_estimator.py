@@ -10,14 +10,15 @@ from horovod_tpu.cluster.store import LocalStore
 
 
 def _train_one_rank(rank, model_factory, loss_name, store, epochs,
-                    batch_size, learning_rate):
+                    batch_size, learning_rate, num_ranks):
     import torch
 
     import horovod_tpu.torch as hvd
+    from horovod_tpu.cluster.store import load_rank_shard
 
     model = model_factory()
     loss_fn = getattr(torch.nn.functional, loss_name)
-    shard = store.load_shard(rank)
+    shard = load_rank_shard(store, rank, num_ranks)
     x = torch.tensor(shard["x"], dtype=torch.float32)
     y = torch.tensor(shard["y"])
     if y.dtype == torch.float64:
@@ -114,7 +115,7 @@ class TorchEstimator:
         metrics = backend.run(
             _train_one_rank,
             args=(self.model_factory, self.loss, store, self.epochs,
-                  self.batch_size, self.learning_rate))
+                  self.batch_size, self.learning_rate, n))
 
         model = self.model_factory()
         model.load_state_dict(torch.load(

@@ -1,0 +1,227 @@
+"""Parquet/Arrow shard store: per-rank disjoint row-group reads
+(reference: ``horovod/spark/common/store.py:30,149`` Parquet
+intermediate store + ``horovod/spark/keras/remote.py`` Petastorm reader
+wiring with ``cur_shard=rank, shard_count=size``; VERDICT r3 item 3)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.cluster import FilesystemStore, ParquetStore
+
+
+def _make_store(tmp_path, n=100, rows_per_group=7, extra=None):
+    store = ParquetStore(str(tmp_path), rows_per_row_group=rows_per_group)
+    data = {
+        "row_id": np.arange(n, dtype=np.int64),
+        "x": np.arange(n * 6, dtype=np.float32).reshape(n, 2, 3),
+        "y": (np.arange(n) % 5).astype(np.int32),
+    }
+    if extra:
+        data.update(extra)
+    store.materialize(data)
+    return store, data
+
+
+def test_roundtrip_shapes_and_dtypes(tmp_path):
+    store, data = _make_store(tmp_path)
+    out = store.read_shard(0, 1)
+    assert out["x"].shape == (100, 2, 3)
+    assert out["x"].dtype == np.float32
+    assert out["y"].dtype == np.int32
+    assert out["row_id"].dtype == np.int64
+    np.testing.assert_array_equal(out["x"], data["x"])
+    np.testing.assert_array_equal(out["y"], data["y"])
+
+
+def test_shards_are_disjoint_and_cover(tmp_path):
+    """The core contract: ranks read DISJOINT row groups whose union is
+    the dataset (minus the equal-shard trim)."""
+    store, _ = _make_store(tmp_path, n=100, rows_per_group=7)
+    n_shards = 4
+    ids = [store.read_shard(r, n_shards, trim_to_min=False)["row_id"]
+           for r in range(n_shards)]
+    sets = [set(map(int, s)) for s in ids]
+    for a in range(n_shards):
+        for b in range(a + 1, n_shards):
+            assert not sets[a] & sets[b], (a, b)
+    assert set().union(*sets) == set(range(100))
+
+
+def test_equal_shard_trim(tmp_path):
+    """100 rows / groups of 7 = 15 groups (last short): shard row counts
+    differ pre-trim, so every shard trims to the metadata-global min and
+    all ranks run identical step counts."""
+    store, _ = _make_store(tmp_path, n=100, rows_per_group=7)
+    counts = store.shard_row_counts(4)
+    assert sum(counts) == 100
+    assert len(set(counts)) > 1  # genuinely uneven pre-trim
+    shards = [store.read_shard(r, 4) for r in range(4)]
+    lens = {len(s["row_id"]) for s in shards}
+    assert lens == {min(counts)}
+
+
+def test_metadata_counts_match_actual_reads(tmp_path):
+    store, _ = _make_store(tmp_path, n=53, rows_per_group=5)
+    counts = store.shard_row_counts(3)
+    for r in range(3):
+        got = store.read_shard(r, 3, trim_to_min=False)
+        assert len(got["row_id"]) == counts[r]
+
+
+def test_empty_shard_raises(tmp_path):
+    store = ParquetStore(str(tmp_path))
+    store.materialize({"x": np.arange(4, dtype=np.float32)},
+                      rows_per_row_group=2)  # only 2 row groups
+    with pytest.raises(ValueError, match="empty"):
+        store.read_shard(0, 4)
+
+
+def test_val_split_and_columns(tmp_path):
+    store = ParquetStore(str(tmp_path), rows_per_row_group=4)
+    store.materialize(
+        {"x": np.ones((32, 3), np.float32), "y": np.zeros(32, np.int32)},
+        validation={"x": np.full((16, 3), 2.0, np.float32),
+                    "y": np.ones(16, np.int32)})
+    val = store.read_shard(0, 2, split="val")
+    assert val["x"][0, 0] == 2.0
+    only_y = store.read_shard(0, 2, columns=["y"])
+    assert set(only_y) == {"y"}
+    assert store.is_parquet_dataset(store.train_data_path())
+    assert store.is_parquet_dataset(store.val_data_path())
+
+
+def test_bfloat16_roundtrip(tmp_path):
+    import ml_dtypes
+
+    store = ParquetStore(str(tmp_path), rows_per_row_group=8)
+    x = np.arange(32, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    store.materialize({"x": x.reshape(16, 2)})
+    out = store.read_shard(0, 2)
+    assert out["x"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        out["x"].astype(np.float32),
+        x.reshape(16, 2)[:len(out["x"])].astype(np.float32))
+
+
+def test_pandas_dataframe_input(tmp_path):
+    pd = pytest.importorskip("pandas")
+    store = ParquetStore(str(tmp_path), rows_per_row_group=5)
+    df = pd.DataFrame({"a": np.arange(20, dtype=np.float64),
+                       "b": np.arange(20, dtype=np.int64)})
+    store.materialize(df)
+    out = store.read_shard(1, 2)
+    assert out["a"].dtype == np.float64
+    assert len(out["a"]) == 10
+
+
+def test_column_length_mismatch_raises(tmp_path):
+    store = ParquetStore(str(tmp_path))
+    with pytest.raises(ValueError, match="lengths differ"):
+        store.materialize({"x": np.ones(4), "y": np.ones(5)})
+
+
+def test_filesystem_store_file_uri(tmp_path):
+    """FilesystemStore over a file:// URI — the HDFS/S3-analog API
+    (reference: HDFSStore, store.py:149) exercised on the local
+    pyarrow filesystem."""
+    store = FilesystemStore(f"file://{tmp_path}/fsstore",
+                            rows_per_row_group=4)
+    store.materialize({"x": np.arange(24, dtype=np.float32)})
+    out = store.read_shard(1, 3)
+    assert out["x"].dtype == np.float32 and len(out["x"]) == 8
+    # sync_fn analog: push a local run dir into the store
+    local = tmp_path / "local_run"
+    local.mkdir()
+    (local / "ckpt.bin").write_bytes(b"\x00" * 16)
+    dest = store.sync_run_dir(str(local), run_id="run1")
+    assert store.exists(f"{dest}/ckpt.bin")
+
+
+def test_run_paths(tmp_path):
+    store = ParquetStore(str(tmp_path))
+    assert store.checkpoint_path("r1").endswith("runs/r1/checkpoints")
+    assert store.logs_path("r1").endswith("runs/r1/logs")
+    assert store.checkpoint_path().endswith("checkpoints")
+
+
+# ------------------------------------------------- estimator integration ---
+
+def test_jax_estimator_fits_from_parquet(hvd, tmp_path):
+    """The VERDICT 'done' bar: an estimator fit where ranks read
+    disjoint row groups of ONE Parquet dataset."""
+    from horovod_tpu.cluster import JaxEstimator
+    from horovod_tpu.models import MLP
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    w = rng.randn(8, 4).astype(np.float32)
+    y = x @ w
+
+    est = JaxEstimator(MLP(features=(16, 4)), epochs=5, batch_size=8,
+                       learning_rate=0.05,
+                       store=ParquetStore(str(tmp_path)))
+    fitted, metrics = est.fit(x, y)
+    assert len(metrics) == 8
+    baseline = float(np.mean((y - y.mean(0)) ** 2))
+    assert fitted.evaluate(x, y) < baseline
+    # the dataset really is a sharded Parquet dataset, not npz files
+    assert est.store.is_parquet_dataset(est.store.train_data_path())
+
+
+def test_torch_estimator_fits_from_parquet(hvd, tmp_path):
+    import torch
+
+    from horovod_tpu.cluster import TorchEstimator
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(64, 6).astype(np.float32)
+    w = rng.randn(6, 2).astype(np.float32)
+    y = x @ w
+
+    est = TorchEstimator(
+        lambda: torch.nn.Sequential(torch.nn.Linear(6, 16),
+                                    torch.nn.ReLU(),
+                                    torch.nn.Linear(16, 2)),
+        epochs=5, batch_size=8, learning_rate=0.05,
+        store=ParquetStore(str(tmp_path)))
+    fitted, metrics = est.fit(x, y)
+    assert len(metrics) == 8
+    baseline = float(np.mean((y - y.mean(0)) ** 2))
+    assert fitted.evaluate(x, y) < baseline
+
+
+def test_jax_estimator_parquet_process_backend(tmp_path):
+    """2 OS processes each reading THEIR disjoint row groups from the
+    shared Parquet store (the reference's actual deployment shape:
+    Spark executors + shared FS store)."""
+    from horovod_tpu.cluster import JaxEstimator
+    from horovod_tpu.cluster.backend import ProcessBackend
+    from horovod_tpu.models import MLP
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(64, 8).astype(np.float32)
+    w = rng.randn(8, 4).astype(np.float32)
+    y = x @ w + 0.01 * rng.randn(64, 4).astype(np.float32)
+
+    est = JaxEstimator(MLP(features=(16, 4)), epochs=5, batch_size=8,
+                       learning_rate=0.05,
+                       store=ParquetStore(str(tmp_path)),
+                       backend=ProcessBackend(2, jax_platform="cpu"))
+    fitted, metrics = est.fit(x, y)
+    assert len(metrics) == 2
+    baseline = float(np.mean((y - y.mean(0)) ** 2))
+    assert fitted.evaluate(x, y) < baseline
+
+
+def test_configured_row_group_size_honored_by_estimator_path(tmp_path):
+    """A rows_per_row_group set on the store must survive
+    materialize_shards (review finding: the computed default silently
+    overrode the user's sharding-granularity knob)."""
+    from horovod_tpu.cluster.store import materialize_shards
+
+    store = ParquetStore(str(tmp_path), rows_per_row_group=4)
+    x = np.arange(64 * 2, dtype=np.float32).reshape(64, 2)
+    y = np.zeros(64, np.int32)
+    materialize_shards(store, x, y, num_ranks=2)
+    pf = store.get_parquet_dataset(store.train_data_path())
+    assert pf.metadata.num_row_groups == 16  # 64 rows / 4 per group
