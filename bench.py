@@ -14,7 +14,11 @@ Also reports (in the same JSON object, under ``extra``):
   - ``allreduce_gbs``: eager-path ``hvd.allreduce`` algorithmic
     bandwidth (GB/s) swept over payload sizes 1KB..256MB — the
     framework-overhead oracle that autotune tunes against (reference:
-    ``docs/benchmarks.rst:31-43``).
+    ``docs/benchmarks.rst:31-43``).  Two legs: the legacy numpy
+    round-trip (host -> device -> psum -> device -> host each call) and
+    ``allreduce_gbs_device``, the device-resident path (jax.Array in /
+    jax.Array out, one host sync at the end) — the honest measure of
+    the eager plane once data lives on device.
 
 Structure: running ``python bench.py`` starts a supervisor that retries
 the actual measurement in a fresh subprocess (``--worker``), because a
@@ -272,11 +276,13 @@ def _bench_allreduce_bandwidth():
 
     def sweep(rank=0):
         out = {}
+        out_device = {}
         for nbytes in sizes:
             n_elem = nbytes // 4
             x = np.ones((n_elem,), np.float32)
             # warmup; np.asarray forces the full eager round trip.
-            np.asarray(hvd.allreduce(x, name=f"bw_{nbytes}"))
+            warm = hvd.allreduce(x, name=f"bw_{nbytes}")
+            np.asarray(warm)
             iters = 10 if nbytes <= (1 << 22) else 3
             start = time.perf_counter()
             for _ in range(iters):
@@ -285,7 +291,25 @@ def _bench_allreduce_bandwidth():
             label = (f"{nbytes // (1 << 20)}MB" if nbytes >= (1 << 20)
                      else f"{nbytes // (1 << 10)}KB")
             out[label] = round(nbytes * iters / elapsed / 1e9, 3)
-        return out
+
+            # device-resident leg: the input is the warmup's on-device
+            # result (jax.Array in -> jax.Array out, zero host copies);
+            # Average keeps the chained values stable.  ONE host sync at
+            # the end — the chain's data dependency means the final
+            # np.asarray cannot complete before every step's device work
+            # does (block_until_ready lies on the relayed backend).
+            y = warm
+            start = time.perf_counter()
+            for i in range(iters):
+                y = hvd.allreduce(y, name=f"bwdev_{nbytes}",
+                                  op=hvd.Average)
+            # 4-byte sync: the chain's data dependency forces every
+            # step to finish, without charging a full D2H transfer to
+            # the "zero host copies" leg
+            float(y[0])
+            elapsed = time.perf_counter() - start
+            out_device[label] = round(nbytes * iters / elapsed / 1e9, 3)
+        return out, out_device
 
     if hvd.local_size() > 1:
         # multi-device (e.g. the CPU fallback): every logical rank needs
@@ -345,7 +369,7 @@ def worker():
         transformer = _bench_transformer(devices)
     except Exception as exc:  # never lose the ResNet number to the LM leg
         sys.stderr.write(f"transformer bench failed: {exc!r}\n")
-    allreduce_gbs = _bench_allreduce_bandwidth()
+    allreduce_gbs, allreduce_gbs_device = _bench_allreduce_bandwidth()
     hvd.shutdown()
 
     print(json.dumps({
@@ -361,6 +385,7 @@ def worker():
             "resnet_bs128": bs128,
             "transformer": transformer,
             "allreduce_gbs": allreduce_gbs,
+            "allreduce_gbs_device": allreduce_gbs_device,
         },
     }))
 

@@ -59,6 +59,7 @@ from horovod_tpu.jax_api import (  # noqa: F401
     DistributedOptimizer,
     ShardedDistributedOptimizer,
     broadcast_parameters,
+    broadcast_optimizer_state,
     allreduce_gradients,
     shard_chunk_size,
     sharded_state_wrap,

@@ -70,8 +70,13 @@ def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
         # copy, not a view: capture-at-call semantics — the caller may
         # legally reuse its buffer before the coordinator cycle runs,
         # and different ranks racing that mutation would reduce
-        # inconsistent snapshots (the device path's commit() copies too)
-        committed = _np.array(tensor, copy=True)
+        # inconsistent snapshots.  NOTE the device path's contract is
+        # weaker for MUTABLE framework tensors: jax.Array inputs are
+        # immutable (capture-at-call for free), but a torch tensor
+        # staged zero-copy via DLPack is aliased until the cycle reads
+        # it — do not mutate between an async submit and synchronize
+        # (the reference's adapters have the same rule,
+        # torch/adapter_v2.h:42).
     else:
         committed = state.executor.commit(tensor, basics.rank())
     handle = Handle(name)

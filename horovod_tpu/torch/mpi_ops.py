@@ -30,6 +30,15 @@ _TORCH_NUMPY_FIXUPS = {
 
 _WARNED_NARROW = set()
 
+# dtypes staged ZERO-COPY via DLPack (reference: the C++ adapters enqueue
+# framework tensors without copies, torch/adapter_v2.h:42).  64-bit dtypes
+# stay on the numpy path so the narrow-to-32-bit conversion is explicit;
+# bf16/bool keep their bridges.
+_DLPACK_DTYPES = frozenset({
+    torch.float32, torch.float16, torch.int32, torch.int16, torch.int8,
+    torch.uint8,
+})
+
 
 def _to_jax(tensor: torch.Tensor):
     src = tensor.detach()
@@ -37,6 +46,15 @@ def _to_jax(tensor: torch.Tensor):
     if fixup is not None:
         arr = jnp.asarray(src.to(fixup).numpy()).astype(
             str(src.dtype).replace("torch.", ""))
+    elif src.dtype in _DLPACK_DTYPES:
+        # zero-copy on the common-dtype path: the jax array aliases the
+        # torch storage (CPU->CPU DLPack import).  Same contract as the
+        # reference's adapters: do not mutate the tensor before
+        # synchronize — the data plane reads it when the cycle runs.
+        try:
+            arr = jax.dlpack.from_dlpack(src.contiguous())
+        except Exception:  # noqa: BLE001 — backend without dlpack import
+            arr = jnp.asarray(src.contiguous().numpy())
     else:
         if src.dtype in (torch.int64, torch.float64) \
                 and not jax.config.jax_enable_x64 \

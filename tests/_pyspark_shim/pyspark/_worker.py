@@ -2,7 +2,9 @@
 fresh Python process that never re-imports the driver's ``__main__`` —
 it reads the cloudpickled mapper + partition from a file and writes the
 pickled result back, exactly the serialization boundary real Spark
-executors impose."""
+executors impose.  Installs the current ``TaskContext`` /
+``BarrierTaskContext`` before running the mapper, as real executors
+do."""
 
 import pickle
 import sys
@@ -12,8 +14,24 @@ import traceback
 def main(payload_path, result_path):
     try:
         with open(payload_path, "rb") as f:
-            func, index, items = pickle.loads(f.read())
-        result = ("ok", pickle.dumps(list(func(index, iter(items)))))
+            task = pickle.loads(f.read())
+        # scheduling-delay simulation: SPARK_SHIM_HOLD_TASK=<index> (+
+        # SPARK_SHIM_HOLD_SECS) models a cluster whose last slot frees
+        # late — the driver-side start_timeout watch must catch it
+        import os
+        import time
+
+        if os.environ.get("SPARK_SHIM_HOLD_TASK") == str(task["index"]):
+            time.sleep(float(os.environ.get("SPARK_SHIM_HOLD_SECS", "30")))
+        import pyspark
+
+        cls = (pyspark.BarrierTaskContext if task["barrier"]
+               else pyspark.TaskContext)
+        pyspark.TaskContext._current = cls(
+            task["index"], task["attempt"], task["stage_attempt"],
+            task["num_tasks"], task["workdir"], task["barrier"])
+        result = ("ok", pickle.dumps(
+            list(task["func"](task["index"], iter(task["items"])))))
     except BaseException:  # noqa: BLE001 — report, Spark-style
         result = ("error", traceback.format_exc())
     with open(result_path, "wb") as f:
