@@ -197,7 +197,10 @@ def _bench_transformer(devices):
     cfg = TransformerConfig(
         vocab_size=vocab, n_layers=n_layers, d_model=d_model,
         n_heads=d_model // 128, d_ff=4 * d_model, max_len=seq_len,
-        dtype=jnp.bfloat16)
+        dtype=jnp.bfloat16,
+        # BENCH_LM_REMAT=1 + a bigger BENCH_LM_BATCH: the MFU lever when
+        # activations bound the per-chip batch
+        remat=bool(int(os.environ.get("BENCH_LM_REMAT", "0"))))
     model = Transformer(cfg)
     tokens = np.random.RandomState(0).randint(
         0, vocab, (batch, seq_len))

@@ -21,8 +21,31 @@ def _default_loss(preds, y):
     return jnp.mean((preds - y) ** 2)
 
 
+def _val_loss(params, model, loss_fn, store, rank, num_ranks):
+    """Rank's validation loss over its val shard, averaged across ranks
+    (reference: the estimators' validation pass feeding val_loss into
+    the returned history)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.cluster.store import load_rank_shard
+
+    shard = load_rank_shard(store, rank, num_ranks, split="val")
+    preds = model.apply(params, jnp.asarray(shard["x"]))
+    local = float(loss_fn(preds, jnp.asarray(shard["y"])))
+    rows = float(len(shard["x"]))
+    # row-WEIGHTED global mean: val shards can be uneven
+    # (np.array_split), and a mean-of-shard-means would weight rows
+    # unequally and disagree with the SPMD path's full-set loss
+    total = np.asarray(hvd.allreduce(
+        jnp.asarray([local * rows, rows]), op=hvd.Sum,
+        name="estimator.metric.val_loss"))
+    return float(total[0] / total[1])
+
+
 def _train_one_rank(rank, model, loss_fn, store, epochs, batch_size,
-                    learning_rate, seed, num_ranks):
+                    learning_rate, seed, num_ranks, has_val=False):
     """Runs inside a rank context (thread or process).  ``num_ranks`` is
     the backend's process count — the shard partition the dataset was
     materialized for (NOT hvd.size(), which can exceed it in multi-host
@@ -77,11 +100,15 @@ def _train_one_rank(rank, model, loss_fn, store, epochs, batch_size,
     if rank == 0:
         ckpt.save_checkpoint(store.checkpoint_path(), params, step=0,
                              rank=0)
+    if has_val:
+        return {"loss": avg_loss,
+                "val_loss": _val_loss(params, model, loss_fn, store,
+                                      rank, num_ranks)}
     return avg_loss
 
 
 def _train_spmd(model, loss_fn, store, epochs, batch_size, learning_rate,
-                seed, num_ranks):
+                seed, num_ranks, has_val=False):
     """The SPMD fit path (single process, device-rank mode): ONE jitted
     ``shard_map`` training step over the ``hvd`` mesh — gradients psum
     inside the compiled program instead of per-leaf eager allreduces
@@ -140,6 +167,15 @@ def _train_spmd(model, loss_fn, store, epochs, batch_size, learning_rate,
     avg_loss = float(np.asarray(jax.device_get(loss))) \
         if loss is not None else 0.0
     ckpt.save_checkpoint(store.checkpoint_path(), params, step=0, rank=0)
+    if has_val:
+        # single-process SPMD: evaluate the FULL val set directly
+        val_shards = [load_rank_shard(store, r, num_ranks, split="val")
+                      for r in range(num_ranks)]
+        vx = np.concatenate([s["x"] for s in val_shards])
+        vy = np.concatenate([s["y"] for s in val_shards])
+        val = float(loss_fn(model.apply(params, jnp.asarray(vx)),
+                            jnp.asarray(vy)))
+        return [{"loss": avg_loss, "val_loss": val}] * num_ranks
     return [avg_loss] * num_ranks
 
 
@@ -172,7 +208,8 @@ class JaxEstimator:
     """
 
     def __init__(self, model, loss=None, epochs=1, batch_size=32,
-                 learning_rate=0.01, store=None, backend=None, seed=0):
+                 learning_rate=0.01, store=None, backend=None, seed=0,
+                 validation=None):
         self.model = model
         self.loss = loss or _default_loss
         self.epochs = epochs
@@ -181,6 +218,10 @@ class JaxEstimator:
         self.store = store
         self.backend = backend
         self.seed = seed
+        # float in (0, 1): tail fraction held out as the val split,
+        # reported as val_loss in the metrics (reference:
+        # spark/common/params.py 'validation')
+        self.validation = validation
 
     def fit(self, x, y):
         """Materialize (x, y) shards to the store, train per rank, return
@@ -194,9 +235,15 @@ class JaxEstimator:
         backend = self.backend or InProcessBackend()
         n = backend.num_processes()
 
-        from horovod_tpu.cluster.store import materialize_shards
+        from horovod_tpu.cluster.store import (materialize_shards,
+                                               split_validation)
 
-        x, y = materialize_shards(store, x, y, n)
+        x_val = y_val = None
+        if self.validation is not None:
+            x, y, x_val, y_val = split_validation(x, y, self.validation)
+        x, y = materialize_shards(store, x, y, n, x_val=x_val,
+                                  y_val=y_val)
+        has_val = x_val is not None
 
         use_spmd = False
         if isinstance(backend, InProcessBackend):
@@ -209,12 +256,14 @@ class JaxEstimator:
         if use_spmd:
             metrics = _train_spmd(
                 self.model, self.loss, store, self.epochs,
-                self.batch_size, self.learning_rate, self.seed, n)
+                self.batch_size, self.learning_rate, self.seed, n,
+                has_val)
         else:
             metrics = backend.run(
                 _train_one_rank,
                 args=(self.model, self.loss, store, self.epochs,
-                      self.batch_size, self.learning_rate, self.seed, n))
+                      self.batch_size, self.learning_rate, self.seed, n,
+                      has_val))
 
         from horovod_tpu.utils import checkpoint as ckpt
 

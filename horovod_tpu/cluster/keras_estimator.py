@@ -18,7 +18,7 @@ from horovod_tpu.cluster.store import LocalStore
 
 def _train_keras_rank(rank, model_config, weights, compile_kwargs,
                       store, epochs, batch_size, learning_rate,
-                      num_ranks):
+                      num_ranks, has_val=False):
     """Runs in a worker process (ProcessBackend) or rank thread.
     ``num_ranks`` is the shard partition the dataset was materialized
     for (the backend's process count, NOT hvd.size())."""
@@ -47,15 +47,23 @@ def _train_keras_rank(rank, model_config, weights, compile_kwargs,
         hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
         hvd_keras.callbacks.MetricAverageCallback(),
     ]
+    fit_kwargs = {}
+    if has_val:
+        vs = load_rank_shard(store, rank, num_ranks, split="val")
+        fit_kwargs["validation_data"] = (np.asarray(vs["x"]),
+                                         np.asarray(vs["y"]))
     history = model.fit(np.asarray(x), np.asarray(y),
                         batch_size=batch_size, epochs=epochs,
-                        callbacks=callbacks, verbose=0)
+                        callbacks=callbacks, verbose=0, **fit_kwargs)
 
     if hvd_keras.rank() == 0:
         path = store.checkpoint_path()
         os.makedirs(path, exist_ok=True)
         np.savez(os.path.join(path, "keras_weights.npz"),
                  *model.get_weights())
+    if has_val:
+        return {"loss": float(history.history["loss"][-1]),
+                "val_loss": float(history.history["val_loss"][-1])}
     return float(history.history["loss"][-1])
 
 
@@ -84,7 +92,7 @@ class KerasEstimator:
 
     def __init__(self, model, loss="mse", optimizer="sgd", metrics=None,
                  epochs=1, batch_size=32, learning_rate=0.01, store=None,
-                 backend=None):
+                 backend=None, validation=None):
         self.model = model
         self.loss = loss
         self.optimizer = optimizer
@@ -94,6 +102,7 @@ class KerasEstimator:
         self.learning_rate = learning_rate
         self.store = store
         self.backend = backend
+        self.validation = validation
 
     def fit(self, x, y):
         import tempfile
@@ -106,7 +115,13 @@ class KerasEstimator:
             prefix="hvd_tpu_keras_estimator_"))
         backend = self.backend or InProcessBackend(num_proc=1)
         n = backend.num_processes()
-        x, y = materialize_shards(store, x, y, n)
+        from horovod_tpu.cluster.store import split_validation
+
+        x_val = y_val = None
+        if self.validation is not None:
+            x, y, x_val, y_val = split_validation(x, y, self.validation)
+        x, y = materialize_shards(store, x, y, n, x_val=x_val,
+                                  y_val=y_val)
 
         if not self.model.built:
             self.model.build((None,) + tuple(x.shape[1:]))
@@ -118,7 +133,8 @@ class KerasEstimator:
         metrics = backend.run(
             _train_keras_rank,
             args=(model_config, weights, compile_kwargs, store,
-                  self.epochs, self.batch_size, self.learning_rate, n))
+                  self.epochs, self.batch_size, self.learning_rate, n,
+                  x_val is not None))
 
         trained = keras.saving.deserialize_keras_object(model_config)
         if not trained.built:

@@ -121,17 +121,23 @@ class ParquetStore(Store):
         store's configured value) wins; otherwise ``num_ranks`` sizes
         groups fine enough that every rank gets several and the
         equal-shard trim stays small."""
-        if rows_per_row_group is None and self.rows_per_row_group is None \
-                and num_ranks:
-            n = len(next(iter(data.values()))) if isinstance(data, dict) \
-                else len(data)
-            rows_per_row_group = max(1, n // max(
-                num_ranks * 8, self.default_row_groups))
+        def granularity(split_data):
+            if rows_per_row_group is not None \
+                    or self.rows_per_row_group is not None \
+                    or not num_ranks:
+                return rows_per_row_group
+            n = len(next(iter(split_data.values()))) \
+                if isinstance(split_data, dict) else len(split_data)
+            # per-SPLIT granularity: a small val split sharing the train
+            # split's group size would yield fewer groups than ranks
+            return max(1, n // max(num_ranks * 8,
+                                   self.default_row_groups))
+
         train = self._write_split(self.train_data_path(idx), data,
-                                  rows_per_row_group)
+                                  granularity(data))
         if validation is not None:
             self._write_split(self.val_data_path(idx), validation,
-                              rows_per_row_group)
+                              granularity(validation))
         return train
 
     def _write_split(self, path, data, rows_per_row_group=None):

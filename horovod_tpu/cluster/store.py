@@ -20,10 +20,10 @@ class Store:
     def checkpoint_path(self):
         raise NotImplementedError
 
-    def save_shard(self, rank, arrays):
+    def save_shard(self, rank, arrays, split="train"):
         raise NotImplementedError
 
-    def load_shard(self, rank):
+    def load_shard(self, rank, split="train"):
         raise NotImplementedError
 
     def exists(self, path):
@@ -44,41 +44,72 @@ class LocalStore(Store):
             return base
         return os.path.join(base, f"part_{rank:05d}.npz")
 
+    def val_data_path(self, rank=None):
+        base = os.path.join(self.prefix_path, "intermediate_val_data")
+        if rank is None:
+            return base
+        return os.path.join(base, f"part_{rank:05d}.npz")
+
     def checkpoint_path(self):
         return os.path.join(self.prefix_path, "checkpoints")
 
-    def save_shard(self, rank, arrays):
-        os.makedirs(self.train_data_path(), exist_ok=True)
-        path = self.train_data_path(rank)
+    def _split_base(self, split):
+        return {"train": self.train_data_path,
+                "val": self.val_data_path}[split]
+
+    def save_shard(self, rank, arrays, split="train"):
+        os.makedirs(self._split_base(split)(), exist_ok=True)
+        path = self._split_base(split)(rank)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
         os.replace(tmp, path)
         return path
 
-    def load_shard(self, rank):
-        with np.load(self.train_data_path(rank)) as data:
+    def load_shard(self, rank, split="train"):
+        with np.load(self._split_base(split)(rank)) as data:
             return {k: data[k] for k in data.files}
+
 
     def exists(self, path):
         return os.path.exists(path)
 
 
-def load_rank_shard(store, rank, size):
+def load_rank_shard(store, rank, size, split="train"):
     """Rank-side shard fetch across both store protocols: disjoint
     row-group reads on a sharded-dataset store (ParquetStore —
     ``cur_shard=rank, shard_count=size``, the reference's Petastorm
     reader contract), per-rank npz files otherwise."""
     if hasattr(store, "read_shard"):
-        return store.read_shard(cur_shard=rank, shard_count=size)
-    return store.load_shard(rank)
+        return store.read_shard(cur_shard=rank, shard_count=size,
+                                split=split)
+    return store.load_shard(rank, split=split)
 
 
-def materialize_shards(store, x, y, num_ranks):
+def split_validation(x, y, validation):
+    """The reference's float-validation semantics
+    (``spark/common/params.py``: ``validation`` = split fraction in
+    [0, 1)): hold out the TAIL fraction as the val set."""
+    import numpy as np
+
+    if not 0.0 < validation < 1.0:
+        raise ValueError(
+            f"validation must be a float in (0, 1), got {validation}")
+    n_val = max(1, int(len(x) * validation))
+    if n_val >= len(x):
+        raise ValueError(
+            f"validation={validation} leaves no training rows "
+            f"({len(x)} total)")
+    return (np.asarray(x[:-n_val]), np.asarray(y[:-n_val]),
+            np.asarray(x[-n_val:]), np.asarray(y[-n_val:]))
+
+
+def materialize_shards(store, x, y, num_ranks, x_val=None, y_val=None):
     """Split (x, y) into per-rank shards and persist them to the store
     (the common front half of every estimator's ``fit``; reference: the
     DataFrame->Parquet materialization in ``spark/common/store.py``).
-    Returns the arrays as numpy."""
+    ``(x_val, y_val)`` materializes the validation split alongside.
+    Returns the train arrays as numpy."""
     import numpy as np
 
     x = np.asarray(x)
@@ -87,13 +118,26 @@ def materialize_shards(store, x, y, num_ranks):
         raise ValueError(
             f"need at least one sample per rank ({num_ranks}), "
             f"got {len(x)}")
+    if x_val is not None and len(x_val) < num_ranks:
+        raise ValueError(
+            f"validation split has {len(x_val)} rows — fewer than one "
+            f"per rank ({num_ranks}); lower num_proc or raise the "
+            f"validation fraction")
     if hasattr(store, "materialize"):
         # sharded-dataset store: ONE dataset, ranks read disjoint
         # partitions — per-rank equality comes from the reader's
         # metadata-driven min-trim, not from pre-splitting.  The store
         # owns its partition-granularity policy; num_ranks is the hint.
-        store.materialize({"x": x, "y": y}, num_ranks=num_ranks)
+        val = None if x_val is None else {"x": np.asarray(x_val),
+                                          "y": np.asarray(y_val)}
+        store.materialize({"x": x, "y": y}, validation=val,
+                          num_ranks=num_ranks)
         return x, y
+    if x_val is not None:
+        for rank, (xs, ys) in enumerate(
+                zip(np.array_split(np.asarray(x_val), num_ranks),
+                    np.array_split(np.asarray(y_val), num_ranks))):
+            store.save_shard(rank, {"x": xs, "y": ys}, split="val")
     # EQUAL shard lengths: uneven shards would give ranks different
     # per-epoch step counts, silently pairing gradients from different
     # optimization steps in the name-matched eager exchange and then

@@ -10,7 +10,7 @@ from horovod_tpu.cluster.store import LocalStore
 
 
 def _train_one_rank(rank, model_factory, loss_name, store, epochs,
-                    batch_size, learning_rate, num_ranks):
+                    batch_size, learning_rate, num_ranks, has_val=False):
     import torch
 
     import horovod_tpu.torch as hvd
@@ -53,6 +53,20 @@ def _train_one_rank(rank, model_factory, loss_name, store, epochs,
         os.makedirs(store.checkpoint_path(), exist_ok=True)
         torch.save(model.state_dict(),
                    os.path.join(store.checkpoint_path(), "model.pt"))
+    if has_val:
+        vs = load_rank_shard(store, rank, num_ranks, split="val")
+        vx = torch.tensor(vs["x"], dtype=torch.float32)
+        vy = torch.tensor(vs["y"])
+        if vy.dtype == torch.float64:
+            vy = vy.float()
+        with torch.no_grad():
+            local = float(loss_fn(model(vx), vy))
+        rows = float(len(vx))
+        # row-weighted: val shards can be uneven (np.array_split)
+        total = np.asarray(hvd_core.allreduce(
+            jnp.asarray([local * rows, rows]), op=hvd_core.Sum,
+            name="torch_estimator.metric.val_loss"))
+        return {"loss": avg_loss, "val_loss": float(total[0] / total[1])}
     return avg_loss
 
 
@@ -88,7 +102,7 @@ class TorchEstimator:
 
     def __init__(self, model_factory, loss="mse_loss", epochs=1,
                  batch_size=32, learning_rate=0.01, store=None,
-                 backend=None):
+                 backend=None, validation=None):
         self.model_factory = model_factory
         self.loss = loss
         self.epochs = epochs
@@ -96,6 +110,7 @@ class TorchEstimator:
         self.learning_rate = learning_rate
         self.store = store
         self.backend = backend
+        self.validation = validation
 
     def fit(self, x, y):
         import os
@@ -108,14 +123,20 @@ class TorchEstimator:
         backend = self.backend or InProcessBackend()
         n = backend.num_processes()
 
-        from horovod_tpu.cluster.store import materialize_shards
+        from horovod_tpu.cluster.store import (materialize_shards,
+                                               split_validation)
 
-        x, y = materialize_shards(store, x, y, n)
+        x_val = y_val = None
+        if self.validation is not None:
+            x, y, x_val, y_val = split_validation(x, y, self.validation)
+        x, y = materialize_shards(store, x, y, n, x_val=x_val,
+                                  y_val=y_val)
 
         metrics = backend.run(
             _train_one_rank,
             args=(self.model_factory, self.loss, store, self.epochs,
-                  self.batch_size, self.learning_rate, n))
+                  self.batch_size, self.learning_rate, n,
+                  x_val is not None))
 
         model = self.model_factory()
         model.load_state_dict(torch.load(

@@ -77,6 +77,7 @@ def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
         # it — do not mutate between an async submit and synchronize
         # (the reference's adapters have the same rule,
         # torch/adapter_v2.h:42).
+        committed = _np.array(tensor, copy=True)
     else:
         committed = state.executor.commit(tensor, basics.rank())
     handle = Handle(name)

@@ -47,3 +47,34 @@ def test_models_bf16_params_stay_fp32():
     leaves = jax.tree.leaves(variables["params"])
     assert all(leaf.dtype == jnp.float32 for leaf in leaves), \
         "params must remain fp32 (bf16 is compute dtype only)"
+
+
+def test_transformer_remat_matches_dense():
+    """cfg.remat=True must be numerically identical (same graph, just
+    rematerialized in backward) and differentiable."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models import Transformer, TransformerConfig, lm_loss
+
+    base = dict(vocab_size=128, n_layers=2, d_model=64, n_heads=2,
+                d_ff=128, max_len=32, dtype=jnp.float32)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (2, 32)))
+    m0 = Transformer(TransformerConfig(**base))
+    m1 = Transformer(TransformerConfig(**base, remat=True))
+    params = m0.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def loss(m):
+        def f(p):
+            return lm_loss(m.apply({"params": p}, tokens), tokens)
+        return f
+
+    l0, g0 = jax.value_and_grad(loss(m0))(params)
+    l1, g1 = jax.value_and_grad(loss(m1))(params)
+    assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
