@@ -50,8 +50,8 @@ def _train_keras_rank(rank, model_config, weights, compile_kwargs,
     fit_kwargs = {}
     if has_val:
         vs = load_rank_shard(store, rank, num_ranks, split="val")
-        fit_kwargs["validation_data"] = (np.asarray(vs["x"]),
-                                         np.asarray(vs["y"]))
+        vx, vy = np.asarray(vs["x"]), np.asarray(vs["y"])
+        fit_kwargs["validation_data"] = (vx, vy)
     history = model.fit(np.asarray(x), np.asarray(y),
                         batch_size=batch_size, epochs=epochs,
                         callbacks=callbacks, verbose=0, **fit_kwargs)
@@ -62,8 +62,23 @@ def _train_keras_rank(rank, model_config, weights, compile_kwargs,
         np.savez(os.path.join(path, "keras_weights.npz"),
                  *model.get_weights())
     if has_val:
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd_core
+
+        local = model.evaluate(vx, vy, batch_size=batch_size, verbose=0)
+        if isinstance(local, (list, tuple)):
+            local = local[0]
+        rows = float(len(vx))
+        # row-weighted global mean, matching the jax/torch estimators:
+        # val shards can be uneven (np.array_split) and the
+        # MetricAverageCallback's equal-weight rank mean would bias
+        # rows in the smaller shards
+        total = np.asarray(hvd_core.allreduce(
+            jnp.asarray([float(local) * rows, rows]), op=hvd_core.Sum,
+            name="keras_estimator.metric.val_loss"))
         return {"loss": float(history.history["loss"][-1]),
-                "val_loss": float(history.history["val_loss"][-1])}
+                "val_loss": float(total[0] / total[1])}
     return float(history.history["loss"][-1])
 
 

@@ -97,7 +97,9 @@ def _sds(shape, dtype, like):
 # across a 128-lane tile — that costs 128x the necessary bandwidth and
 # capped long-sequence backward (the bundled jax.experimental kernel
 # pays exactly this).  When block_q == 128 the scalars are packed dense:
-# HBM shape [bh, t/128, 128], one q-block's column per lane row.  The
+# HBM shape [bh, t/128, 1, 128], one q-block's column per lane row (the
+# singleton sublane axis satisfies the TPU block-shape rule — the last
+# two block dims must divide (8, 128) or equal the array dims).  The
 # lane<->sublane conversion uses an MXU identity contraction — bit-exact
 # for fp32 (one nonzero term per output) and guaranteed to lower on any
 # Mosaic version, unlike a reshape across the minor-two dims.
@@ -180,7 +182,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     o_ref[0] = (o / l_safe).astype(o_ref.dtype)
     lse = m + jnp.log(l_safe)
     if packed:
-        lse_ref[0] = _col_to_row(lse)
+        lse_ref[0, 0] = _col_to_row(lse)
     else:
         lse_ref[0] = jnp.broadcast_to(lse, (block_q, 128))
 
@@ -193,8 +195,8 @@ def _fwd(q3, k3, v3, *, scale, causal, block_q, block_k, interpret):
     packed = block_q == _PACK
 
     if packed:
-        lse_spec = _vmem_spec((1, 1, _PACK), lambda b, i: (b, i, 0))
-        lse_shape = _sds((bh, nq, _PACK), jnp.float32, q3)
+        lse_spec = _vmem_spec((1, 1, 1, _PACK), lambda b, i: (b, i, 0, 0))
+        lse_shape = _sds((bh, nq, 1, _PACK), jnp.float32, q3)
     else:
         lse_spec = _vmem_spec((1, block_q, 128), lambda b, i: (b, i, 0))
         lse_shape = _sds((bh, t, 128), jnp.float32, q3)
@@ -233,8 +235,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     if packed:
-        lse = _row_to_col(lse_ref[0])                       # [bq, 1]
-        delta = _row_to_col(delta_ref[0])
+        lse = _row_to_col(lse_ref[0, 0])                    # [bq, 1]
+        delta = _row_to_col(delta_ref[0, 0])
     else:
         lse = lse_ref[0, :, 0:1]                            # [bq, 1]
         delta = delta_ref[0, :, 0:1]
@@ -291,8 +293,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
         if packed:
-            lse = _row_to_col(lse_ref[0, pl.ds(iq, 1), :])
-            delta = _row_to_col(delta_ref[0, pl.ds(iq, 1), :])
+            lse = _row_to_col(lse_ref[0, pl.ds(iq, 1), 0, :])
+            delta = _row_to_col(delta_ref[0, pl.ds(iq, 1), 0, :])
         else:
             lse = lse_ref[0, pl.ds(iq * block_q, block_q), 0:1]
             delta = delta_ref[0, pl.ds(iq * block_q, block_q), 0:1]
@@ -351,10 +353,12 @@ def _bwd(res, g, *, scale, causal, block_q, block_k, interpret,
     if packed:
         # dense: one q-block's 128 row scalars per lane row (a reshape,
         # i.e. free) — 128x less HBM than the broadcast fallback below
-        lse_b = lse.reshape(bh, nq, _PACK)
-        delta_b = delta.reshape(bh, nq, _PACK)
-        dq_lse_spec = _vmem_spec((1, 1, _PACK), lambda b, i: (b, i, 0))
-        dkv_lse_spec = _vmem_spec((1, nq, _PACK), lambda b, i: (b, 0, 0))
+        lse_b = lse.reshape(bh, nq, 1, _PACK)
+        delta_b = delta.reshape(bh, nq, 1, _PACK)
+        dq_lse_spec = _vmem_spec((1, 1, 1, _PACK),
+                                 lambda b, i: (b, i, 0, 0))
+        dkv_lse_spec = _vmem_spec((1, nq, 1, _PACK),
+                                  lambda b, i: (b, 0, 0, 0))
     else:
         lse_b = jnp.broadcast_to(lse[:, :, None], (bh, t, 128))
         delta_b = jnp.broadcast_to(delta[:, :, None], (bh, t, 128))

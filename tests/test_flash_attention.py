@@ -208,7 +208,7 @@ def test_mxu_transpose_helpers_exact():
 
 def test_packed_lse_layout_engaged_and_dense():
     """VERDICT r2 item 6: with block_q=128 the backward's lse/delta ride
-    a dense [bh, t/128, 128] layout (128x less HBM than the broadcast
+    a dense [bh, t/128, 1, 128] layout (128x less HBM than the broadcast
     fallback).  Check the forward's residual output shape directly and
     that long-T backward matches the dense reference."""
     from horovod_tpu.ops.pallas.flash_attention import _fwd
@@ -221,7 +221,7 @@ def test_packed_lse_layout_engaged_and_dense():
     assert lse.shape == (bh, t)
 
     # prove the PACKED layout is what the kernel writes to HBM: the
-    # pallas_call's lse output aval must be [bh, t/128, 128], not the
+    # pallas_call's lse output aval must be [bh, t/128, 1, 128], not the
     # broadcast [bh, t, 128] (which would also reshape to (bh, t) after
     # the [:, :, 0] slice — shape of the public return can't catch it)
     import functools as ft
@@ -232,7 +232,7 @@ def test_packed_lse_layout_engaged_and_dense():
         tuple(v.aval.shape)
         for eqn in jaxpr.jaxpr.eqns if eqn.primitive.name == "pallas_call"
         for v in eqn.outvars]
-    assert (bh, t // 128, 128) in pallas_out_shapes, pallas_out_shapes
+    assert (bh, t // 128, 1, 128) in pallas_out_shapes, pallas_out_shapes
     assert (bh, t, 128) not in pallas_out_shapes, pallas_out_shapes
 
     # end-to-end gradient at t=512 (packed path active: block_q=128)
