@@ -122,6 +122,10 @@ class ParquetStore(Store):
         groups fine enough that every rank gets several and the
         equal-shard trim stays small."""
         def granularity(split_data):
+            # explicit values win — _build_table owns that precedence
+            # chain (arg over store attr over default); this helper only
+            # supplies the num_ranks-derived argument when nothing
+            # explicit is in play
             if rows_per_row_group is not None \
                     or self.rows_per_row_group is not None \
                     or not num_ranks:
@@ -251,6 +255,8 @@ class ParquetStore(Store):
 
     @staticmethod
     def _to_numpy(table, metadata, limit):
+        import pyarrow as pa
+
         metadata = metadata or {}
         out = {}
         for name in table.column_names:
@@ -258,8 +264,6 @@ class ParquetStore(Store):
             shape_key = f"{_META_PREFIX}shape.{name}".encode()
             dtype_key = f"{_META_PREFIX}dtype.{name}".encode()
             trailing = json.loads(metadata.get(shape_key, b"[]"))
-            import pyarrow as pa
-
             if isinstance(col.type, pa.FixedSizeListType):
                 arr = np.asarray(col.values)
                 arr = arr.reshape(len(col), *trailing) if trailing else \
@@ -282,13 +286,13 @@ class ParquetStore(Store):
     # --------------------------------------- legacy shard-file protocol --
     # ParquetStore is also a drop-in Store for the npz per-rank protocol
     # so existing callers (checkpoint-only use) keep working.
-    def save_shard(self, rank, arrays):
+    def save_shard(self, rank, arrays, split="train"):
         raise NotImplementedError(
             "ParquetStore shards by row group — use materialize() + "
             "read_shard() (per-rank npz files are the LocalStore "
             "protocol)")
 
-    def load_shard(self, rank):
+    def load_shard(self, rank, split="train"):
         raise NotImplementedError(
             "ParquetStore shards by row group — use read_shard(rank, n)")
 

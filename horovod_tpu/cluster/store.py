@@ -81,8 +81,13 @@ def load_rank_shard(store, rank, size, split="train"):
     ``cur_shard=rank, shard_count=size``, the reference's Petastorm
     reader contract), per-rank npz files otherwise."""
     if hasattr(store, "read_shard"):
+        # trim-to-min equalizes shards for the LOCKSTEP train loop;
+        # the val pass is one forward + row-weighted Sum allreduce and
+        # must see every row, or val_loss diverges from full-set
+        # evaluation
         return store.read_shard(cur_shard=rank, shard_count=size,
-                                split=split)
+                                split=split,
+                                trim_to_min=(split == "train"))
     return store.load_shard(rank, split=split)
 
 

@@ -257,3 +257,43 @@ def test_validation_split_rejects_bad_fraction(hvd, tmp_path):
     xt, yt, xv, yv = split_validation(np.arange(10), np.arange(10), 0.2)
     assert len(xt) == 8 and len(xv) == 2
     assert xv[0] == 8  # TAIL split, deterministic
+
+
+def test_keras_estimator_validation_split_row_weighted(tmp_path):
+    """Keras val_loss must be the row-WEIGHTED global mean (identical
+    across ranks and equal to full-val-set evaluation), matching the
+    jax/torch estimators — an equal-weight mean of per-rank shard means
+    would bias rows in the smaller shard when np.array_split is uneven
+    (here: 27 val rows over 2 ranks -> 14/13)."""
+    import pytest
+
+    pytest.importorskip("tensorflow")
+    import keras
+    import numpy as np
+    from horovod_tpu.cluster import KerasEstimator, LocalStore
+    from horovod_tpu.cluster.backend import ProcessBackend
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(90, 8).astype(np.float32)
+    w = rng.randn(8, 2).astype(np.float32)
+    y = x @ w + 0.01 * rng.randn(90, 2).astype(np.float32)
+
+    model = keras.Sequential([keras.layers.Dense(16, activation="relu"),
+                              keras.layers.Dense(2)])
+    est = KerasEstimator(model, loss="mse", optimizer="sgd", epochs=4,
+                         batch_size=8, learning_rate=0.02,
+                         store=LocalStore(str(tmp_path)),
+                         backend=ProcessBackend(2, jax_platform="cpu"),
+                         validation=0.3)
+    fitted, metrics = est.fit(x, y)
+    assert len(metrics) == 2
+    for m in metrics:
+        assert set(m) == {"loss", "val_loss"}, m
+    # every rank reports the SAME weighted value
+    assert len({round(m["val_loss"], 6) for m in metrics}) == 1
+    # and it equals evaluation over the full (tail-split) val set with
+    # the final weights — the row-weighted identity
+    x_val, y_val = x[-27:], y[-27:]
+    full = fitted.evaluate(x_val, y_val)
+    np.testing.assert_allclose(metrics[0]["val_loss"], full,
+                               rtol=5e-3, atol=1e-5)

@@ -225,3 +225,30 @@ def test_configured_row_group_size_honored_by_estimator_path(tmp_path):
     materialize_shards(store, x, y, num_ranks=2)
     pf = store.get_parquet_dataset(store.train_data_path())
     assert pf.metadata.num_row_groups == 16  # 64 rows / 4 per group
+
+
+def test_val_split_reads_all_rows_untrimmed(tmp_path):
+    """The estimator's val pass must see EVERY val row: equal-shard
+    trimming is a lockstep-train-loop concern, and applying it to the
+    val split silently drops rows and breaks the row-weighted
+    val_loss == full-set-evaluation identity."""
+    import numpy as np
+
+    from horovod_tpu.cluster.parquet_store import ParquetStore
+    from horovod_tpu.cluster.store import load_rank_shard
+
+    store = ParquetStore(str(tmp_path), rows_per_row_group=1)
+    rng = np.random.RandomState(0)
+    train = {"x": rng.randn(40, 3).astype(np.float32)}
+    # 27 val rows, 1-row groups, 2 ranks -> 14/13 shards: trim would
+    # drop one row from rank 0
+    val = {"x": rng.randn(27, 3).astype(np.float32)}
+    store.materialize(train, validation=val)
+
+    val_rows = sum(len(load_rank_shard(store, r, 2, split="val")["x"])
+                   for r in range(2))
+    assert val_rows == 27, val_rows
+    # the train split keeps the lockstep equal-shard contract
+    train_lens = {len(load_rank_shard(store, r, 2, split="train")["x"])
+                  for r in range(2)}
+    assert len(train_lens) == 1, train_lens
