@@ -555,12 +555,38 @@ def _run_worker_once(extra_env=None, timeout=900, flag="--worker"):
 # Most recent successful real-TPU measurement (update when a new
 # on-chip run lands; history in BENCH_NOTES.md).
 _LAST_TPU_MEASUREMENT = {
-    "date": "2026-07-29",
-    "resnet50_synthetic_img_sec_per_chip": 2185.9,
-    "vs_baseline": 21.107,
-    "mfu": 0.265,
+    "date": "2026-07-31",
+    "resnet50_synthetic_img_sec_per_chip": 2105.75,
+    "vs_baseline": 20.335,
+    "mfu": 0.2556,
 }
 _CPU_FALLBACK_BATCH = 2
+
+
+def _last_tpu_measurement():
+    """Newest driver-verifiable banked real-TPU bench (bin/bank-tpu
+    output), falling back to the hardcoded last-known figures."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in sorted(glob.glob(os.path.join(here, "BANKED_TPU_*.json")),
+                       key=os.path.getmtime, reverse=True):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+            b = d.get("bench") or {}
+            if (b.get("extra") or {}).get("platform") == "tpu":
+                return {
+                    "date": d.get("date_utc", "")[:10],
+                    "resnet50_synthetic_img_sec_per_chip": b["value"],
+                    "vs_baseline": b["vs_baseline"],
+                    "mfu": b["extra"].get("mfu"),
+                    "transformer": b["extra"].get("transformer"),
+                    "source": os.path.basename(path),
+                }
+        except (OSError, KeyError, ValueError):
+            continue
+    return dict(_LAST_TPU_MEASUREMENT)
 
 
 def _cpu_fallback():
@@ -587,7 +613,7 @@ def _cpu_fallback():
     record.setdefault("extra", {})
     record["extra"]["platform"] = "cpu-fallback"
     record["extra"]["cpu_fallback_batch_per_device"] = _CPU_FALLBACK_BATCH
-    m = _LAST_TPU_MEASUREMENT
+    m = _last_tpu_measurement()
     record["extra"]["note"] = (
         "TPU relay unreachable after all retry attempts; this is a "
         "virtual 8-device CPU-mesh run of the same benchmark. Last "
