@@ -10,6 +10,12 @@ from horovod_tpu.parallel.ring_attention import (  # noqa: F401
     ring_self_attention,
     reference_attention,
 )
+from horovod_tpu.parallel.zigzag_attention import (  # noqa: F401
+    zigzag_ring_attention,
+    zigzag_ring_self_attention,
+    zigzag_shard,
+    zigzag_unshard,
+)
 from horovod_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,
     ulysses_self_attention,
