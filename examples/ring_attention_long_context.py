@@ -8,8 +8,11 @@ fresh").  Two strategies over the ``sp`` mesh axis:
   T/P, memory is O(T/P * block).
 - Ulysses: two ``all_to_all``s re-shard sequence -> heads so each device
   runs exact full-sequence attention on H/P heads.
+- zigzag: load-balanced causal ring — each rank holds one early and
+  one late chunk, so every hop costs the same two unmasked block
+  attends on every rank (~2x causal throughput at large P).
 
-    python examples/ring_attention_long_context.py --strategy ring
+    python examples/ring_attention_long_context.py --strategy zigzag
 """
 
 import argparse
@@ -29,7 +32,8 @@ from horovod_tpu.parallel.ulysses import ulysses_attention
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--strategy", choices=["ring", "ulysses"],
+    parser.add_argument("--strategy",
+                        choices=["ring", "ulysses", "zigzag"],
                         default="ring")
     parser.add_argument("--seq-len", type=int, default=4096)
     parser.add_argument("--heads", type=int, default=8)
@@ -45,16 +49,23 @@ def main():
     q, k, v = (jnp.asarray(rng.randn(b, t, h, d).astype(np.float32)) * 0.1
                for _ in range(3))
 
-    def body(q, k, v):
-        if args.strategy == "ring":
-            return ring_attention(q, k, v, axis_name="sp", causal=True)
-        return ulysses_attention(q, k, v, axis_name="sp", causal=True)
+    if args.strategy == "zigzag":
+        from horovod_tpu.parallel import zigzag_ring_self_attention
 
-    fn = jax.jit(shard_map(
-        body, mesh=mesh,
-        in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp")))
+        out = zigzag_ring_self_attention(q, k, v, mesh)
+    else:
+        def body(q, k, v):
+            if args.strategy == "ring":
+                return ring_attention(q, k, v, axis_name="sp",
+                                      causal=True)
+            return ulysses_attention(q, k, v, axis_name="sp",
+                                     causal=True)
 
-    out = fn(q, k, v)
+        fn = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp")))
+
+        out = fn(q, k, v)
     jax.block_until_ready(out)
     if hvd.rank() == 0:
         # verify against the dense oracle on a prefix

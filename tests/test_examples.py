@@ -37,6 +37,8 @@ def _run_example(name, *args, timeout=420):
     ("join_uneven_data.py", ()),
     ("interactive_run.py", ()),
     ("ring_attention_long_context.py", ("--seq-len", "512")),
+    ("ring_attention_long_context.py",
+     ("--strategy", "zigzag", "--seq-len", "512")),
     ("transformer_lm.py", ("--steps", "2", "--d-model", "64",
                            "--n-layers", "2", "--seq-len", "32")),
     ("jax_mnist.py", ("--epochs", "1", "--batch-size", "256",
