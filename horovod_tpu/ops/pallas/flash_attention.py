@@ -461,8 +461,17 @@ def _flash_lse_bwd(scale, causal, block_q, block_k, interpret, res, g):
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None, return_lse=False):
+def _env_block(name, default):
+    import os
+
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def flash_attention(q, k, v, *, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=None, return_lse=False):
     """Flash multi-head attention, ``[B, T, H, D] -> [B, T, H, D]``.
 
     Differentiable (custom VJP with Pallas backward kernels).  On
@@ -480,6 +489,14 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
         scale = 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = _default_interpret()
+    # HVD_FLASH_BLOCK_Q/K: measured-default overrides (bank-tpu's
+    # flash_blocks sweep is the evidence source).  block_q=128 keeps
+    # the packed lse/delta layout; other values fall back to the
+    # broadcast layout.
+    if block_q is None:
+        block_q = _env_block("HVD_FLASH_BLOCK_Q", 128)
+    if block_k is None:
+        block_k = _env_block("HVD_FLASH_BLOCK_K", 128)
     block_q = _pick_block(t, block_q)
     block_k = _pick_block(t_kv, block_k)
 
