@@ -315,3 +315,30 @@ def gloo_enabled() -> bool:
 
 def xla_enabled() -> bool:
     return True
+
+
+def ccl_built() -> bool:
+    """oneCCL backend probe (reference: ``basics.py`` ``ccl_built``) —
+    always False: the five comm backends collapse into the XLA plane."""
+    return False
+
+
+def ddl_built() -> bool:
+    """IBM DDL backend probe (reference parity) — always False."""
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    """Reference: whether MPI was initialized with THREAD_MULTIPLE.
+    There is no MPI data plane here (mpirun only launches workers), so
+    this is always False; raises if called before ``init`` like the
+    reference does."""
+    _get_state()  # raises when not initialized (reference contract)
+    return False
+
+
+def is_homogeneous() -> bool:
+    """True when every host runs the same number of ranks (reference:
+    ``controller.cc`` ``is_homogeneous_``, exposed on the basics
+    surface)."""
+    return _get_state().topology.is_homogeneous

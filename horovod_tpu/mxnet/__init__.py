@@ -35,6 +35,10 @@ local_size = _basics.local_size
 mpi_built = _basics.mpi_built
 gloo_built = _basics.gloo_built
 nccl_built = _basics.nccl_built
+ccl_built = _basics.ccl_built
+ddl_built = _basics.ddl_built
+mpi_threads_supported = _basics.mpi_threads_supported
+is_homogeneous = _basics.is_homogeneous
 
 
 def _require_mx():

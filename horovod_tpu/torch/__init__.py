@@ -26,6 +26,10 @@ from horovod_tpu.common.basics import (  # noqa: F401
     mpi_enabled,
     gloo_enabled,
     xla_enabled,
+    ccl_built,
+    ddl_built,
+    mpi_threads_supported,
+    is_homogeneous,
 )
 from horovod_tpu.common.ops_enum import Average, Sum, Adasum  # noqa: F401
 from horovod_tpu.torch.compression import Compression  # noqa: F401

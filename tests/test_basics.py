@@ -28,6 +28,10 @@ def test_capability_probes(hvd):
     assert not hvd.mpi_built() and not hvd.mpi_enabled()
     assert not hvd.gloo_built() and not hvd.gloo_enabled()
     assert not hvd.nccl_built()
+    assert not hvd.ccl_built() and not hvd.ddl_built()
+    assert not hvd.mpi_threads_supported()
+    # single-host 8-device topology is homogeneous by construction
+    assert hvd.is_homogeneous()
 
 
 def test_mesh(hvd):
