@@ -26,6 +26,31 @@ local_rank = _basics.local_rank
 local_size = _basics.local_size
 
 
+def allreduce(value, name=None, average=True):
+    """Allreduce a tensor-compatible value (reference:
+    ``keras/__init__.py:74`` — the Keras-level value op; evaluates
+    eagerly and returns the reduced tensor)."""
+    from horovod_tpu import tensorflow as hvd_tf
+
+    return hvd_tf.allreduce(value, name=name, average=average)
+
+
+def allgather(value, name=None):
+    """Allgather a tensor-compatible value along dim 0 (reference:
+    ``keras/__init__.py:88``)."""
+    from horovod_tpu import tensorflow as hvd_tf
+
+    return hvd_tf.allgather(value, name=name)
+
+
+def broadcast(value, root_rank, name=None):
+    """Broadcast a tensor-compatible value from ``root_rank``
+    (reference: ``keras/__init__.py:102``)."""
+    from horovod_tpu import tensorflow as hvd_tf
+
+    return hvd_tf.broadcast(value, root_rank, name=name)
+
+
 def broadcast_object(obj, root_rank=0, name=None):
     """Pickle-based object broadcast (delegates to the TF binding)."""
     from horovod_tpu import tensorflow as hvd_tf

@@ -235,6 +235,14 @@ model.save(path)
 loaded = hvd_keras.load_model(path)
 assert getattr(loaded.optimizer, "_hvd_wrapped", False)
 
+# keras-level value collectives (reference keras/__init__.py:74-102)
+red = hvd_keras.allreduce(tf.constant([float(r + 1)]), name="kar")
+np.testing.assert_allclose(red.numpy(), [1.5])
+gat = hvd_keras.allgather(tf.constant([[float(r)]]), name="kag")
+np.testing.assert_allclose(gat.numpy(), [[0.0], [1.0]])
+bc = hvd_keras.broadcast(tf.constant([7.0 + r]), 0, name="kbc")
+np.testing.assert_allclose(bc.numpy(), [7.0])
+
 print(f"rank {r} KERAS_OK", flush=True)
 hvd_keras.shutdown()
 """
