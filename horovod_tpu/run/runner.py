@@ -96,9 +96,59 @@ def make_parser():
     logg.add_argument("--log-hide-timestamp", action="store_true",
                       default=None)
 
+    parser.add_argument("-cb", "--check-build", action="store_true",
+                        help="Print available frameworks, controllers "
+                             "and data planes, then exit (reference: "
+                             "horovodrun --check-build).")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="Training command to run on each rank.")
     return parser
+
+
+def check_build(verbose=False):
+    """The reference's ``horovodrun --check-build`` diagnostic
+    (``runner.py:118``), in this framework's idiom: frameworks are
+    import-probed, controllers/data planes are what the build ships."""
+    import importlib.util
+    import textwrap
+
+    import horovod_tpu
+
+    def have(mod):
+        try:
+            return importlib.util.find_spec(mod) is not None
+        except (ImportError, ValueError):
+            return False
+
+    def native_core():
+        try:
+            from horovod_tpu.ops.native_controller import _load_lib
+            return _load_lib() is not None
+        except Exception:  # noqa: BLE001 — diagnostic must not crash
+            return False
+
+    x = lambda v: "X" if v else " "
+    out = f"""\
+    horovod_tpu v{horovod_tpu.__version__}:
+
+    Available Frameworks:
+        [{x(have('jax'))}] JAX (native)
+        [{x(have('tensorflow'))}] TensorFlow / Keras
+        [{x(have('torch'))}] PyTorch
+        [{x(have('mxnet'))}] MXNet
+
+    Available Controllers:
+        [{x(native_core())}] native (C++ core)
+        [X] python (in-process)
+        [X] tcp (process coordinator)
+        [X] gmesh (pod global mesh)
+
+    Available Data Planes:
+        [X] XLA (fused compiled collectives; ICI on TPU)
+        [X] tcp ring (numpy p2p, process mode)
+    """
+    print(textwrap.dedent(out))
+    return 0
 
 
 def build_slots(args):
@@ -131,6 +181,8 @@ def run_commandline(argv=None) -> int:
     parser = make_parser()
     args = parser.parse_args(argv)
 
+    if args.check_build:
+        return check_build(verbose=args.verbose)
     if not args.command:
         parser.error("no training command given")
     if args.num_proc is None and not args.tpu:

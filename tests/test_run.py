@@ -228,3 +228,17 @@ def test_fallback_yaml_keeps_hash_in_values(tmp_path):
     cfg.write_text("timeline:\n  filename: /tmp/run#3/t.json  # note\n")
     tree = config_parser._parse_simple_yaml(str(cfg))
     assert tree["timeline"]["filename"] == "/tmp/run#3/t.json"
+
+
+def test_check_build_diagnostic(capsys):
+    """--check-build prints the capability report and exits 0
+    (reference: horovodrun --check-build, runner.py:118)."""
+    from horovod_tpu.run.runner import run_commandline
+
+    rc = run_commandline(["--check-build"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Available Frameworks" in out
+    assert "[X] JAX (native)" in out
+    assert "Available Controllers" in out
+    assert "tcp (process coordinator)" in out
