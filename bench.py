@@ -569,22 +569,34 @@ def _last_tpu_measurement():
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
+
+    def mtime(p):
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return 0.0
+
     for path in sorted(glob.glob(os.path.join(here, "BANKED_TPU_*.json")),
-                       key=os.path.getmtime, reverse=True):
+                       key=mtime, reverse=True):
         try:
             with open(path) as f:
                 d = json.load(f)
             b = d.get("bench") or {}
             if (b.get("extra") or {}).get("platform") == "tpu":
+                # banked_at_utc is stamped when the bench leg itself
+                # ran; the file-level date_utc is rewritten on every
+                # bank-tpu invocation (resume re-stamps it)
+                date = (b.get("banked_at_utc")
+                        or d.get("date_utc", ""))[:10]
                 return {
-                    "date": d.get("date_utc", "")[:10],
+                    "date": date,
                     "resnet50_synthetic_img_sec_per_chip": b["value"],
                     "vs_baseline": b["vs_baseline"],
                     "mfu": b["extra"].get("mfu"),
                     "transformer": b["extra"].get("transformer"),
                     "source": os.path.basename(path),
                 }
-        except (OSError, KeyError, ValueError):
+        except Exception:  # noqa: BLE001 — fallback must never crash
             continue
     return dict(_LAST_TPU_MEASUREMENT)
 

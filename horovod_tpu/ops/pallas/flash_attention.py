@@ -411,6 +411,8 @@ def _bwd(res, g, *, scale, causal, block_q, block_k, interpret,
 
 def _pick_block(t, want):
     """Largest divisor of t that is <= want (kernel blocks must tile T)."""
+    if want < 1:
+        raise ValueError(f"block size must be >= 1, got {want}")
     b = min(want, t)
     while t % b != 0:
         b -= 1
@@ -462,12 +464,10 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _env_block(name, default):
-    import os
+    from horovod_tpu.utils.env import get_int
 
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+    value = get_int(name, default)
+    return value if value >= 1 else default
 
 
 def flash_attention(q, k, v, *, causal=False, scale=None, block_q=None,

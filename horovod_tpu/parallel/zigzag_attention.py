@@ -71,6 +71,9 @@ def zigzag_shard(x, p_size, axis=1):
 def zigzag_unshard(x, p_size, axis=1):
     """Inverse of :func:`zigzag_shard`."""
     t = x.shape[axis]
+    if t % (2 * p_size):
+        raise ValueError(
+            f"sequence length {t} not divisible by 2*{p_size}")
     c = t // (2 * p_size)
     order = zigzag_chunk_order(p_size)
     inverse = [0] * len(order)
@@ -192,20 +195,25 @@ def zigzag_ring_attention(q, k, v, *, axis_name, scale=None,
     return out.astype(q.dtype)
 
 
+@functools.lru_cache(maxsize=32)
+def _jitted_zigzag(mesh, axis_name, scale, use_flash):
+    spec = P(None, axis_name, None, None)
+    return jax.jit(shard_map(
+        functools.partial(zigzag_ring_attention, axis_name=axis_name,
+                          scale=scale, use_flash=use_flash),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+
+
 def zigzag_ring_self_attention(q, k, v, mesh, *, axis_name="sp",
                                scale=None, use_flash=None):
     """Convenience wrapper: zigzag-reorder global ``[B, T, H, D]``
-    arrays, run :func:`zigzag_ring_attention` under ``shard_map``, and
-    restore the natural token order."""
+    arrays, run :func:`zigzag_ring_attention` under ``shard_map``
+    (jitted, cached per (mesh, axis, scale, flash)), and restore the
+    natural token order."""
     p_size = mesh.shape[axis_name]
-    spec = P(None, axis_name, None, None)
-    sharding = NamedSharding(mesh, spec)
+    sharding = NamedSharding(mesh, P(None, axis_name, None, None))
 
-    fn = shard_map(
-        functools.partial(zigzag_ring_attention, axis_name=axis_name,
-                          scale=scale, use_flash=use_flash),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-
+    fn = _jitted_zigzag(mesh, axis_name, scale, use_flash)
     args = (jax.device_put(zigzag_shard(x, p_size), sharding)
             for x in (q, k, v))
     return zigzag_unshard(fn(*args), p_size)

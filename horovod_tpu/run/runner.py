@@ -148,6 +148,22 @@ def check_build(verbose=False):
         [X] tcp ring (numpy p2p, process mode)
     """
     print(textwrap.dedent(out))
+    if verbose:
+        import os
+
+        from horovod_tpu.ops import native_controller as nc
+
+        print(f"package: {os.path.dirname(horovod_tpu.__file__)}")
+        print(f"native core: {nc._LIB_PATH} "
+              f"({'present' if os.path.exists(nc._LIB_PATH) else 'absent'})")
+        try:
+            import jax
+
+            # version only — default_backend() would initialize the
+            # backend and can block behind a dead TPU relay
+            print(f"jax version: {jax.__version__}")
+        except Exception as exc:  # noqa: BLE001
+            print(f"jax: unavailable ({exc!r})")
     return 0
 
 

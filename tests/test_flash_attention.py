@@ -267,3 +267,22 @@ def test_long_sequence_backward_packed():
         arr = np.asarray(g)
         assert np.isfinite(arr).all(), name
         assert np.abs(arr).max() > 0, name
+
+
+def test_flash_block_env_overrides_validated(monkeypatch):
+    """HVD_FLASH_BLOCK_Q/K override the defaults; non-positive or
+    garbage values fall back instead of crashing _pick_block."""
+    from horovod_tpu.ops.pallas.flash_attention import _env_block
+
+    monkeypatch.setenv("HVD_FLASH_BLOCK_Q", "256")
+    assert _env_block("HVD_FLASH_BLOCK_Q", 128) == 256
+    for bad in ("0", "-128", "abc", ""):
+        monkeypatch.setenv("HVD_FLASH_BLOCK_Q", bad)
+        assert _env_block("HVD_FLASH_BLOCK_Q", 128) == 128
+
+    # an explicit bad argument still fails loudly
+    import pytest as _pytest
+
+    from horovod_tpu.ops.pallas.flash_attention import _pick_block
+    with _pytest.raises(ValueError, match="block size"):
+        _pick_block(64, 0)
