@@ -39,6 +39,7 @@ def _run_example(name, *args, timeout=420):
     ("ring_attention_long_context.py", ("--seq-len", "512")),
     ("ring_attention_long_context.py",
      ("--strategy", "zigzag", "--seq-len", "512")),
+    ("long_context_training.py", ("--steps", "4", "--seq-len", "128")),
     ("transformer_lm.py", ("--steps", "2", "--d-model", "64",
                            "--n-layers", "2", "--seq-len", "32")),
     ("jax_mnist.py", ("--epochs", "1", "--batch-size", "256",
