@@ -413,6 +413,10 @@ def worker():
         except Exception as exc:  # noqa: BLE001 — OOM etc.: keep headline
             sys.stderr.write(f"bs128 leg failed: {exc!r}\n")
         state["last"] = time.time()
+    if os.environ.get("BENCH_TEST_HANG_S"):
+        # test hook: simulate a relay death between legs so the
+        # partial-emit path is exercisable (tests/test_bench_gate.py)
+        time.sleep(float(os.environ["BENCH_TEST_HANG_S"]))
     try:
         record["extra"]["transformer"] = _bench_transformer(devices)
     except Exception as exc:  # never lose the ResNet number to the LM leg

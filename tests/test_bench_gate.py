@@ -1,0 +1,81 @@
+"""The bench gate's resilience machinery (bench.py) — the paths the
+driver depends on when the TPU relay is flaky.
+
+These run the REAL worker subprocess on the virtual CPU mesh with the
+fallback's tiny config, so they're a few minutes of wall clock in
+exchange for covering the exact code the round's BENCH_r{N}.json comes
+from.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_worker(extra_env, timeout=600):
+    env = dict(os.environ)
+    env.update({
+        "BENCH_CPU_FALLBACK": "1",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "BENCH_BATCH": "2",
+        "BENCH_ITERS": "2",
+        "BENCH_WARMUP": "1",
+    })
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--worker"],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=REPO)
+
+
+def _last_json(stdout):
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def test_worker_partial_emit_on_stalled_leg():
+    """A leg stalling after the headline emits the labeled partial
+    record with rc=0 — the relay-died-mid-run contract."""
+    result = _run_worker({"BENCH_TEST_HANG_S": "9999",
+                          "BENCH_LEG_TIMEOUT": "30"})
+    assert result.returncode == 0, result.stderr[-1500:]
+    record = _last_json(result.stdout)
+    assert record is not None, result.stdout[-1500:]
+    assert record["extra"]["partial"] is True
+    assert record["value"] > 0                      # headline survived
+    assert record["extra"]["transformer"] is None   # stalled leg absent
+
+
+def test_last_tpu_measurement_never_crashes(tmp_path, monkeypatch):
+    """The banked-file scan tolerates vanished and malformed files."""
+    import bench
+
+    m = bench._last_tpu_measurement()
+    assert m["resnet50_synthetic_img_sec_per_chip"] > 0
+    # malformed candidates must be skipped, not crash the fallback
+    import glob as _glob
+
+    bad1 = tmp_path / "BANKED_TPU_bad.json"
+    bad1.write_text("[1, 2, 3]")
+    bad2 = tmp_path / "BANKED_TPU_gone.json"
+    bad2.write_text("{}")
+    real = {"bench": {"value": 42.0, "vs_baseline": 1.5,
+                      "banked_at_utc": "2026-07-30T01:00:00+00:00",
+                      "extra": {"platform": "tpu", "mfu": 0.5}}}
+    (tmp_path / "BANKED_TPU_real.json").write_text(json.dumps(real))
+    monkeypatch.setattr(
+        bench.os.path, "dirname", lambda p: str(tmp_path))
+    got = bench._last_tpu_measurement()
+    assert got["resnet50_synthetic_img_sec_per_chip"] == 42.0
+    assert got["date"] == "2026-07-30"
