@@ -134,3 +134,88 @@ def _current_rank():
         return basics.rank()
     except Exception:  # noqa: BLE001 — not initialized: single process
         return 0
+
+
+class AsyncCheckpointManager:
+    """Orbax-backed ASYNC checkpointing — the save returns as soon as
+    the pytree is snapshotted; serialization and the filesystem write
+    happen on a background thread, so the training step never blocks on
+    I/O.  A TPU-native improvement over the reference's synchronous
+    per-framework saves (large-model checkpoints take seconds to
+    minutes; async hides that behind compute).
+
+    Same conventions as :func:`save_checkpoint`: rank 0 writes, other
+    ranks no-op; ``keep`` prunes old steps.  Call :meth:`wait` before
+    shutdown (and before reading a just-written step back).
+
+    Falls back to the synchronous msgpack writer when orbax is
+    unavailable — the API is identical either way.
+    """
+
+    def __init__(self, directory, keep=3, rank=None):
+        self.directory = os.path.abspath(directory)
+        self.keep = keep
+        self._rank = rank
+        self._mgr = None
+        try:
+            import orbax.checkpoint as ocp
+
+            self._ocp = ocp
+            self._mgr = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=keep, enable_async_checkpointing=True))
+        except Exception:  # noqa: BLE001 — orbax absent/unusable
+            self._ocp = None
+
+    def _is_writer(self):
+        rank = self._rank if self._rank is not None else _current_rank()
+        return rank == 0
+
+    def save(self, step, target):
+        """Queue an async save of ``target`` at ``step`` (rank 0 only).
+        Returns True when a save was queued/written."""
+        if not self._is_writer():
+            return False
+        if self._mgr is None:
+            save_checkpoint(self.directory, target, step,
+                            keep=self.keep, rank=0)
+            return True
+        return bool(self._mgr.save(
+            step, args=self._ocp.args.StandardSave(target)))
+
+    def restore(self, target, step=None):
+        """Restore ``step`` (default latest) into ``target``'s
+        structure; returns ``(restored, step)`` or ``(target, None)``."""
+        if self._mgr is None:
+            return restore_checkpoint(self.directory, target, step)
+        self._mgr.wait_until_finished()
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                return target, None
+        restored = self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(target))
+        return restored, step
+
+    def latest_step(self):
+        if self._mgr is None:
+            return latest_step(self.directory)
+        self._mgr.wait_until_finished()
+        return self._mgr.latest_step()
+
+    def wait(self):
+        """Block until every queued save is durably on disk."""
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
+
+    def close(self):
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
+            self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -80,3 +80,43 @@ def test_jax_arrays_roundtrip(tmp_path):
         str(tmp_path), {"w": jnp.zeros((2, 3), jnp.float32)})
     np.testing.assert_allclose(np.asarray(restored["w"]),
                                np.arange(6).reshape(2, 3))
+
+
+def test_async_checkpoint_manager_roundtrip(tmp_path):
+    """Orbax-backed async save/restore: queue saves without blocking,
+    wait() makes them durable, restore returns the exact pytree, keep
+    prunes old steps."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.utils.checkpoint import AsyncCheckpointManager
+
+    target = {"w": jnp.arange(8, dtype=jnp.float32),
+              "b": {"inner": jnp.ones((2, 3))}}
+
+    with AsyncCheckpointManager(str(tmp_path / "ckpts"), keep=2,
+                                rank=0) as mgr:
+        for step in (1, 2, 3):
+            scaled = {"w": target["w"] * step,
+                      "b": {"inner": target["b"]["inner"] * step}}
+            assert mgr.save(step, scaled)
+        mgr.wait()
+        assert mgr.latest_step() == 3
+        restored, step = mgr.restore(target)
+        assert step == 3
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(8) * 3)
+        # keep=2: step 1 pruned
+        restored2, s2 = mgr.restore(target, step=2)
+        assert s2 == 2
+        np.testing.assert_array_equal(
+            np.asarray(restored2["b"]["inner"]), np.ones((2, 3)) * 2)
+
+
+def test_async_checkpoint_manager_non_writer_noop(tmp_path):
+    from horovod_tpu.utils.checkpoint import AsyncCheckpointManager
+
+    mgr = AsyncCheckpointManager(str(tmp_path / "c2"), rank=1)
+    assert mgr.save(1, {"x": 1}) is False
+    assert mgr.latest_step() is None
+    mgr.close()
