@@ -595,3 +595,39 @@ def test_global_mesh_8x1_hierarchical_gang():
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
     assert result.stdout.count("POD81_OK") == 8
+
+
+ZIGZAG_WORKER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.parallel import (make_mesh, reference_attention,
+                                  zigzag_ring_self_attention)
+
+hvd.init()
+mesh = make_mesh({"sp": len(jax.devices())})   # 8 devices over 2 procs
+
+rng = np.random.RandomState(0)                 # same data on both hosts
+b, t, h, d = 1, 128, 2, 16
+q, k, v = (jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+           for _ in range(3))
+got = zigzag_ring_self_attention(q, k, v, mesh, use_flash=False)
+exp = reference_attention(q, k, v, causal=True)
+from jax.experimental import multihost_utils
+got_np = np.asarray(multihost_utils.process_allgather(got, tiled=True))
+np.testing.assert_allclose(got_np, np.asarray(exp),
+                           rtol=2e-4, atol=2e-4)
+print("GMESH_ZIGZAG_OK", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_global_mesh_zigzag_attention():
+    """Zigzag (balanced causal) ring over the REAL 2-process x 4-device
+    global mesh gang — the pod wiring — must be exact attention."""
+    result = _run_gmesh(ZIGZAG_WORKER)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-3000:]}"
+    assert result.stdout.count("GMESH_ZIGZAG_OK") == 2
