@@ -91,8 +91,10 @@ def initialize_jax_distributed(process_id: int, num_processes: int) -> None:
             http_client.put(addr, int(port), JAXDIST_SCOPE, JAXDIST_KEY,
                             coordinator.encode())
         else:
-            coordinator = http_client.get(addr, int(port), JAXDIST_SCOPE,
-                                          JAXDIST_KEY, timeout=120).decode()
+            coordinator = http_client.get(
+                addr, int(port), JAXDIST_SCOPE, JAXDIST_KEY,
+                timeout=env_util.get_float(
+                    env_util.HVD_START_TIMEOUT, 120.0)).decode()
 
     get_logger().debug(
         "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",

@@ -588,8 +588,10 @@ class TcpController:
                     "multi-process mode requires the rendezvous env "
                     "contract (launch with hvdrun)")
             from horovod_tpu.run import http_client
-            blob = http_client.get(addr, int(port), CONTROLLER_SCOPE,
-                                   CONTROLLER_KEY, timeout=120).decode()
+            blob = http_client.get(
+                addr, int(port), CONTROLLER_SCOPE, CONTROLLER_KEY,
+                timeout=env_util.get_float(
+                    env_util.HVD_START_TIMEOUT, 120.0)).decode()
             tagged = []
             for part in blob.split(";"):
                 iface, rest = part.split("=", 1)
@@ -615,8 +617,10 @@ class TcpController:
 
         addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
         port = os.environ.get(env_util.HVD_RENDEZVOUS_PORT)
-        blob = http_client.get(addr, int(port), PEERS_SCOPE, str(rank),
-                               timeout=120).decode()
+        blob = http_client.get(
+            addr, int(port), PEERS_SCOPE, str(rank),
+            timeout=env_util.get_float(
+                env_util.HVD_START_TIMEOUT, 120.0)).decode()
         tagged = []
         for part in blob.split(";"):
             iface, rest = part.split("=", 1)

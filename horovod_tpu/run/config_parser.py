@@ -27,6 +27,16 @@ _PARAMS = {
     "log_level": (env_util.HVD_LOG_LEVEL, "logging.level"),
     "log_hide_timestamp": (env_util.HVD_LOG_HIDE_TIME, "logging.hide_timestamp"),
     "controller": (env_util.HVD_CONTROLLER, "params.controller"),
+    "start_timeout": (env_util.HVD_START_TIMEOUT, "timeouts.start_timeout"),
+    "network_interface": (env_util.HVD_IFACE, "network.interface"),
+}
+
+# negation flags -> env var forced to "0" (reference: --no-autotune etc.)
+_NEGATIONS = {
+    "no_autotune": env_util.HVD_AUTOTUNE,
+    "no_hierarchical_allreduce": env_util.HVD_HIERARCHICAL_ALLREDUCE,
+    "no_hierarchical_allgather": env_util.HVD_HIERARCHICAL_ALLGATHER,
+    "stall_check": env_util.HVD_STALL_CHECK_DISABLE,  # enable = disable-var 0
 }
 
 
@@ -143,4 +153,9 @@ def env_from_args(args) -> dict:
         if arg == "fusion_threshold_mb" and value is not None:
             value = int(float(value) * 1024 * 1024)
         setenv(var, value)
+    if getattr(args, "disable_cache", None):
+        env[env_util.HVD_CACHE_CAPACITY] = "0"
+    for arg, var in _NEGATIONS.items():
+        if getattr(args, arg, None):
+            env[var] = "0"
     return env

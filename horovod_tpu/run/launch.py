@@ -58,7 +58,8 @@ def _ssh_command(slot, command, env, ssh_port=None):
 
 
 def launch_job(slots, command, rendezvous_addr, rendezvous_port,
-               extra_env=None, ssh_port=None, verbose=False) -> int:
+               extra_env=None, ssh_port=None, verbose=False,
+               output_filename=None) -> int:
     """Launch one process per slot; kill everything on first failure.
     Returns the FIRST failure's exit code (or 0) — after the
     kill-on-first-failure fan-out, later ranks die with signal codes
@@ -86,9 +87,24 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
             if verbose:
                 log.warning("launching rank %d on %s: %s", slot.rank,
                             slot.hostname, cmd)
-            code = safe_shell_exec.execute(
-                cmd, env=full_env, stdout=sys.stdout, stderr=sys.stderr,
-                events=[failure], stdin_data=stdin_data)
+            out_f = err_f = None
+            stdout, stderr = sys.stdout, sys.stderr
+            if output_filename:
+                # reference layout: <dir>/rank.<N>/stdout|stderr
+                rank_dir = os.path.join(output_filename,
+                                        f"rank.{slot.rank}")
+                os.makedirs(rank_dir, exist_ok=True)
+                out_f = open(os.path.join(rank_dir, "stdout"), "w")
+                err_f = open(os.path.join(rank_dir, "stderr"), "w")
+                stdout, stderr = out_f, err_f
+            try:
+                code = safe_shell_exec.execute(
+                    cmd, env=full_env, stdout=stdout, stderr=stderr,
+                    events=[failure], stdin_data=stdin_data)
+            finally:
+                for f in (out_f, err_f):
+                    if f is not None:
+                        f.close()
         except Exception as exc:  # noqa: BLE001 — a thread dying
             # silently would record no failure (reported success) while
             # sibling ranks hang waiting for this one

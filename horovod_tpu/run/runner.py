@@ -50,6 +50,23 @@ def make_parser():
                              "over the global mesh (metadata-only control "
                              "plane).")
     parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--version", action="store_true",
+                        help="Print the framework version and exit.")
+    parser.add_argument("--start-timeout", type=float, default=None,
+                        help="Gang-start deadline in seconds: workers "
+                             "that cannot reach the rendezvous/"
+                             "controller within this window fail with "
+                             "a clear message (default 120).")
+    parser.add_argument("--output-filename", default=None,
+                        help="Directory for per-rank logs: each rank's "
+                             "stdout/stderr tee into "
+                             "<dir>/rank.<N>/stdout|stderr (reference: "
+                             "horovodrun --output-filename).")
+    parser.add_argument("--network-interface", default=None,
+                        help="NIC name override for the data/control "
+                             "plane (maps to HVD_IFACE; default: "
+                             "auto-discovered + intersected across "
+                             "hosts).")
     parser.add_argument("--config-file", default=None,
                         help="YAML config file (CLI flags take precedence).")
 
@@ -57,6 +74,18 @@ def make_parser():
     group.add_argument("--fusion-threshold-mb", type=float, default=None)
     group.add_argument("--cycle-time-ms", type=float, default=None)
     group.add_argument("--cache-capacity", type=int, default=None)
+    group.add_argument("--disable-cache", action="store_true",
+                       default=None,
+                       help="Disable the response cache entirely "
+                            "(HVD_CACHE_CAPACITY=0).")
+    group.add_argument("--no-hierarchical-allreduce",
+                       action="store_true", default=None,
+                       help="Force flat allreduce, overriding "
+                            "env/config.")
+    group.add_argument("--no-hierarchical-allgather",
+                       action="store_true", default=None,
+                       help="Force flat allgather, overriding "
+                            "env/config.")
     group.add_argument("--hierarchical-allreduce", action="store_true",
                        default=None)
     group.add_argument("--hierarchical-allgather", action="store_true",
@@ -72,6 +101,8 @@ def make_parser():
 
     auto = parser.add_argument_group("autotune")
     auto.add_argument("--autotune", action="store_true", default=None)
+    auto.add_argument("--no-autotune", action="store_true", default=None,
+                      help="Force autotune off, overriding env/config.")
     auto.add_argument("--autotune-log-file", default=None)
     auto.add_argument("--autotune-warmup-samples", type=int, default=None)
     auto.add_argument("--autotune-steady-state-samples", type=int,
@@ -84,6 +115,9 @@ def make_parser():
 
     stall = parser.add_argument_group("stall check")
     stall.add_argument("--no-stall-check", action="store_true", default=None)
+    stall.add_argument("--stall-check", action="store_true", default=None,
+                       help="Force the stall check on, overriding "
+                            "env/config.")
     stall.add_argument("--stall-check-warning-time-seconds", type=float,
                        default=None)
     stall.add_argument("--stall-check-shutdown-time-seconds", type=float,
@@ -197,6 +231,11 @@ def run_commandline(argv=None) -> int:
     parser = make_parser()
     args = parser.parse_args(argv)
 
+    if args.version:
+        import horovod_tpu
+
+        print(horovod_tpu.__version__)
+        return 0
     if args.check_build:
         return check_build(verbose=args.verbose)
     if not args.command:
@@ -253,7 +292,8 @@ def run_commandline(argv=None) -> int:
     command = " ".join(shlex.quote(c) for c in args.command)
     try:
         return launch_job(slots, command, addr, port, extra_env=extra_env,
-                          ssh_port=args.ssh_port, verbose=args.verbose)
+                          ssh_port=args.ssh_port, verbose=args.verbose,
+                          output_filename=args.output_filename)
     finally:
         rendezvous.stop()
 

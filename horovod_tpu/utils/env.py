@@ -48,6 +48,7 @@ HVD_IFACE = "HVD_IFACE"
 HVD_GLOBAL_MESH = "HVD_GLOBAL_MESH"            # pod mode: one global jax mesh
 HVD_HOST_SLOTS = "HVD_HOST_SLOTS"      # "h1:n1,h2:n2" rank-block layout
 HVD_COORDINATOR_ADDR = "HVD_COORDINATOR_ADDR"  # jax.distributed coordinator
+HVD_START_TIMEOUT = "HVD_START_TIMEOUT"  # gang-start deadline, s (default 120)
 
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
 DEFAULT_CYCLE_TIME_MS = 1.0

@@ -129,3 +129,52 @@ def test_int_tensors_pass_compression_untouched():
     out = Compression.fp16.decompress(comp, ctx)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
     assert np.asarray(out).dtype == np.int32
+
+
+def test_round4_flag_additions_map():
+    """--start-timeout / --network-interface / --disable-cache and the
+    negation flags (reference runner.py surface) land in the worker
+    env contract."""
+    args = _parse(["-np", "2", "--start-timeout", "45",
+                   "--network-interface", "eth7", "--disable-cache",
+                   "--no-autotune", "--no-hierarchical-allreduce",
+                   "--no-hierarchical-allgather", "--stall-check"])
+    env = config_parser.env_from_args(args)
+    assert env[env_util.HVD_START_TIMEOUT] == "45.0"
+    assert env[env_util.HVD_IFACE] == "eth7"
+    assert env[env_util.HVD_CACHE_CAPACITY] == "0"
+    assert env[env_util.HVD_AUTOTUNE] == "0"
+    assert env[env_util.HVD_HIERARCHICAL_ALLREDUCE] == "0"
+    assert env[env_util.HVD_HIERARCHICAL_ALLGATHER] == "0"
+    assert env[env_util.HVD_STALL_CHECK_DISABLE] == "0"
+    # negation after positive: the "0" wins (explicit off)
+    assert env_util.get_bool("X_UNSET", True) is True
+
+
+def test_output_filename_per_rank_logs(tmp_path):
+    """--output-filename writes <dir>/rank.N/stdout|stderr (reference:
+    horovodrun --output-filename layout)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os, sys\n"
+        "print('OUT rank', os.environ['HVD_RANK'])\n"
+        "print('ERR rank', os.environ['HVD_RANK'], file=sys.stderr)\n")
+    out_dir = tmp_path / "logs"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo
+    result = subprocess.run(
+        [sys.executable, os.path.join(repo, "bin", "hvdrun"),
+         "-np", "2", "--output-filename", str(out_dir),
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert result.returncode == 0, result.stderr[-2000:]
+    for r in (0, 1):
+        out = (out_dir / f"rank.{r}" / "stdout").read_text()
+        err = (out_dir / f"rank.{r}" / "stderr").read_text()
+        assert f"OUT rank {r}" in out, out
+        assert f"ERR rank {r}" in err, err
