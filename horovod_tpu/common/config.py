@@ -24,6 +24,8 @@ class Config:
     autotune_log: str | None = None
     autotune_warmup_samples: int = 3
     autotune_steady_state_samples: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     # Opt-in separately from hierarchical_allreduce: hierarchical Adasum
@@ -58,6 +60,10 @@ class Config:
                 env_util.HVD_AUTOTUNE_WARMUP_SAMPLES, 3),
             autotune_steady_state_samples=env_util.get_int(
                 env_util.HVD_AUTOTUNE_STEADY_STATE_SAMPLES, 10),
+            autotune_bayes_opt_max_samples=env_util.get_int(
+                env_util.HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, 20),
+            autotune_gaussian_process_noise=env_util.get_float(
+                env_util.HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.8),
             hierarchical_allreduce=env_util.get_bool(
                 env_util.HVD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=env_util.get_bool(

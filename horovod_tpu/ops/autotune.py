@@ -70,6 +70,10 @@ class AutotuneManager:
                 getattr(config, "autotune_warmup_samples", 3)),
             steady_state_samples=int(
                 getattr(config, "autotune_steady_state_samples", 10)),
+            bayes_opt_max_samples=int(
+                getattr(config, "autotune_bayes_opt_max_samples", 20)),
+            gp_noise=float(
+                getattr(config, "autotune_gaussian_process_noise", 0.8)),
             log_path=config.autotune_log or None,
             fusion_threshold_bytes=int(config.fusion_threshold_bytes),
             cycle_time_ms=float(config.cycle_time_ms),

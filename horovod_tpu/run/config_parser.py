@@ -19,6 +19,8 @@ _PARAMS = {
     "autotune_log_file": (env_util.HVD_AUTOTUNE_LOG, "autotune.log_file"),
     "autotune_warmup_samples": (env_util.HVD_AUTOTUNE_WARMUP_SAMPLES, "autotune.warmup_samples"),
     "autotune_steady_state_samples": (env_util.HVD_AUTOTUNE_STEADY_STATE_SAMPLES, "autotune.steady_state_samples"),
+    "autotune_bayes_opt_max_samples": (env_util.HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, "autotune.bayes_opt_max_samples"),
+    "autotune_gaussian_process_noise": (env_util.HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, "autotune.gaussian_process_noise"),
     "timeline_filename": (env_util.HVD_TIMELINE, "timeline.filename"),
     "timeline_mark_cycles": (env_util.HVD_TIMELINE_MARK_CYCLES, "timeline.mark_cycles"),
     "no_stall_check": (env_util.HVD_STALL_CHECK_DISABLE, "stall_check.disabled"),

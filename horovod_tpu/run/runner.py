@@ -107,6 +107,10 @@ def make_parser():
     auto.add_argument("--autotune-warmup-samples", type=int, default=None)
     auto.add_argument("--autotune-steady-state-samples", type=int,
                       default=None)
+    auto.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                      default=None)
+    auto.add_argument("--autotune-gaussian-process-noise", type=float,
+                      default=None)
 
     timeline = parser.add_argument_group("timeline")
     timeline.add_argument("--timeline-filename", default=None)

@@ -26,6 +26,8 @@ HVD_AUTOTUNE = "HVD_AUTOTUNE"
 HVD_AUTOTUNE_LOG = "HVD_AUTOTUNE_LOG"
 HVD_AUTOTUNE_WARMUP_SAMPLES = "HVD_AUTOTUNE_WARMUP_SAMPLES"
 HVD_AUTOTUNE_STEADY_STATE_SAMPLES = "HVD_AUTOTUNE_STEADY_STATE_SAMPLES"
+HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
 HVD_LOG_LEVEL = "HVD_LOG_LEVEL"
 HVD_LOG_HIDE_TIME = "HVD_LOG_HIDE_TIME"
 HVD_CONTROLLER = "HVD_CONTROLLER"                      # native | python | tcp
