@@ -118,3 +118,15 @@ def test_zigzag_gradients_match_dense():
 def test_zigzag_rejects_bad_length():
     with pytest.raises(ValueError, match="not divisible"):
         zigzag_shard(jnp.zeros((1, 30, 2, 4)), 4)
+
+
+def test_zigzag_bf16():
+    """bf16 inputs ride the same fp32 streaming-softmax accumulators."""
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    q, k, v = _rand(t=128, seed=6)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    expected = reference_attention(q, k, v, causal=True)
+    got = zigzag_ring_self_attention(qb, kb, vb, mesh, use_flash=False)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                               np.asarray(expected), rtol=0.1, atol=0.1)
