@@ -178,3 +178,28 @@ def test_output_filename_per_rank_logs(tmp_path):
         err = (out_dir / f"rank.{r}" / "stderr").read_text()
         assert f"OUT rank {r}" in out, out
         assert f"ERR rank {r}" in err, err
+
+
+def test_start_timeout_bounds_gang_start(tmp_path):
+    """HVD_START_TIMEOUT caps how long a worker waits for the
+    coordinator's rendezvous registration: with a live KV server but
+    no rank 0, a non-zero rank must fail within the window instead of
+    hanging for the 120 s default (reference: horovodrun
+    --start-timeout gang semantics)."""
+    import time as _time
+
+    from horovod_tpu.run import http_client
+    from horovod_tpu.run.http_server import RendezvousServer
+
+    server = RendezvousServer()
+    port = server.start()
+    try:
+        start = _time.monotonic()
+        with pytest.raises(KeyError):
+            http_client.get("127.0.0.1", port, "controller", "addr",
+                            timeout=env_util.get_float(
+                                "HVD_START_TIMEOUT_TESTVAL", 2.0))
+        elapsed = _time.monotonic() - start
+        assert elapsed < 30, elapsed
+    finally:
+        server.stop()
