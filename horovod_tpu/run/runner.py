@@ -32,6 +32,10 @@ def make_parser():
     parser.add_argument("--hostfile", default=None,
                         help="File with one 'hostname slots=N' per line.")
     parser.add_argument("--ssh-port", type=int, default=None)
+    parser.add_argument("--mpi-args", default=None,
+                        help="Extra arguments appended to the delegated "
+                             "mpirun command (--launcher mpirun), e.g. "
+                             "--mpi-args='--mca btl_tcp_if_include eth0'")
     parser.add_argument("--launcher", choices=["ssh", "mpirun", "jsrun"],
                         default="ssh",
                         help="Process placement: built-in ssh fan-out "
@@ -320,9 +324,12 @@ def _delegate_launch(args, slots, extra_env):
         _slots_by_host(slots).items())
     try:
         if args.launcher == "mpirun":
+            import shlex
+
             from horovod_tpu.run import mpi_run
+            extra = shlex.split(args.mpi_args) if args.mpi_args else None
             return mpi_run.mpi_run(len(slots), hosts_spec, args.command,
-                                   env=env)
+                                   env=env, extra_args=extra)
         from horovod_tpu.run import js_run
         return js_run.js_run(len(slots), args.command, env=env)
     finally:

@@ -203,3 +203,11 @@ def test_start_timeout_bounds_gang_start(tmp_path):
         assert elapsed < 30, elapsed
     finally:
         server.stop()
+
+
+def test_mpi_args_flag_splits():
+    args = _parse(["-np", "2", "--launcher", "mpirun",
+                   "--mpi-args=--mca btl_tcp_if_include eth0"])
+    import shlex
+    assert shlex.split(args.mpi_args) == [
+        "--mca", "btl_tcp_if_include", "eth0"]
