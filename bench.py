@@ -374,6 +374,11 @@ def worker():
                 continue
             if time.time() - state["last"] <= limit:
                 continue
+            if state.get("printed"):
+                # all legs done and the record already printed; only
+                # shutdown is stalling — exit clean without relabeling
+                # a complete measurement as partial
+                os._exit(0)
             sys.stderr.write(
                 "bench worker: leg stalled; emitting partial\n")
             state["record"]["extra"]["partial"] = True
@@ -426,9 +431,11 @@ def worker():
     record["extra"]["allreduce_gbs"] = gbs
     record["extra"]["allreduce_gbs_device"] = gbs_device
     state["last"] = time.time()
+    # print BEFORE shutdown: a shutdown stall (relay death at the
+    # barrier) must not cost a complete measurement
+    print(json.dumps(record), flush=True)
+    state["printed"] = True
     hvd.shutdown()
-
-    print(json.dumps(record))
 
 
 def scaling_worker():

@@ -63,7 +63,7 @@ def make_parser():
                              "a clear message (default 120).")
     parser.add_argument("--output-filename", default=None,
                         help="Directory for per-rank logs: each rank's "
-                             "stdout/stderr tee into "
+                             "stdout/stderr are redirected to "
                              "<dir>/rank.<N>/stdout|stderr (reference: "
                              "horovodrun --output-filename).")
     parser.add_argument("--network-interface", default=None,

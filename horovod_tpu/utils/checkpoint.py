@@ -158,8 +158,17 @@ class AsyncCheckpointManager:
         self._rank = rank
         self._mgr = None
         try:
+            import jax
             import orbax.checkpoint as ocp
 
+            if jax.process_count() > 1:
+                # orbax's save is a COLLECTIVE in multi-process JAX
+                # (sync_global_processes barrier) — the rank-0-writes
+                # contract below would deadlock rank 0 against ranks
+                # that never call it.  Multi-process pods use the
+                # synchronous rank-0 msgpack path until all-rank
+                # orbax save is wired.
+                raise RuntimeError("multi-process: use msgpack path")
             self._ocp = ocp
             self._mgr = ocp.CheckpointManager(
                 self.directory,

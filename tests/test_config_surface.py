@@ -147,8 +147,9 @@ def test_round4_flag_additions_map():
     assert env[env_util.HVD_HIERARCHICAL_ALLREDUCE] == "0"
     assert env[env_util.HVD_HIERARCHICAL_ALLGATHER] == "0"
     assert env[env_util.HVD_STALL_CHECK_DISABLE] == "0"
-    # negation after positive: the "0" wins (explicit off)
-    assert env_util.get_bool("X_UNSET", True) is True
+    # negation wins over the positive flag: explicit off
+    both = _parse(["-np", "2", "--autotune", "--no-autotune"])
+    assert config_parser.env_from_args(both)[env_util.HVD_AUTOTUNE] == "0"
 
 
 def test_output_filename_per_rank_logs(tmp_path):
@@ -180,29 +181,40 @@ def test_output_filename_per_rank_logs(tmp_path):
         assert f"ERR rank {r}" in err, err
 
 
-def test_start_timeout_bounds_gang_start(tmp_path):
-    """HVD_START_TIMEOUT caps how long a worker waits for the
-    coordinator's rendezvous registration: with a live KV server but
-    no rank 0, a non-zero rank must fail within the window instead of
-    hanging for the 120 s default (reference: horovodrun
-    --start-timeout gang semantics)."""
-    import time as _time
+def test_start_timeout_bounds_gang_start(tmp_path, monkeypatch):
+    """HVD_START_TIMEOUT must reach the worker's rendezvous waits: the
+    tcp controller's peer resolution passes it as the KV-poll timeout
+    (reference: horovodrun --start-timeout gang semantics)."""
+    import types
 
+    from horovod_tpu.ops import tcp_controller as tc
     from horovod_tpu.run import http_client
-    from horovod_tpu.run.http_server import RendezvousServer
 
-    server = RendezvousServer()
-    port = server.start()
-    try:
-        start = _time.monotonic()
-        with pytest.raises(KeyError):
-            http_client.get("127.0.0.1", port, "controller", "addr",
-                            timeout=env_util.get_float(
-                                "HVD_START_TIMEOUT_TESTVAL", 2.0))
-        elapsed = _time.monotonic() - start
-        assert elapsed < 30, elapsed
-    finally:
-        server.stop()
+    seen = {}
+
+    def fake_get(addr, port, scope, key, timeout=None):
+        seen["timeout"] = timeout
+        return b"lo=127.0.0.1:1"
+
+    monkeypatch.setattr(http_client, "get", fake_get)
+    monkeypatch.setenv(env_util.HVD_RENDEZVOUS_ADDR, "127.0.0.1")
+    monkeypatch.setenv(env_util.HVD_RENDEZVOUS_PORT, "1")
+    monkeypatch.setenv(env_util.HVD_START_TIMEOUT, "7.5")
+    from horovod_tpu.run.service import network
+
+    class _NoClient:
+        def __init__(self, *a, **k):
+            pass
+
+    monkeypatch.setattr(network, "MuxClient", _NoClient)
+    stub = types.SimpleNamespace(
+        _key=b"k", _filter_ifaces=lambda tagged: tagged)
+    tc.TcpController._resolve_peer(stub, 1)
+    assert seen["timeout"] == 7.5
+    # and the default is the documented 120 s
+    monkeypatch.delenv(env_util.HVD_START_TIMEOUT)
+    tc.TcpController._resolve_peer(stub, 1)
+    assert seen["timeout"] == 120.0
 
 
 def test_mpi_args_flag_splits():
