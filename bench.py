@@ -362,6 +362,11 @@ def worker():
     # emits the partial record instead of losing everything to the
     # supervisor's subprocess timeout
     state = {"last": time.time(), "record": None}
+    # one lock serializes the watchdog's partial emit against the main
+    # thread's final print: without it either a complete record gets a
+    # partial-labeled duplicate (watchdog fires during the final print)
+    # or a blocked final print gets truncated by os._exit
+    print_lock = threading.Lock()
 
     def leg_watchdog():
         limit = float(os.environ.get("BENCH_LEG_TIMEOUT", 600))
@@ -374,16 +379,17 @@ def worker():
                 continue
             if time.time() - state["last"] <= limit:
                 continue
-            if state.get("printed"):
-                # all legs done and the record already printed; only
-                # shutdown is stalling — exit clean without relabeling
-                # a complete measurement as partial
+            with print_lock:
+                if state.get("printed"):
+                    # all legs done and the record fully printed; only
+                    # shutdown is stalling — exit clean without
+                    # relabeling a complete measurement as partial
+                    os._exit(0)
+                sys.stderr.write(
+                    "bench worker: leg stalled; emitting partial\n")
+                state["record"]["extra"]["partial"] = True
+                print(json.dumps(state["record"]), flush=True)
                 os._exit(0)
-            sys.stderr.write(
-                "bench worker: leg stalled; emitting partial\n")
-            state["record"]["extra"]["partial"] = True
-            print(json.dumps(state["record"]), flush=True)
-            os._exit(0)
 
     threading.Thread(target=leg_watchdog, daemon=True).start()
 
@@ -432,9 +438,12 @@ def worker():
     record["extra"]["allreduce_gbs_device"] = gbs_device
     state["last"] = time.time()
     # print BEFORE shutdown: a shutdown stall (relay death at the
-    # barrier) must not cost a complete measurement
-    print(json.dumps(record), flush=True)
-    state["printed"] = True
+    # barrier) must not cost a complete measurement.  Under the lock,
+    # so the watchdog can neither emit a partial-labeled duplicate nor
+    # os._exit mid-print if this print blocks on a full pipe
+    with print_lock:
+        print(json.dumps(record), flush=True)
+        state["printed"] = True
     hvd.shutdown()
 
 
@@ -632,7 +641,8 @@ def _last_tpu_measurement():
                 # ran; the file-level date_utc is rewritten on every
                 # bank-tpu invocation (resume re-stamps it)
                 date = (b.get("banked_at_utc")
-                        or d.get("date_utc", ""))[:10]
+                        or d.get("date_utc", ""))[:10] \
+                    or _LAST_TPU_MEASUREMENT["date"]
                 return {
                     "date": date,
                     "resnet50_synthetic_img_sec_per_chip": b["value"],

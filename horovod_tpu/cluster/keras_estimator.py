@@ -66,6 +66,9 @@ def _train_keras_rank(rank, model_config, weights, compile_kwargs,
 
         import horovod_tpu as hvd_core
 
+        # one extra evaluate pass per fit: history's val_loss was
+        # already equal-weight rank-averaged by MetricAverageCallback,
+        # so the local shard value needed for row weighting is gone
         local = model.evaluate(vx, vy, batch_size=batch_size, verbose=0)
         if isinstance(local, (list, tuple)):
             local = local[0]

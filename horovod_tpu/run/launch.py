@@ -18,6 +18,38 @@ from horovod_tpu.utils.logging import get_logger
 LOCAL_HOSTS = ("localhost", "127.0.0.1")
 
 
+class _Tee:
+    """Write to a rank's output file AND the launcher console.
+
+    Reference: ``gloo_run.py`` ``MultiFile`` — ``--output-filename``
+    captures per-rank files without silencing the console.  The file
+    is the primary sink; a dead console (e.g. BrokenPipeError after
+    ``hvdrun ... | head`` exits) must not truncate the file capture.
+    A merely *blocked* console (paused pager) stalls the forwarder —
+    same as the reference's MultiFile and as the plain inherit-console
+    path, where the child itself blocks."""
+
+    def __init__(self, primary, *mirrors):
+        self._primary = primary
+        self._mirrors = mirrors
+
+    def write(self, data):
+        self._primary.write(data)
+        for s in self._mirrors:
+            try:
+                s.write(data)
+            except (OSError, ValueError):
+                pass
+
+    def flush(self):
+        self._primary.flush()
+        for s in self._mirrors:
+            try:
+                s.flush()
+            except (OSError, ValueError):
+                pass
+
+
 def slot_env(slot, rendezvous_addr, rendezvous_port, extra_env=None):
     """The worker env contract for one rank."""
     env = {
@@ -89,15 +121,19 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
                             slot.hostname, cmd)
             out_f = err_f = None
             stdout, stderr = sys.stdout, sys.stderr
-            if output_filename:
-                # reference layout: <dir>/rank.<N>/stdout|stderr
-                rank_dir = os.path.join(output_filename,
-                                        f"rank.{slot.rank}")
-                os.makedirs(rank_dir, exist_ok=True)
-                out_f = open(os.path.join(rank_dir, "stdout"), "w")
-                err_f = open(os.path.join(rank_dir, "stderr"), "w")
-                stdout, stderr = out_f, err_f
             try:
+                if output_filename:
+                    # reference layout (gloo_run.py MultiFile): write
+                    # <dir>/rank.<NN>/stdout|stderr AND tee to the
+                    # console; rank dir zero-padded to num_proc-1 width
+                    pad = len(str(max(len(slots) - 1, 1)))
+                    rank_dir = os.path.join(
+                        output_filename, f"rank.{slot.rank:0{pad}d}")
+                    os.makedirs(rank_dir, exist_ok=True)
+                    out_f = open(os.path.join(rank_dir, "stdout"), "w")
+                    err_f = open(os.path.join(rank_dir, "stderr"), "w")
+                    stdout = _Tee(out_f, sys.stdout)
+                    stderr = _Tee(err_f, sys.stderr)
                 code = safe_shell_exec.execute(
                     cmd, env=full_env, stdout=stdout, stderr=stderr,
                     events=[failure], stdin_data=stdin_data)

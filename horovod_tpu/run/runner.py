@@ -63,8 +63,10 @@ def make_parser():
                              "a clear message (default 120).")
     parser.add_argument("--output-filename", default=None,
                         help="Directory for per-rank logs: each rank's "
-                             "stdout/stderr are redirected to "
-                             "<dir>/rank.<N>/stdout|stderr (reference: "
+                             "stdout/stderr are captured to "
+                             "<dir>/rank.<NN>/stdout|stderr (rank "
+                             "zero-padded to the width of np-1) while "
+                             "still teeing to the console (reference: "
                              "horovodrun --output-filename).")
     parser.add_argument("--network-interface", default=None,
                         help="NIC name override for the data/control "

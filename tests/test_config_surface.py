@@ -179,6 +179,28 @@ def test_output_filename_per_rank_logs(tmp_path):
         err = (out_dir / f"rank.{r}" / "stderr").read_text()
         assert f"OUT rank {r}" in out, out
         assert f"ERR rank {r}" in err, err
+        # reference MultiFile semantics: files capture AND the console
+        # still sees every rank's output
+        assert f"OUT rank {r}" in result.stdout, result.stdout[-2000:]
+        assert f"ERR rank {r}" in result.stderr, result.stderr[-2000:]
+
+
+def test_output_filename_zero_pads_rank_dirs(tmp_path):
+    """Rank dirs are zero-padded to the width of num_proc-1 (reference
+    layout: rank.00..rank.10 for an 11-rank job)."""
+    import sys
+
+    from horovod_tpu.run import allocate as allocate_mod
+    from horovod_tpu.run import launch as launch_mod
+
+    slots = allocate_mod.allocate(
+        [allocate_mod.HostInfo("localhost", 11)], 11)
+    rc = launch_mod.launch_job(
+        slots, f"{sys.executable} -c \"print('hi')\"",
+        "127.0.0.1", 0, output_filename=str(tmp_path / "logs"))
+    assert rc == 0
+    dirs = sorted(p.name for p in (tmp_path / "logs").iterdir())
+    assert dirs == [f"rank.{r:02d}" for r in range(11)], dirs
 
 
 def test_start_timeout_bounds_gang_start(tmp_path, monkeypatch):
