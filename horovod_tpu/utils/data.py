@@ -1,0 +1,292 @@
+"""TPU-native input pipeline: sharded batch iteration + device prefetch.
+
+The reference's data path is framework loaders feeding each rank its own
+shard — torch ``DataLoader`` + ``DistributedSampler`` in the examples,
+and Petastorm readers over per-rank Parquet row groups in the estimators
+(``horovod/spark/keras/remote.py``: ``cur_shard=hvd.rank(),
+shard_count=hvd.size()``).  The TPU equivalent below keeps the same
+contract (disjoint per-rank shards, deterministic per-epoch shuffling)
+and adds the piece TPU training actually needs: **device prefetch**.
+An XLA training step dispatches asynchronously; if the NEXT batch's
+host→device transfer only starts when the step returns, the HBM copy
+sits on the critical path.  ``prefetch_to_device`` overlaps the copy
+with compute via a background thread and a bounded queue, handing the
+step loop batches that are already device-resident ``jax.Array``s.
+
+Pieces:
+
+- :class:`BatchIterator` — batches over in-memory arrays (the
+  ``read_shard`` output), per-epoch seeded reshuffle.
+- :class:`ParquetShardIterator` — streams THIS rank's Parquet row groups
+  (``rg % shard_count == cur_shard``) one group at a time, so the shard
+  never has to fit in host memory at once.
+- :func:`prefetch_to_device` — background host→device staging; accepts a
+  ``jax.sharding.Sharding`` for SPMD global batches or a ``Mesh`` (uses
+  :func:`horovod_tpu.parallel.mesh.shard_global_batch` per batch).
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["BatchIterator", "ParquetShardIterator", "prefetch_to_device"]
+
+
+def _tree_rows(data):
+    """Leading-dim length of a {name: array} dict / tuple / array."""
+    if isinstance(data, dict):
+        arrays = list(data.values())
+    elif isinstance(data, (tuple, list)):
+        arrays = list(data)
+    else:
+        arrays = [data]
+    if not arrays:
+        raise ValueError("empty batch structure")
+    rows = {int(np.shape(a)[0]) for a in arrays}
+    if len(rows) != 1:
+        raise ValueError(f"ragged leading dims: {sorted(rows)}")
+    return rows.pop()
+
+
+def _tree_take(data, idx):
+    if isinstance(data, dict):
+        return {k: v[idx] for k, v in data.items()}
+    if isinstance(data, (tuple, list)):
+        return type(data)(v[idx] for v in data)
+    return data[idx]
+
+
+class BatchIterator:
+    """Deterministic batcher over in-memory per-rank shard data.
+
+    ``data``: ``{name: array}`` dict (the ``ParquetStore.read_shard``
+    output), tuple of arrays, or one array — batches keep the structure.
+    ``shuffle``: reshuffles every epoch with ``seed + epoch`` so runs are
+    reproducible and ranks (which hold disjoint shards) need no
+    coordination — the reference gets the same property from
+    ``DistributedSampler.set_epoch``.
+    ``epochs=None`` iterates forever (the training-loop default: the
+    step count, not the iterator, ends training).
+    """
+
+    def __init__(self, data, batch_size, *, shuffle=False, seed=0,
+                 drop_remainder=True, epochs=1):
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be > 0, got {batch_size}")
+        self._data = data
+        self._rows = _tree_rows(data)
+        if self._rows == 0:
+            raise ValueError("shard has zero rows")
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self.epochs = epochs
+        if drop_remainder and self._rows < batch_size:
+            raise ValueError(
+                f"shard rows ({self._rows}) < batch_size ({batch_size}) "
+                f"with drop_remainder — every epoch would be empty")
+
+    @property
+    def batches_per_epoch(self):
+        if self.drop_remainder:
+            return self._rows // self.batch_size
+        return -(-self._rows // self.batch_size)
+
+    def __iter__(self):
+        epoch = 0
+        while self.epochs is None or epoch < self.epochs:
+            if self.shuffle:
+                order = np.random.default_rng(
+                    self.seed + epoch).permutation(self._rows)
+            else:
+                order = np.arange(self._rows)
+            stop = (self._rows - self._rows % self.batch_size
+                    if self.drop_remainder else self._rows)
+            for lo in range(0, stop, self.batch_size):
+                yield _tree_take(self._data,
+                                 order[lo:lo + self.batch_size])
+            epoch += 1
+
+
+class ParquetShardIterator:
+    """Stream this rank's Parquet row groups into batches, one group in
+    memory at a time.
+
+    Matches ``ParquetStore.read_shard`` semantics (disjoint row groups
+    ``rg % shard_count == cur_shard``, reference Petastorm wiring in
+    ``horovod/spark/keras/remote.py``) without materializing the whole
+    shard: rows left over when a group is exhausted carry into the next
+    group's batches, so batch boundaries don't leak the row-group size.
+    ``shuffle`` permutes the rank's row-group ORDER per epoch and the
+    rows inside each group (window shuffle — the memory bound is one
+    row group, same trade-off as Petastorm's shuffling buffer).
+    """
+
+    def __init__(self, store, cur_shard, shard_count, batch_size, *,
+                 split="train", idx=None, columns=None, shuffle=False,
+                 seed=0, drop_remainder=True, epochs=1):
+        if not 0 <= cur_shard < shard_count:
+            raise ValueError(
+                f"cur_shard {cur_shard} outside [0, {shard_count})")
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be > 0, got {batch_size}")
+        self._store = store
+        self._cur_shard = cur_shard
+        self._shard_count = shard_count
+        self._split = split
+        self._idx = idx
+        self._columns = columns
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self.epochs = epochs
+        pf = store._open(split, idx)
+        self._groups = [rg for rg in range(pf.metadata.num_row_groups)
+                        if rg % shard_count == cur_shard]
+        if not self._groups:
+            raise ValueError(
+                f"shard {cur_shard}/{shard_count} holds no row groups "
+                f"({pf.metadata.num_row_groups} total) — rewrite with "
+                f"smaller rows_per_row_group or fewer ranks")
+        rows = sum(pf.metadata.row_group(rg).num_rows
+                   for rg in self._groups)
+        if drop_remainder and rows < batch_size:
+            # same check BatchIterator does in __init__: an epoch that
+            # yields nothing must fail loudly at construction, not run
+            # zero training steps silently
+            raise ValueError(
+                f"shard {cur_shard}/{shard_count} rows ({rows}) < "
+                f"batch_size ({batch_size}) with drop_remainder — "
+                f"every epoch would be empty")
+
+    def _read_group(self, pf, rg, schema_meta):
+        table = pf.read_row_groups([rg], columns=self._columns)
+        return self._store._to_numpy(table, schema_meta, table.num_rows)
+
+    def __iter__(self):
+        epoch = 0
+        while self.epochs is None or epoch < self.epochs:
+            rng = (np.random.default_rng(self.seed + epoch)
+                   if self.shuffle else None)
+            groups = list(self._groups)
+            if rng is not None:
+                rng.shuffle(groups)
+            pf = self._store._open(self._split, self._idx)
+            schema_meta = pf.schema_arrow.metadata
+            pending = None  # carry-over rows smaller than batch_size
+            for rg in groups:
+                chunk = self._read_group(pf, rg, schema_meta)
+                if rng is not None:
+                    chunk = _tree_take(
+                        chunk, rng.permutation(_tree_rows(chunk)))
+                if pending is not None:
+                    chunk = {k: np.concatenate([pending[k], v])
+                             for k, v in chunk.items()}
+                rows = _tree_rows(chunk)
+                stop = rows - rows % self.batch_size
+                for lo in range(0, stop, self.batch_size):
+                    yield _tree_take(chunk,
+                                     slice(lo, lo + self.batch_size))
+                pending = (_tree_take(chunk, slice(stop, rows))
+                           if stop < rows else None)
+            if pending is not None and not self.drop_remainder:
+                yield pending
+            epoch += 1
+
+
+def prefetch_to_device(iterator, size=2, *, sharding=None, mesh=None,
+                       axis=None):
+    """Stage batches onto device ahead of the training loop.
+
+    A daemon thread pulls host batches from ``iterator``, moves them to
+    device, and parks up to ``size`` device-resident batches in a
+    bounded queue — the host→device copy of batch N+1 overlaps the
+    compute of batch N instead of serializing after it.  ``size=2`` is
+    the classic double buffer; more only helps when batch copy time is
+    burstier than step time.
+
+    Placement: default is ``jax.device_put`` to the default device
+    (single-chip path); pass ``sharding`` (any ``jax.sharding.Sharding``)
+    to lay the batch out for SPMD, or ``mesh`` (+ optional ``axis``) to
+    build a multi-host GLOBAL batch from per-process local rows via
+    :func:`horovod_tpu.parallel.mesh.shard_global_batch`.
+
+    Source-iterator exceptions re-raise at the consuming ``next()`` —
+    a data-path failure must fail the step loop, not silently end the
+    epoch early.
+    """
+    import jax
+
+    if size <= 0:
+        raise ValueError(f"size must be > 0, got {size}")
+    if sharding is not None and mesh is not None:
+        raise ValueError("pass sharding OR mesh, not both")
+
+    if mesh is not None:
+        from horovod_tpu.parallel.mesh import MeshAxes, shard_global_batch
+
+        axis = axis or MeshAxes.HVD
+
+        def put(x):
+            return shard_global_batch(np.asarray(x), mesh=mesh, axis=axis)
+    elif sharding is not None:
+        def put(x):
+            return jax.device_put(x, sharding)
+    else:
+        put = jax.device_put
+
+    q = queue.Queue(maxsize=size)
+    sentinel = object()
+    stop = threading.Event()
+
+    def _put(item):
+        # bounded put that gives up when the consumer has stopped — a
+        # plain q.put would block this thread forever if the training
+        # loop exits early, pinning device batches and the source
+        # iterator until process exit
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for batch in iterator:
+                if stop.is_set() or \
+                        not _put(jax.tree.map(put, batch)):
+                    return
+            _put(sentinel)
+        except BaseException as exc:  # noqa: BLE001 — re-raised consumer-side
+            _put((sentinel, exc))
+
+    # start staging NOW (not at first next()): the whole point is the
+    # first batch being on device before the loop asks for it
+    threading.Thread(target=producer, daemon=True).start()
+
+    def consume():
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] is sentinel:
+                    raise item[1]
+                yield item
+        finally:
+            # consumer done (exhausted, errored, or closed early):
+            # release the producer and any queued device batches
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+    return consume()

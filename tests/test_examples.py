@@ -61,6 +61,8 @@ def _run_example(name, *args, timeout=420):
     ("mxnet_mnist.py", ()),  # prints a clean notice when mxnet absent
     ("zero1_sharded_optimizer.py", ("--steps", "12", "--batch-size",
                                     "64", "--hidden", "32")),
+    ("data_pipeline.py", ("--epochs", "1", "--rows", "1024",
+                          "--batch-size", "128")),
 ])
 def test_example_runs(name, args):
     result = _run_example(name, *args)
