@@ -44,12 +44,27 @@ def _val_loss(params, model, loss_fn, store, rank, num_ranks):
     return float(total[0] / total[1])
 
 
+def _min_shard_rows(store, num_ranks):
+    """Smallest shard's row count (footer metadata only), with the same
+    clear empty-shard error ``read_shard`` raises — streaming must not
+    degrade it to a ZeroDivisionError downstream."""
+    counts = store.shard_row_counts(num_ranks)
+    if min(counts) == 0:
+        raise ValueError(
+            f"shard {counts.index(0)} of {num_ranks} would be empty — "
+            f"rewrite with smaller rows_per_row_group or fewer ranks")
+    return min(counts)
+
+
 def _train_one_rank(rank, model, loss_fn, store, epochs, batch_size,
-                    learning_rate, seed, num_ranks, has_val=False):
+                    learning_rate, seed, num_ranks, has_val=False,
+                    streaming=False):
     """Runs inside a rank context (thread or process).  ``num_ranks`` is
     the backend's process count — the shard partition the dataset was
     materialized for (NOT hvd.size(), which can exceed it in multi-host
-    device-rank mode and would silently drop row groups)."""
+    device-rank mode and would silently drop row groups).
+    ``streaming=True`` (sharded-dataset stores) iterates the rank's row
+    groups one at a time instead of loading the shard."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -58,10 +73,36 @@ def _train_one_rank(rank, model, loss_fn, store, epochs, batch_size,
     from horovod_tpu.cluster.store import load_rank_shard
     from horovod_tpu.utils import checkpoint as ckpt
 
-    shard = load_rank_shard(store, rank, num_ranks)
-    x, y = shard["x"], shard["y"]
+    if streaming:
+        import itertools
 
-    params = model.init(jax.random.PRNGKey(seed), jnp.asarray(x[:1]))
+        from horovod_tpu.utils.data import ParquetShardIterator
+
+        # LOCKSTEP: every rank must run the SAME number of collective
+        # rounds.  Shards are row-group sharded and can be uneven, so
+        # cap every rank at the smallest shard's step count (the
+        # streamed analog of read_shard's trim_to_min).
+        min_rows = _min_shard_rows(store, num_ranks)
+        batch_size = min(batch_size, min_rows)
+        steps = epochs * max(min_rows // batch_size, 1)
+        batches = itertools.islice(
+            iter(ParquetShardIterator(store, rank, num_ranks,
+                                      batch_size, epochs=None)), steps)
+        # peek the first batch for the init sample instead of paying a
+        # second row-group read — chain it back for training
+        first = next(batches)
+        sample = first["x"][:1]
+        batches = itertools.chain([first], batches)
+    else:
+        from horovod_tpu.utils.data import BatchIterator
+
+        shard = load_rank_shard(store, rank, num_ranks)
+        x, y = shard["x"], shard["y"]
+        sample = x[:1]
+        batches = BatchIterator({"x": x, "y": y},
+                                min(batch_size, len(x)), epochs=epochs)
+
+    params = model.init(jax.random.PRNGKey(seed), jnp.asarray(sample))
     # reference workflow: rank 0's init everywhere before training
     params = hvd.broadcast_parameters(params, root_rank=0)
 
@@ -76,21 +117,20 @@ def _train_one_rank(rank, model, loss_fn, store, epochs, batch_size,
         return jax.value_and_grad(local_loss)(params)
 
     last_loss = 0.0
-    for _ in range(epochs):
-        for i in range(0, max(len(x) - batch_size + 1, 1), batch_size):
-            xb = jnp.asarray(x[i:i + batch_size])
-            yb = jnp.asarray(y[i:i + batch_size])
-            loss, grads = grads_fn(params, xb, yb)
-            # gradient exchange on the eager path, one fused group per step
-            leaves, treedef = jax.tree.flatten(grads)
-            handles = [hvd.allreduce_async(leaf, op=hvd.Average,
-                                           name=f"estimator.grad.{j}")
-                       for j, leaf in enumerate(leaves)]
-            reduced = [hvd.synchronize(h) for h in handles]
-            grads = jax.tree.unflatten(treedef, reduced)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            last_loss = loss
+    for batch in batches:
+        xb = jnp.asarray(batch["x"])
+        yb = jnp.asarray(batch["y"])
+        loss, grads = grads_fn(params, xb, yb)
+        # gradient exchange on the eager path, one fused group per step
+        leaves, treedef = jax.tree.flatten(grads)
+        handles = [hvd.allreduce_async(leaf, op=hvd.Average,
+                                       name=f"estimator.grad.{j}")
+                   for j, leaf in enumerate(leaves)]
+        reduced = [hvd.synchronize(h) for h in handles]
+        grads = jax.tree.unflatten(treedef, reduced)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        last_loss = loss
 
     # epoch metric averaged across ranks (reference: MetricAverageCallback)
     avg_loss = float(np.asarray(hvd.allreduce(
@@ -107,13 +147,36 @@ def _train_one_rank(rank, model, loss_fn, store, epochs, batch_size,
     return avg_loss
 
 
+def _spmd_streamed_batches(store, num_ranks, batch_per_rank, epochs):
+    """Zip one streamed iterator per shard into mesh-ordered global
+    batches: shard r's rows land in mesh position r, matching the
+    in-memory path's layout.  Memory bound: one row group per shard in
+    flight (the reference's Petastorm readers stream the same way)."""
+    from horovod_tpu.utils.data import ParquetShardIterator
+
+    its = [iter(ParquetShardIterator(store, r, num_ranks,
+                                     batch_per_rank, epochs=epochs))
+           for r in range(num_ranks)]
+    while True:
+        parts = []
+        for it in its:
+            nxt = next(it, None)
+            if nxt is None:  # shortest shard done == equal-shard trim
+                return
+            parts.append(nxt)
+        yield {k: np.concatenate([p[k] for p in parts])
+               for k in parts[0]}
+
+
 def _train_spmd(model, loss_fn, store, epochs, batch_size, learning_rate,
-                seed, num_ranks, has_val=False):
+                seed, num_ranks, has_val=False, streaming=False):
     """The SPMD fit path (single process, device-rank mode): ONE jitted
     ``shard_map`` training step over the ``hvd`` mesh — gradients psum
     inside the compiled program instead of per-leaf eager allreduces
     (VERDICT r1 weak #8: the advertised fit path must ride the SPMD
-    plane)."""
+    plane).  ``streaming=True`` (sharded-dataset stores only) feeds the
+    loop through ``ParquetShardIterator`` + ``prefetch_to_device``
+    instead of materializing every shard in host memory."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -126,12 +189,26 @@ def _train_spmd(model, loss_fn, store, epochs, batch_size, learning_rate,
     from horovod_tpu.cluster.store import load_rank_shard
 
     mesh = hvd.mesh()
-    shards = [load_rank_shard(store, r, num_ranks)
-              for r in range(num_ranks)]
-    per = min(len(s["x"]) for s in shards)
+    stream_src = None
+    if streaming:
+        import itertools
 
-    params = model.init(jax.random.PRNGKey(seed),
-                        jnp.asarray(shards[0]["x"][:1]))
+        # row counts come from footer metadata alone — no data reads
+        per = _min_shard_rows(store, num_ranks)
+        stream_src = _spmd_streamed_batches(
+            store, num_ranks, min(batch_size, per), epochs)
+        # peek the first global batch for the init sample (no second
+        # row-group read) and chain it back for training
+        first = next(stream_src)
+        sample = first["x"][:1]
+        stream_src = itertools.chain([first], stream_src)
+    else:
+        shards = [load_rank_shard(store, r, num_ranks)
+                  for r in range(num_ranks)]
+        per = min(len(s["x"]) for s in shards)
+        sample = shards[0]["x"][:1]
+
+    params = model.init(jax.random.PRNGKey(seed), jnp.asarray(sample))
     opt = hvd.DistributedOptimizer(optax.sgd(learning_rate, momentum=0.9),
                                    named_axes=("hvd",))
     opt_state = opt.init(params)
@@ -153,17 +230,25 @@ def _train_spmd(model, loss_fn, store, epochs, batch_size, learning_rate,
     sharded = NamedSharding(mesh, P("hvd"))
     batch_per_rank = min(batch_size, per)
     loss = None
-    for _ in range(epochs):
-        for i in range(0, max(per - batch_per_rank + 1, 1),
-                       batch_per_rank):
-            xb = np.concatenate([
-                s["x"][i:i + batch_per_rank] for s in shards])
-            yb = np.concatenate([
-                s["y"][i:i + batch_per_rank] for s in shards])
+    if streaming:
+        from horovod_tpu.utils.data import prefetch_to_device
+
+        for batch in prefetch_to_device(stream_src, size=2,
+                                        sharding=sharded):
             params, opt_state, loss = step(
-                params, opt_state,
-                jax.device_put(jnp.asarray(xb), sharded),
-                jax.device_put(jnp.asarray(yb), sharded))
+                params, opt_state, batch["x"], batch["y"])
+    else:
+        for _ in range(epochs):
+            for i in range(0, max(per - batch_per_rank + 1, 1),
+                           batch_per_rank):
+                xb = np.concatenate([
+                    s["x"][i:i + batch_per_rank] for s in shards])
+                yb = np.concatenate([
+                    s["y"][i:i + batch_per_rank] for s in shards])
+                params, opt_state, loss = step(
+                    params, opt_state,
+                    jax.device_put(jnp.asarray(xb), sharded),
+                    jax.device_put(jnp.asarray(yb), sharded))
     avg_loss = float(np.asarray(jax.device_get(loss))) \
         if loss is not None else 0.0
     ckpt.save_checkpoint(store.checkpoint_path(), params, step=0, rank=0)
@@ -209,7 +294,7 @@ class JaxEstimator:
 
     def __init__(self, model, loss=None, epochs=1, batch_size=32,
                  learning_rate=0.01, store=None, backend=None, seed=0,
-                 validation=None):
+                 validation=None, streaming=False):
         self.model = model
         self.loss = loss or _default_loss
         self.epochs = epochs
@@ -222,6 +307,10 @@ class JaxEstimator:
         # reported as val_loss in the metrics (reference:
         # spark/common/params.py 'validation')
         self.validation = validation
+        # stream row groups + device prefetch instead of loading every
+        # shard in host memory (sharded-dataset stores only; the
+        # reference's Petastorm readers stream the same way)
+        self.streaming = streaming
 
     def fit(self, x, y):
         """Materialize (x, y) shards to the store, train per rank, return
@@ -238,6 +327,13 @@ class JaxEstimator:
         from horovod_tpu.cluster.store import (materialize_shards,
                                                split_validation)
 
+        if self.streaming and not hasattr(store, "shard_row_counts"):
+            # check BEFORE materializing: the error depends only on the
+            # store type, and materialization writes the whole dataset
+            raise ValueError(
+                "streaming=True needs a sharded-dataset store "
+                "(ParquetStore/FilesystemStore); this store has no "
+                "row-group layout to stream")
         x_val = y_val = None
         if self.validation is not None:
             x, y, x_val, y_val = split_validation(x, y, self.validation)
@@ -257,13 +353,13 @@ class JaxEstimator:
             metrics = _train_spmd(
                 self.model, self.loss, store, self.epochs,
                 self.batch_size, self.learning_rate, self.seed, n,
-                has_val)
+                has_val, streaming=self.streaming)
         else:
             metrics = backend.run(
                 _train_one_rank,
                 args=(self.model, self.loss, store, self.epochs,
                       self.batch_size, self.learning_rate, self.seed, n,
-                      has_val))
+                      has_val, self.streaming))
 
         from horovod_tpu.utils import checkpoint as ckpt
 
