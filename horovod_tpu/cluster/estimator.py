@@ -44,16 +44,9 @@ def _val_loss(params, model, loss_fn, store, rank, num_ranks):
     return float(total[0] / total[1])
 
 
-def _min_shard_rows(store, num_ranks):
-    """Smallest shard's row count (footer metadata only), with the same
-    clear empty-shard error ``read_shard`` raises — streaming must not
-    degrade it to a ZeroDivisionError downstream."""
-    counts = store.shard_row_counts(num_ranks)
-    if min(counts) == 0:
-        raise ValueError(
-            f"shard {counts.index(0)} of {num_ranks} would be empty — "
-            f"rewrite with smaller rows_per_row_group or fewer ranks")
-    return min(counts)
+# shared with the torch estimator (and kept under the old name for
+# callers): the lockstep/empty-shard logic lives in utils.data
+from horovod_tpu.utils.data import min_shard_rows as _min_shard_rows  # noqa: E402
 
 
 def _train_one_rank(rank, model, loss_fn, store, epochs, batch_size,
@@ -76,18 +69,10 @@ def _train_one_rank(rank, model, loss_fn, store, epochs, batch_size,
     if streaming:
         import itertools
 
-        from horovod_tpu.utils.data import ParquetShardIterator
+        from horovod_tpu.utils.data import lockstep_shard_batches
 
-        # LOCKSTEP: every rank must run the SAME number of collective
-        # rounds.  Shards are row-group sharded and can be uneven, so
-        # cap every rank at the smallest shard's step count (the
-        # streamed analog of read_shard's trim_to_min).
-        min_rows = _min_shard_rows(store, num_ranks)
-        batch_size = min(batch_size, min_rows)
-        steps = epochs * max(min_rows // batch_size, 1)
-        batches = itertools.islice(
-            iter(ParquetShardIterator(store, rank, num_ranks,
-                                      batch_size, epochs=None)), steps)
+        batches = lockstep_shard_batches(store, rank, num_ranks,
+                                         batch_size, epochs)
         # peek the first batch for the init sample instead of paying a
         # second row-group read — chain it back for training
         first = next(batches)
@@ -327,13 +312,11 @@ class JaxEstimator:
         from horovod_tpu.cluster.store import (materialize_shards,
                                                split_validation)
 
-        if self.streaming and not hasattr(store, "shard_row_counts"):
+        if self.streaming:
             # check BEFORE materializing: the error depends only on the
             # store type, and materialization writes the whole dataset
-            raise ValueError(
-                "streaming=True needs a sharded-dataset store "
-                "(ParquetStore/FilesystemStore); this store has no "
-                "row-group layout to stream")
+            from horovod_tpu.utils.data import require_sharded_store
+            require_sharded_store(store)
         x_val = y_val = None
         if self.validation is not None:
             x, y, x_val, y_val = split_validation(x, y, self.validation)

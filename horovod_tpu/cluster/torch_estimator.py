@@ -9,8 +9,25 @@ from horovod_tpu.cluster.backend import InProcessBackend
 from horovod_tpu.cluster.store import LocalStore
 
 
+def _as_torch(xb, yb):
+    import torch
+
+    def writable(a):
+        a = np.asarray(a)
+        # torch rejects non-writable views (Arrow buffers can be
+        # read-only); copy only then
+        return a if a.flags.writeable else a.copy()
+
+    x = torch.as_tensor(writable(xb), dtype=torch.float32)
+    y = torch.as_tensor(writable(yb))
+    if y.dtype == torch.float64:
+        y = y.float()
+    return x, y
+
+
 def _train_one_rank(rank, model_factory, loss_name, store, epochs,
-                    batch_size, learning_rate, num_ranks, has_val=False):
+                    batch_size, learning_rate, num_ranks, has_val=False,
+                    streaming=False):
     import torch
 
     import horovod_tpu.torch as hvd
@@ -18,11 +35,17 @@ def _train_one_rank(rank, model_factory, loss_name, store, epochs,
 
     model = model_factory()
     loss_fn = getattr(torch.nn.functional, loss_name)
-    shard = load_rank_shard(store, rank, num_ranks)
-    x = torch.tensor(shard["x"], dtype=torch.float32)
-    y = torch.tensor(shard["y"])
-    if y.dtype == torch.float64:
-        y = y.float()
+    if streaming:
+        from horovod_tpu.utils.data import lockstep_shard_batches
+
+        batches = lockstep_shard_batches(store, rank, num_ranks,
+                                         batch_size, epochs)
+    else:
+        from horovod_tpu.utils.data import BatchIterator
+
+        shard = load_rank_shard(store, rank, num_ranks)
+        batches = BatchIterator(shard, min(batch_size, len(shard["x"])),
+                                epochs=epochs)
 
     optimizer = torch.optim.SGD(model.parameters(), lr=learning_rate,
                                 momentum=0.9)
@@ -32,12 +55,12 @@ def _train_one_rank(rank, model_factory, loss_name, store, epochs,
         optimizer, named_parameters=model.named_parameters())
 
     loss = torch.zeros(())
-    for _ in range(epochs):
-        for i in range(0, max(len(x) - batch_size + 1, 1), batch_size):
-            optimizer.zero_grad()
-            loss = loss_fn(model(x[i:i + batch_size]), y[i:i + batch_size])
-            loss.backward()
-            optimizer.step()
+    for batch in batches:
+        xb, yb = _as_torch(batch["x"], batch["y"])
+        optimizer.zero_grad()
+        loss = loss_fn(model(xb), yb)
+        loss.backward()
+        optimizer.step()
 
     import jax.numpy as jnp
 
@@ -55,10 +78,7 @@ def _train_one_rank(rank, model_factory, loss_name, store, epochs,
                    os.path.join(store.checkpoint_path(), "model.pt"))
     if has_val:
         vs = load_rank_shard(store, rank, num_ranks, split="val")
-        vx = torch.tensor(vs["x"], dtype=torch.float32)
-        vy = torch.tensor(vs["y"])
-        if vy.dtype == torch.float64:
-            vy = vy.float()
+        vx, vy = _as_torch(vs["x"], vs["y"])
         with torch.no_grad():
             local = float(loss_fn(model(vx), vy))
         rows = float(len(vx))
@@ -102,7 +122,7 @@ class TorchEstimator:
 
     def __init__(self, model_factory, loss="mse_loss", epochs=1,
                  batch_size=32, learning_rate=0.01, store=None,
-                 backend=None, validation=None):
+                 backend=None, validation=None, streaming=False):
         self.model_factory = model_factory
         self.loss = loss
         self.epochs = epochs
@@ -111,6 +131,9 @@ class TorchEstimator:
         self.store = store
         self.backend = backend
         self.validation = validation
+        # stream row groups instead of loading shards (sharded-dataset
+        # stores only; see docs/data.md)
+        self.streaming = streaming
 
     def fit(self, x, y):
         import os
@@ -126,6 +149,9 @@ class TorchEstimator:
         from horovod_tpu.cluster.store import (materialize_shards,
                                                split_validation)
 
+        if self.streaming:
+            from horovod_tpu.utils.data import require_sharded_store
+            require_sharded_store(store)
         x_val = y_val = None
         if self.validation is not None:
             x, y, x_val, y_val = split_validation(x, y, self.validation)
@@ -136,7 +162,7 @@ class TorchEstimator:
             _train_one_rank,
             args=(self.model_factory, self.loss, store, self.epochs,
                   self.batch_size, self.learning_rate, n,
-                  x_val is not None))
+                  x_val is not None, self.streaming))
 
         model = self.model_factory()
         model.load_state_dict(torch.load(

@@ -30,7 +30,9 @@ import threading
 
 import numpy as np
 
-__all__ = ["BatchIterator", "ParquetShardIterator", "prefetch_to_device"]
+__all__ = ["BatchIterator", "ParquetShardIterator", "prefetch_to_device",
+           "lockstep_shard_batches", "min_shard_rows",
+           "require_sharded_store"]
 
 
 def _tree_rows(data):
@@ -195,6 +197,44 @@ class ParquetShardIterator:
             if pending is not None and not self.drop_remainder:
                 yield pending
             epoch += 1
+
+
+def require_sharded_store(store):
+    """Fail fast (before any I/O) when a store has no row-group layout
+    to stream."""
+    if not hasattr(store, "shard_row_counts"):
+        raise ValueError(
+            "streaming=True needs a sharded-dataset store "
+            "(ParquetStore/FilesystemStore); this store has no "
+            "row-group layout to stream")
+
+
+def min_shard_rows(store, num_ranks):
+    """Smallest shard's row count (footer metadata only), with the same
+    clear empty-shard error ``read_shard`` raises — streaming must not
+    degrade it to a ZeroDivisionError downstream."""
+    counts = store.shard_row_counts(num_ranks)
+    if min(counts) == 0:
+        raise ValueError(
+            f"shard {counts.index(0)} of {num_ranks} would be empty — "
+            f"rewrite with smaller rows_per_row_group or fewer ranks")
+    return min(counts)
+
+
+def lockstep_shard_batches(store, rank, num_ranks, batch_size, epochs):
+    """One rank's streamed batches, capped so EVERY rank yields the
+    same count: row-group shards can be uneven, and a rank running more
+    per-batch collective rounds than its peers hangs the gang.  The
+    streamed analog of ``read_shard``'s equal-shard trim; shared by the
+    JAX and torch estimators' eager streaming paths."""
+    import itertools
+
+    rows = min_shard_rows(store, num_ranks)
+    batch_size = min(batch_size, rows)
+    steps = epochs * max(rows // batch_size, 1)
+    return itertools.islice(
+        iter(ParquetShardIterator(store, rank, num_ranks, batch_size,
+                                  epochs=None)), steps)
 
 
 def prefetch_to_device(iterator, size=2, *, sharding=None, mesh=None,

@@ -261,6 +261,28 @@ def test_torch_estimator_fits_from_parquet(hvd, tmp_path):
     assert fitted.evaluate(x, y) < baseline
 
 
+def test_torch_estimator_streaming_fit(hvd, tmp_path):
+    import torch
+
+    from horovod_tpu.cluster import TorchEstimator
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(64, 6).astype(np.float32)
+    w = rng.randn(6, 2).astype(np.float32)
+    y = x @ w
+
+    est = TorchEstimator(
+        lambda: torch.nn.Sequential(torch.nn.Linear(6, 16),
+                                    torch.nn.ReLU(),
+                                    torch.nn.Linear(16, 2)),
+        epochs=5, batch_size=8, learning_rate=0.05, streaming=True,
+        store=ParquetStore(str(tmp_path)))
+    fitted, metrics = est.fit(x, y)
+    assert len(metrics) == 8
+    baseline = float(np.mean((y - y.mean(0)) ** 2))
+    assert fitted.evaluate(x, y) < baseline
+
+
 def test_jax_estimator_parquet_process_backend(tmp_path):
     """2 OS processes each reading THEIR disjoint row groups from the
     shared Parquet store (the reference's actual deployment shape:
