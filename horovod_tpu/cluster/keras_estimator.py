@@ -18,20 +18,46 @@ from horovod_tpu.cluster.store import LocalStore
 
 def _train_keras_rank(rank, model_config, weights, compile_kwargs,
                       store, epochs, batch_size, learning_rate,
-                      num_ranks, has_val=False):
+                      num_ranks, has_val=False, streaming=False):
     """Runs in a worker process (ProcessBackend) or rank thread.
     ``num_ranks`` is the shard partition the dataset was materialized
-    for (the backend's process count, NOT hvd.size())."""
+    for (the backend's process count, NOT hvd.size()).  ``streaming``
+    feeds ``model.fit`` a row-group-streaming generator with a
+    lockstep ``steps_per_epoch`` (see utils.data) instead of the
+    in-memory shard arrays."""
     import keras
 
     import horovod_tpu.keras as hvd_keras
     from horovod_tpu.cluster.store import load_rank_shard
 
     model = keras.saving.deserialize_keras_object(model_config)
-    shard = load_rank_shard(store, rank, num_ranks)
-    x, y = shard["x"], shard["y"]
+    if streaming:
+        from horovod_tpu.utils.data import (ParquetShardIterator,
+                                            lockstep_plan)
+
+        batch_size, steps_per_epoch, _ = lockstep_plan(
+            store, num_ranks, batch_size, epochs)
+        stream = iter(ParquetShardIterator(store, rank, num_ranks,
+                                           batch_size, epochs=None))
+        # peek the first batch for the build shape (no second
+        # row-group read) and hand it back through the generator
+        first = next(stream)
+
+        def gen(batch=first):
+            while True:
+                yield np.asarray(batch["x"]), np.asarray(batch["y"])
+                batch = next(stream)
+
+        fit_data = {"x": gen(), "steps_per_epoch": steps_per_epoch}
+        build_shape = (None,) + tuple(first["x"].shape[1:])
+    else:
+        shard = load_rank_shard(store, rank, num_ranks)
+        x, y = shard["x"], shard["y"]
+        fit_data = {"x": np.asarray(x), "y": np.asarray(y),
+                    "batch_size": batch_size}
+        build_shape = (None,) + tuple(np.asarray(x).shape[1:])
     if not model.built:
-        model.build((None,) + tuple(np.asarray(x).shape[1:]))
+        model.build(build_shape)
     model.set_weights(weights)
 
     optimizer = hvd_keras.DistributedOptimizer(
@@ -52,9 +78,8 @@ def _train_keras_rank(rank, model_config, weights, compile_kwargs,
         vs = load_rank_shard(store, rank, num_ranks, split="val")
         vx, vy = np.asarray(vs["x"]), np.asarray(vs["y"])
         fit_kwargs["validation_data"] = (vx, vy)
-    history = model.fit(np.asarray(x), np.asarray(y),
-                        batch_size=batch_size, epochs=epochs,
-                        callbacks=callbacks, verbose=0, **fit_kwargs)
+    history = model.fit(epochs=epochs, callbacks=callbacks, verbose=0,
+                        **fit_data, **fit_kwargs)
 
     if hvd_keras.rank() == 0:
         path = store.checkpoint_path()
@@ -110,7 +135,7 @@ class KerasEstimator:
 
     def __init__(self, model, loss="mse", optimizer="sgd", metrics=None,
                  epochs=1, batch_size=32, learning_rate=0.01, store=None,
-                 backend=None, validation=None):
+                 backend=None, validation=None, streaming=False):
         self.model = model
         self.loss = loss
         self.optimizer = optimizer
@@ -121,6 +146,9 @@ class KerasEstimator:
         self.store = store
         self.backend = backend
         self.validation = validation
+        # stream row groups instead of loading shards (sharded-dataset
+        # stores only; see docs/data.md)
+        self.streaming = streaming
 
     def fit(self, x, y):
         import tempfile
@@ -135,6 +163,9 @@ class KerasEstimator:
         n = backend.num_processes()
         from horovod_tpu.cluster.store import split_validation
 
+        if self.streaming:
+            from horovod_tpu.utils.data import require_sharded_store
+            require_sharded_store(store)
         x_val = y_val = None
         if self.validation is not None:
             x, y, x_val, y_val = split_validation(x, y, self.validation)
@@ -152,7 +183,7 @@ class KerasEstimator:
             _train_keras_rank,
             args=(model_config, weights, compile_kwargs, store,
                   self.epochs, self.batch_size, self.learning_rate, n,
-                  x_val is not None))
+                  x_val is not None, self.streaming))
 
         trained = keras.saving.deserialize_keras_object(model_config)
         if not trained.built:

@@ -31,7 +31,7 @@ import threading
 import numpy as np
 
 __all__ = ["BatchIterator", "ParquetShardIterator", "prefetch_to_device",
-           "lockstep_shard_batches", "min_shard_rows",
+           "lockstep_plan", "lockstep_shard_batches", "min_shard_rows",
            "require_sharded_store"]
 
 
@@ -221,17 +221,26 @@ def min_shard_rows(store, num_ranks):
     return min(counts)
 
 
-def lockstep_shard_batches(store, rank, num_ranks, batch_size, epochs):
-    """One rank's streamed batches, capped so EVERY rank yields the
-    same count: row-group shards can be uneven, and a rank running more
-    per-batch collective rounds than its peers hangs the gang.  The
-    streamed analog of ``read_shard``'s equal-shard trim; shared by the
-    JAX and torch estimators' eager streaming paths."""
-    import itertools
-
+def lockstep_plan(store, num_ranks, batch_size, epochs):
+    """The lockstep trim: (clamped batch_size, steps_per_epoch, total
+    steps) derived from the SMALLEST shard, identical on every rank —
+    a rank running more per-batch collective rounds than its peers
+    hangs the gang.  The streamed analog of ``read_shard``'s
+    equal-shard trim; single source of truth for all three estimators'
+    streaming paths."""
     rows = min_shard_rows(store, num_ranks)
     batch_size = min(batch_size, rows)
-    steps = epochs * max(rows // batch_size, 1)
+    steps_per_epoch = max(rows // batch_size, 1)
+    return batch_size, steps_per_epoch, epochs * steps_per_epoch
+
+
+def lockstep_shard_batches(store, rank, num_ranks, batch_size, epochs):
+    """One rank's streamed batches under the :func:`lockstep_plan` cap
+    (JAX and torch eager streaming paths)."""
+    import itertools
+
+    batch_size, _, steps = lockstep_plan(store, num_ranks, batch_size,
+                                         epochs)
     return itertools.islice(
         iter(ParquetShardIterator(store, rank, num_ranks, batch_size,
                                   epochs=None)), steps)

@@ -283,6 +283,30 @@ def test_torch_estimator_streaming_fit(hvd, tmp_path):
     assert fitted.evaluate(x, y) < baseline
 
 
+def test_keras_estimator_streaming_fit(tmp_path):
+    pytest.importorskip("tensorflow")
+    import keras
+
+    from horovod_tpu.cluster import KerasEstimator
+    from horovod_tpu.cluster.backend import ProcessBackend
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(64, 6).astype(np.float32)
+    w = rng.randn(6, 2).astype(np.float32)
+    y = x @ w
+
+    model = keras.Sequential([keras.layers.Dense(16, activation="relu"),
+                              keras.layers.Dense(2)])
+    est = KerasEstimator(model, epochs=5, batch_size=8,
+                         learning_rate=0.05, streaming=True,
+                         store=ParquetStore(str(tmp_path)),
+                         backend=ProcessBackend(2, jax_platform="cpu"))
+    fitted, metrics = est.fit(x, y)
+    assert len(metrics) == 2
+    baseline = float(np.mean((y - y.mean(0)) ** 2))
+    assert fitted.evaluate(x, y) < baseline
+
+
 def test_jax_estimator_parquet_process_backend(tmp_path):
     """2 OS processes each reading THEIR disjoint row groups from the
     shared Parquet store (the reference's actual deployment shape:
