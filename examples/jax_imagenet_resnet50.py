@@ -23,13 +23,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu import callbacks
 from horovod_tpu.models import ResNet50
 from horovod_tpu.parallel._compat import shard_map
 from horovod_tpu.utils import checkpoint as ckpt
+from horovod_tpu.utils.data import prefetch_to_device
 
 
 def iter_shards(data_dir, batch, rank, size, synthetic_steps, seed=0):
@@ -134,19 +135,25 @@ def main():
         return top1, top5
 
     eval_jit = jax.jit(eval_step)
-    sharded = NamedSharding(mesh, P("hvd"))
 
     for epoch in range(start_epoch, args.epochs):
         t0 = time.perf_counter()
         images = 0
         loss = None
-        for x, y in iter_shards(args.train_dir, global_batch, hvd.cross_rank(),
-                                hvd.cross_size(), args.steps, seed=epoch):
-            xd = jax.device_put(jnp.asarray(x), sharded)
-            yd = jax.device_put(jnp.asarray(y), sharded)
+        # double-buffered device staging: batch N+1's host->device copy
+        # overlaps step N's compute instead of serializing after it.
+        # mesh= builds the GLOBAL batch from each process's local rows
+        # (multi-host correct; single-process: local rows == global)
+        local_batch = global_batch // jax.process_count()
+        for batch in prefetch_to_device(
+                iter_shards(args.train_dir, local_batch,
+                            hvd.cross_rank(), hvd.cross_size(),
+                            args.steps, seed=epoch),
+                size=2, mesh=mesh):
+            xd, yd = batch
             params, batch_stats, opt_state, loss = step(
                 params, batch_stats, opt_state, xd, yd)
-            images += len(x)
+            images += xd.shape[0]
         loss_val = float(np.asarray(jax.device_get(loss))) \
             if loss is not None else float("nan")
         rate = images / (time.perf_counter() - t0)

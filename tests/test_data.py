@@ -221,6 +221,28 @@ def test_parquet_shard_smaller_than_batch_raises(parquet_store):
         ParquetShardIterator(parquet_store, 0, 2, batch_size=64)
 
 
+def test_parquet_stream_bf16_to_device(tmp_path):
+    """bf16 columns stream through the pipeline and land on device as
+    bf16 jax.Arrays (the TPU training dtype)."""
+    pytest.importorskip("pyarrow")
+    import ml_dtypes
+    import jax.numpy as jnp
+
+    from horovod_tpu.cluster.parquet_store import ParquetStore
+
+    store = ParquetStore(str(tmp_path / "bf16"), rows_per_row_group=8)
+    x = np.arange(64, dtype=np.float32).astype(
+        ml_dtypes.bfloat16).reshape(32, 2)
+    store.materialize({"x": x})
+    batches = list(prefetch_to_device(
+        iter(ParquetShardIterator(store, 0, 1, batch_size=8))))
+    assert len(batches) == 4
+    assert batches[0]["x"].dtype == jnp.bfloat16
+    got = np.concatenate([np.asarray(b["x"].astype(jnp.float32))
+                          for b in batches])
+    np.testing.assert_array_equal(got, x.astype(np.float32))
+
+
 def test_prefetch_rejects_bad_args():
     with pytest.raises(ValueError, match="size"):
         prefetch_to_device(iter([]), size=0)
