@@ -172,6 +172,44 @@ def test_estimator_fit_through_spark_backend(tmp_path):
     assert "SPARK_ESTIMATOR_OK" in result.stdout
 
 
+STREAMING_ESTIMATOR_DRIVER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from horovod_tpu.models import MLP
+from horovod_tpu.cluster import JaxEstimator, ParquetStore
+from horovod_tpu.spark import SparkBackend
+
+rng = np.random.RandomState(1)
+x = rng.randn(64, 8).astype(np.float32)
+w = rng.randn(8, 3).astype(np.float32)
+y = (x @ w).astype(np.float32)
+
+# the full reference deployment shape: Spark schedules the workers, the
+# Parquet store carries the data, each task STREAMS its disjoint row
+# groups (Petastorm-reader analog) instead of loading its shard
+est = JaxEstimator(MLP(features=(16, 3)), epochs=6, batch_size=8,
+                   learning_rate=0.05, streaming=True,
+                   store=ParquetStore({store_path!r}),
+                   backend=SparkBackend(num_proc=2, jax_platform="cpu"))
+model, metrics = est.fit(x, y)
+assert len(metrics) == 2
+mse = float(np.mean((np.asarray(model.predict(x)) - y) ** 2))
+assert mse < np.mean(y ** 2) * 0.5, (mse, float(np.mean(y ** 2)))
+print("SPARK_STREAMING_ESTIMATOR_OK", flush=True)
+"""
+
+
+def test_streaming_estimator_through_spark_backend(tmp_path):
+    driver = STREAMING_ESTIMATOR_DRIVER.format(
+        store_path=str(tmp_path / "pq_store"))
+    result = _run_driver(driver, timeout=900)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert "SPARK_STREAMING_ESTIMATOR_OK" in result.stdout
+
+
 def test_import_guard_without_pyspark():
     """Without pyspark on the path the attachment raises the documented
     ImportError while the Spark-free estimators stay importable."""
