@@ -1,0 +1,34 @@
+"""The TPU banking recipe stays runnable: ``bin/bank-tpu --cpu-smoke``
+executes the same compiled-kernel validation code paths the real-chip
+windows use (tiny shapes, interpret mode), so a code change that would
+break the next scarce relay window fails HERE instead (BENCH_NOTES:
+round-4's first window was nearly lost to exactly such drift)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bank_tpu_cpu_smoke():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "bank-tpu"),
+         "--cpu-smoke"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout[-3000:]}\nstderr:\n{result.stderr[-2000:]}"
+    assert "CPU smoke of the banking recipe: OK" in result.stdout
+
+
+def test_bank_tpu_rejects_unknown_flags():
+    """A typo must not silently bank nothing with rc=0 during a scarce
+    relay window (bank-tpu's own guard)."""
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "bank-tpu"),
+         "--kernel"],  # typo for --kernels
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert result.returncode == 2
+    assert "unknown flag" in result.stderr
