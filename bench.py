@@ -564,6 +564,112 @@ def scaling_worker():
                       "per_device_batch": per_device_batch}))
 
 
+def _bench_pipeline(devices, steps=None, batch=None, img=None):
+    """Input-pipeline overlap measurement: the same host-fed training
+    loop with and without ``prefetch_to_device``.  The copy cost the
+    prefetcher hides is the host→device batch transfer — negligible on
+    the CPU mesh (gain ≈ 1.0 expected), large through the TPU relay,
+    where round-2 notes measured the transfer dominating eager-path
+    time.  Returns {img_sec_plain, img_sec_prefetch, overlap_gain}."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel import make_mesh
+    from horovod_tpu.parallel._compat import shard_map
+    from horovod_tpu.utils.data import prefetch_to_device
+
+    n = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+    # CPU-mesh smoke shapes vs real-chip shapes: the conv at full
+    # ImageNet size is minutes per step on 8 virtual CPU devices
+    steps = steps or (48 if on_tpu else 10)
+    batch = batch or (32 if on_tpu else 4)
+    img = img or (224 if on_tpu else 64)
+    mesh = make_mesh({"hvd": n}, devices=devices)
+    sharded = NamedSharding(mesh, P("hvd"))
+    global_batch = batch * n
+
+    # small conv stack: enough compute to overlap against, small enough
+    # that the [B,224,224,3] host->device copy is a real fraction
+    key = jax.random.PRNGKey(0)
+    params = {
+        "w1": jax.random.normal(key, (3, 3, 3, 16), jnp.bfloat16) * 0.1,
+        "w2": jax.random.normal(key, (3, 3, 16, 16), jnp.bfloat16) * 0.1,
+    }
+
+    def per_shard(params, x):
+        h = jax.lax.conv_general_dilated(
+            x.astype(jnp.bfloat16), params["w1"], (2, 2), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        h = jax.nn.relu(h)
+        h = jax.lax.conv_general_dilated(
+            h, params["w2"], (2, 2), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return jax.lax.pmean(jnp.mean(h.astype(jnp.float32)), "hvd")
+
+    fwd = jax.jit(shard_map(per_shard, mesh=mesh,
+                            in_specs=(P(), P("hvd")), out_specs=P()))
+
+    rng = np.random.RandomState(0)
+    host_batches = [rng.rand(global_batch, img, img, 3)
+                    .astype(np.float32) for _ in range(8)]
+
+    def batches():
+        for i in range(steps):
+            yield host_batches[i % len(host_batches)]
+
+    # warmup compiles
+    out = fwd(params, jax.device_put(host_batches[0], sharded))
+    float(jax.device_get(out))
+
+    t0 = time.perf_counter()
+    for x in batches():
+        out = fwd(params, jax.device_put(x, sharded))
+    plain_s = _sync_elapsed(t0, out)
+
+    t0 = time.perf_counter()
+    for xd in prefetch_to_device(batches(), size=2, sharding=sharded):
+        out = fwd(params, xd)
+    prefetch_s = _sync_elapsed(t0, out)
+
+    imgs = steps * global_batch
+    return {"img_sec_plain": round(imgs / plain_s, 1),
+            "img_sec_prefetch": round(imgs / prefetch_s, 1),
+            "overlap_gain": round(plain_s / prefetch_s, 3),
+            "batch_global": global_batch, "steps": steps, "img": img}
+
+
+def _sync_elapsed(t0, out):
+    """Elapsed seconds synchronized through a device_get of the final
+    step's output (BENCH_NOTES: block_until_ready returns early on the
+    relayed backend)."""
+    import jax
+
+    float(jax.device_get(out))
+    return time.perf_counter() - t0
+
+
+def pipeline_worker():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
+            os.environ.get("BENCH_CPU_FALLBACK"):
+        # the axon plugin ignores JAX_PLATFORMS; pin programmatically
+        # (a down relay otherwise BLOCKS jax.devices() forever) — and
+        # give the CPU smoke a real 8-device mesh like the scaling leg
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    print(json.dumps({"pipeline": _bench_pipeline(devices),
+                      "platform": devices[0].platform}))
+
+
 def _run_scaling(timeout=600):
     """Run the scaling harness in a CPU-forced subprocess; returns the
     parsed dict or None."""
@@ -797,6 +903,8 @@ if __name__ == "__main__":
         profile_worker()
     elif "--scaling-worker" in sys.argv:
         scaling_worker()
+    elif "--pipeline" in sys.argv:
+        pipeline_worker()
     elif "--scaling" in sys.argv:
         result = _run_scaling()
         print(json.dumps(result if result is not None else
