@@ -1,0 +1,78 @@
+"""Drop-in import alias: ``import horovod.torch as hvd`` works unchanged.
+
+Migration surface (reference namespace: the ``horovod/`` tree): every
+``horovod.*`` import path — top-level bindings AND their submodules
+(``horovod.torch.compression``, ``horovod.run.runner``,
+``horovod.spark.keras``, ...) — resolves to the SAME module object as
+its ``horovod_tpu`` implementation, so existing Horovod training
+scripts run without touching their imports and identity/isinstance
+checks hold across both spellings.
+
+Mechanism: a meta-path finder maps ``horovod.X`` -> ``horovod_tpu.X``
+(plus the reference's special case ``horovod.tensorflow.keras`` ->
+``horovod_tpu.keras``) and hands the already-imported implementation
+module to the import machinery via a loader whose ``create_module``
+returns it — no second copy is ever executed.
+
+The JAX-native surface (this framework's recommended API) also rides
+the top level: ``import horovod as hvd; hvd.init()``.
+"""
+
+import importlib
+import importlib.abc
+import importlib.machinery
+import sys as _sys
+
+import horovod_tpu as _impl
+
+__version__ = getattr(_impl, "__version__", "0.0")
+
+# reference special case: the tf-keras binding lives at
+# horovod.tensorflow.keras but our implementation module is
+# horovod_tpu.keras (horovod_tpu.tensorflow has no keras submodule)
+_SPECIAL = {"horovod.tensorflow.keras": "horovod_tpu.keras"}
+
+
+class _AliasLoader(importlib.abc.Loader):
+    def __init__(self, impl):
+        self._impl = impl
+
+    def create_module(self, spec):
+        # hand the machinery the ALREADY-imported implementation module
+        # so sys.modules['horovod.X'] is horovod_tpu.X itself
+        return self._impl
+
+    def exec_module(self, module):
+        pass  # already executed under its horovod_tpu name
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith("horovod."):
+            return None
+        impl_name = _SPECIAL.get(
+            fullname, "horovod_tpu." + fullname[len("horovod."):])
+        try:
+            impl = importlib.import_module(impl_name)
+        except ModuleNotFoundError as exc:
+            if exc.name and (impl_name == exc.name
+                             or impl_name.startswith(exc.name + ".")):
+                return None  # no such implementation module
+            raise  # impl exists; a real dependency is missing
+        return importlib.machinery.ModuleSpec(
+            fullname, _AliasLoader(impl),
+            is_package=hasattr(impl, "__path__"))
+
+
+_sys.meta_path.insert(0, _AliasFinder())
+
+
+def __getattr__(name):
+    # top-level parity: horovod.init / rank / allreduce / ... delegate
+    # to the horovod_tpu surface
+    return getattr(_impl, name)
+
+
+def __dir__():
+    return sorted(set(dir(_impl)) | {"torch", "tensorflow", "keras",
+                                     "mxnet", "spark", "run"})

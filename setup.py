@@ -45,6 +45,7 @@ setup(
     description=("TPU-native distributed deep-learning training "
                  "framework with the Horovod capability surface"),
     packages=[
+        "horovod",        # drop-in import alias (horovod.* paths)
         "horovod_tpu",
         "horovod_tpu.common",
         "horovod_tpu.cluster",
