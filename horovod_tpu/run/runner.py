@@ -22,7 +22,9 @@ from horovod_tpu.utils import env as env_util
 
 def make_parser():
     parser = argparse.ArgumentParser(
-        prog="hvdrun",
+        # derive from argv[0]: the launcher answers to both its own
+        # name (hvdrun) and the reference's (horovodrun alias)
+        prog=os.path.basename(sys.argv[0]) or "hvdrun",
         description="Launch a horovod_tpu distributed job.")
     parser.add_argument("-np", "--num-proc", type=int, default=None,
                         help="Total number of training processes.")

@@ -72,6 +72,7 @@ setup(
     entry_points={
         "console_scripts": [
             "hvdrun = horovod_tpu.run.runner:main",
+            "horovodrun = horovod_tpu.run.runner:main",
         ],
     },
     cmdclass={"build_py": BuildWithNativeCore},

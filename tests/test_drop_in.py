@@ -68,6 +68,23 @@ def test_reference_tensorflow_keras_path():
     assert "TF_KERAS_PATH_OK" in result.stdout
 
 
+def _clean_worker_env():
+    """Env for worker-spawning drop-in tests, simulating a clean user
+    shell: this image boots with JAX_PLATFORMS=axon,cpu and a
+    sitecustomize that programmatically registers the relayed-TPU
+    backend whenever PALLAS_AXON_POOL_IPS is set — a worker inheriting
+    those would select the (dead) relay regardless of the env pin.
+    Strip the harness vars and pin cpu."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    for k in list(env):
+        if k.startswith(("AXON", "PALLAS_AXON", "_AXON", "TPU_")) \
+                or k == "PJRT_LIBRARY_PATH":
+            env.pop(k)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 def test_unmodified_reference_style_script_trains(tmp_path):
     """A training script written against the REFERENCE API (imports and
     all) runs under hvdrun with zero changes."""
@@ -102,22 +119,58 @@ def test_unmodified_reference_style_script_trains(tmp_path):
         "assert last < first * 0.5, (first, last)\n"
         "if hvd.rank() == 0:\n"
         "    print('REFERENCE_STYLE_TRAIN_OK')\n")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    # simulate a clean user shell: this image boots with
-    # JAX_PLATFORMS=axon,cpu and a sitecustomize that programmatically
-    # registers the relayed-TPU backend whenever PALLAS_AXON_POOL_IPS
-    # is set — a worker inheriting those would select the (dead) relay
-    # regardless of the env pin.  Strip the harness vars and pin cpu.
-    for k in list(env):
-        if k.startswith(("AXON", "PALLAS_AXON", "_AXON", "TPU_")) \
-                or k == "PJRT_LIBRARY_PATH":
-            env.pop(k)
-    env["JAX_PLATFORMS"] = "cpu"
     result = subprocess.run(
         [sys.executable, os.path.join(REPO, "bin", "hvdrun"),
          "-np", "2", sys.executable, str(script)],
-        env=env, capture_output=True, text=True, timeout=420)
+        env=_clean_worker_env(), capture_output=True, text=True,
+        timeout=420)
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout[-2000:]}\nstderr:\n{result.stderr[-2000:]}"
     assert "REFERENCE_STYLE_TRAIN_OK" in result.stdout
+
+
+def test_unmodified_reference_style_tf_script_under_horovodrun(tmp_path):
+    """The TF flavor, launched with the reference's own CLI name
+    (``horovodrun``): DistributedGradientTape + broadcast_variables,
+    imports unchanged."""
+    import pytest
+
+    pytest.importorskip("tensorflow")
+    script = tmp_path / "train_tf.py"
+    script.write_text(
+        "import numpy as np\n"
+        "import tensorflow as tf\n"
+        "import horovod.tensorflow as hvd\n"     # reference import
+        "\n"
+        "hvd.init()\n"
+        "tf.random.set_seed(1 + hvd.rank())\n"
+        "model = tf.keras.Sequential("
+        "[tf.keras.layers.Dense(2, input_shape=(4,))])\n"
+        "opt = tf.keras.optimizers.SGD(0.05 * hvd.size())\n"
+        "rng = np.random.RandomState(hvd.rank())\n"
+        "x = tf.constant(rng.randn(32, 4), dtype=tf.float32)\n"
+        "w = tf.constant([[1., 0.], [0., 1.], [1., 1.], [0., 0.]])\n"
+        "y = x @ w\n"
+        "first = last = None\n"
+        "for step in range(25):\n"
+        "    with tf.GradientTape() as tape:\n"
+        "        loss = tf.reduce_mean((model(x) - y) ** 2)\n"
+        "    tape = hvd.DistributedGradientTape(tape)\n"
+        "    grads = tape.gradient(loss, model.trainable_variables)\n"
+        "    opt.apply_gradients(zip(grads, model.trainable_variables))\n"
+        "    if step == 0:\n"
+        "        hvd.broadcast_variables(model.variables, root_rank=0)\n"
+        "        hvd.broadcast_variables(opt.variables, root_rank=0)\n"
+        "    last = float(loss)\n"
+        "    first = first if first is not None else last\n"
+        "assert last < first * 0.5, (first, last)\n"
+        "if hvd.rank() == 0:\n"
+        "    print('TF_REFERENCE_STYLE_OK')\n")
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "horovodrun"),
+         "-np", "2", sys.executable, str(script)],
+        env=_clean_worker_env(), capture_output=True, text=True,
+        timeout=420)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout[-2000:]}\nstderr:\n{result.stderr[-2000:]}"
+    assert "TF_REFERENCE_STYLE_OK" in result.stdout
