@@ -79,3 +79,17 @@ def test_last_tpu_measurement_never_crashes(tmp_path, monkeypatch):
     got = bench._last_tpu_measurement()
     assert got["resnet50_synthetic_img_sec_per_chip"] == 42.0
     assert got["date"] == "2026-07-30"
+
+
+def test_pipeline_leg_smoke():
+    """The --pipeline overlap leg runs on the CPU mesh with tiny
+    shapes and returns a well-formed record (on-chip it banks via
+    bin/bank-tpu)."""
+    import jax
+
+    import bench
+
+    r = bench._bench_pipeline(jax.devices(), steps=4, batch=2, img=32)
+    assert r["img_sec_plain"] > 0 and r["img_sec_prefetch"] > 0
+    assert r["steps"] == 4 and r["img"] == 32
+    assert 0.1 < r["overlap_gain"] < 10
