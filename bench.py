@@ -321,6 +321,88 @@ def _bench_allreduce_bandwidth():
     return sweep()
 
 
+def _bench_ring_allreduce_bandwidth(p=4):
+    """Quantized TCP-ring sweep (ISSUE 1 acceptance: on payloads >= 4MB
+    the int8 ring must move >= 2x the effective GB/s of the
+    uncompressed ring on the same host — bytes-on-wire shrink ~4x, 2x
+    end-to-end leaves room for quantize overhead).
+
+    Same-host worker ring over real loopback TCP: ``p`` threads, one
+    PeerService mailbox + RingPlane per rank, exactly the transport the
+    multi-process tcp mode uses.  Effective GB/s = payload bytes x iters
+    / wall time (algorithmic bandwidth, same convention as the eager
+    sweep)."""
+    import threading
+
+    import numpy as np
+
+    from horovod_tpu.ops.tcp_dataplane import PeerService, RingPlane
+    from horovod_tpu.run.service import network
+
+    key = b"0" * 32
+    services = [PeerService(key) for _ in range(p)]
+
+    def resolver(rank):
+        return network.MuxClient([("127.0.0.1", services[rank].port)],
+                                 key, timeout=60)
+
+    planes = [RingPlane(r, services[r], resolver) for r in range(p)]
+    ring_seq = [0]
+
+    def run_all(data, compression):
+        errs = []
+
+        def run(r):
+            try:
+                planes[r].allreduce(
+                    ring_seq[0], data[r], list(range(p)),
+                    op_average=False, world_size=p, timeout=300,
+                    compression=compression)
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=run, args=(r,))
+                   for r in range(p)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    sizes = [1 << 20, 1 << 22, 1 << 24]
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        sizes = sizes[:2]
+    out = {"ring": {}, "int8": {}, "speedup": {}}
+    try:
+        for nbytes in sizes:
+            n_elem = nbytes // 4
+            rng = np.random.RandomState(0)
+            data = [rng.randn(n_elem).astype(np.float32)
+                    for _ in range(p)]
+            label = (f"{nbytes // (1 << 20)}MB" if nbytes >= (1 << 20)
+                     else f"{nbytes // (1 << 10)}KB")
+            for comp, bucket in (("none", "ring"), ("int8", "int8")):
+                ring_seq[0] += 1
+                run_all(data, comp)  # warmup (connection setup)
+                iters = 3
+                start = time.perf_counter()
+                for _ in range(iters):
+                    ring_seq[0] += 1
+                    run_all(data, comp)
+                elapsed = time.perf_counter() - start
+                out[bucket][label] = round(
+                    nbytes * iters / elapsed / 1e9, 3)
+            out["speedup"][label] = round(
+                out["int8"][label] / out["ring"][label], 2)
+    finally:
+        for plane in planes:
+            plane.close()
+        for svc in services:
+            svc.shutdown()
+    return out
+
+
 def worker():
     # watchdog: a held/unreachable TPU can make backend init BLOCK
     # (not fail); bail out so the supervisor's retry loop stays snappy
@@ -408,6 +490,9 @@ def worker():
             "transformer": None,
             "allreduce_gbs": None,
             "allreduce_gbs_device": None,
+            "allreduce_gbs_ring": None,
+            "allreduce_gbs_int8": None,
+            "allreduce_int8_speedup": None,
         },
     }
     state["record"] = record
@@ -436,6 +521,14 @@ def worker():
     gbs, gbs_device = _bench_allreduce_bandwidth()
     record["extra"]["allreduce_gbs"] = gbs
     record["extra"]["allreduce_gbs_device"] = gbs_device
+    state["last"] = time.time()
+    try:
+        ring = _bench_ring_allreduce_bandwidth()
+        record["extra"]["allreduce_gbs_ring"] = ring["ring"]
+        record["extra"]["allreduce_gbs_int8"] = ring["int8"]
+        record["extra"]["allreduce_int8_speedup"] = ring["speedup"]
+    except Exception as exc:  # never lose the headline to the ring leg
+        sys.stderr.write(f"int8 ring bench failed: {exc!r}\n")
     state["last"] = time.time()
     # print BEFORE shutdown: a shutdown stall (relay death at the
     # barrier) must not cost a complete measurement.  Under the lock,

@@ -166,7 +166,8 @@ void* hvd_pm_create(int warmup, int steady_state, int bayes_max,
                     double gp_noise, const char* log_path,
                     int64_t fusion_bytes, double cycle_ms,
                     int hier_allreduce, int hier_allgather,
-                    int cache_enabled) {
+                    int cache_enabled, int compression,
+                    int compression_available) {
   hvd::ParameterManager::Options o;
   o.active = true;
   o.warmup_samples = warmup;
@@ -184,6 +185,8 @@ void* hvd_pm_create(int warmup, int steady_state, int bayes_max,
   o.hierarchical_allreduce = hier_allreduce != 0;
   o.hierarchical_allgather = hier_allgather != 0;
   o.cache_enabled = cache_enabled != 0;
+  o.compression = compression != 0;
+  o.compression_available = compression_available != 0;
   return new hvd::ParameterManager(o);
 }
 
@@ -221,6 +224,11 @@ int hvd_pm_hierarchical_allgather(void* pm) {
 
 int hvd_pm_cache_enabled(void* pm) {
   return static_cast<hvd::ParameterManager*>(pm)->cache_enabled() ? 1 : 0;
+}
+
+int hvd_pm_compression_enabled(void* pm) {
+  return static_cast<hvd::ParameterManager*>(pm)->compression_enabled() ? 1
+                                                                        : 0;
 }
 
 int hvd_pm_tuning(void* pm) {

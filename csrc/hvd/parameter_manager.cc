@@ -27,35 +27,46 @@ ParameterManager::ParameterManager(const Options& opts)
                                               (1024.0 * 1024.0)))),
       best_cycle_ms_(opts.cycle_time_ms),
       best_cat_{opts.hierarchical_allreduce, opts.hierarchical_allgather,
-                opts.cache_enabled},
+                opts.cache_enabled, opts.compression},
       fusion_bytes_(opts.fusion_threshold_bytes),
       cycle_ms_(opts.cycle_time_ms),
       hier_allreduce_(opts.hierarchical_allreduce),
       hier_allgather_(opts.hierarchical_allgather),
       cache_enabled_(opts.cache_enabled),
+      compression_(opts.compression),
       tuning_(opts.active),
       best_score_(0.0) {
   if (!opts.active) return;
   // Categorical walk (reference tries its CategoricalParameters
-  // sequentially; same set here: hierarchy on/off, cache on/off).
+  // sequentially; same set here: hierarchy on/off, cache on/off, and —
+  // when a compressor is configured — wire compression on/off).
+  const bool comp = opts.compression;
   walk_ = {
-      {false, false, true},
-      {true, false, true},
-      {false, true, true},
-      {true, true, true},
-      {false, false, false},
+      {false, false, true, comp},
+      {true, false, true, comp},
+      {false, true, true, comp},
+      {true, true, true, comp},
+      {false, false, false, comp},
   };
+  if (opts.compression_available) {
+    // one probe of the opposite compression state at the default
+    // schedule configuration — enough for the score to decide whether
+    // the quantize overhead pays for the wire savings on this job
+    walk_.push_back({false, false, true, !comp});
+  }
   // The walk starts at the CONFIGURED categorical so the first tuning
   // samples — and everything published before the walk advances —
   // respect the operator's explicit hierarchical/cache choices instead
   // of silently flipping them off (the reference seeds its parameter
   // manager from the configured values before tuning).
   const Categorical seed{opts.hierarchical_allreduce,
-                         opts.hierarchical_allgather, opts.cache_enabled};
+                         opts.hierarchical_allgather, opts.cache_enabled,
+                         opts.compression};
   auto same = [&seed](const Categorical& c) {
     return c.hier_allreduce == seed.hier_allreduce &&
            c.hier_allgather == seed.hier_allgather &&
-           c.cache_enabled == seed.cache_enabled;
+           c.cache_enabled == seed.cache_enabled &&
+           c.compression == seed.compression;
   };
   walk_.erase(std::remove_if(walk_.begin(), walk_.end(), same), walk_.end());
   walk_.insert(walk_.begin(), seed);
@@ -65,7 +76,7 @@ ParameterManager::ParameterManager(const Options& opts)
       std::fprintf(log_,
                    "score_bytes_per_sec,fusion_threshold_mb,cycle_time_ms,"
                    "hierarchical_allreduce,hierarchical_allgather,"
-                   "cache_enabled\n");
+                   "cache_enabled,compression\n");
     }
   }
   bayes_ = std::make_unique<optim::BayesianOptimizer>(
@@ -92,6 +103,7 @@ void ParameterManager::ApplyPoint(const std::vector<double>& point) {
   hier_allreduce_.store(cat.hier_allreduce);
   hier_allgather_.store(cat.hier_allgather);
   cache_enabled_.store(cat.cache_enabled);
+  compression_.store(cat.compression);
   discard_left_ = opts_.warmup_samples;
   window_scores_.clear();
   window_bytes_ = 0;
@@ -104,6 +116,7 @@ void ParameterManager::ApplyBest() {
   hier_allreduce_.store(best_cat_.hier_allreduce);
   hier_allgather_.store(best_cat_.hier_allgather);
   cache_enabled_.store(best_cat_.cache_enabled);
+  compression_.store(best_cat_.compression);
   tuning_.store(false);
   if (log_) {
     std::fflush(log_);
@@ -125,10 +138,11 @@ void ParameterManager::NextCategorical() {
 
 void ParameterManager::LogRow(double score) {
   if (!log_) return;
-  std::fprintf(log_, "%.1f,%.2f,%.2f,%d,%d,%d\n", score,
+  std::fprintf(log_, "%.1f,%.2f,%.2f,%d,%d,%d,%d\n", score,
                static_cast<double>(fusion_bytes_.load()) / (1024.0 * 1024.0),
                cycle_ms_.load(), hier_allreduce_.load() ? 1 : 0,
-               hier_allgather_.load() ? 1 : 0, cache_enabled_.load() ? 1 : 0);
+               hier_allgather_.load() ? 1 : 0, cache_enabled_.load() ? 1 : 0,
+               compression_.load() ? 1 : 0);
 }
 
 bool ParameterManager::Update(double now_seconds) {

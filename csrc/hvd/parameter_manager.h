@@ -50,6 +50,12 @@ class ParameterManager {
     bool hierarchical_allreduce = false;
     bool hierarchical_allgather = false;
     bool cache_enabled = true;
+    // On-the-wire compression toggle (HVD_TPU_COMPRESSION).  Only part
+    // of the categorical walk when `compression_available` — unlike the
+    // hierarchical switches it changes numerics, so it is explored only
+    // when the operator configured a compressor.
+    bool compression = false;
+    bool compression_available = false;
   };
 
   explicit ParameterManager(const Options& opts);
@@ -70,13 +76,14 @@ class ParameterManager {
   bool hierarchical_allreduce() const { return hier_allreduce_.load(); }
   bool hierarchical_allgather() const { return hier_allgather_.load(); }
   bool cache_enabled() const { return cache_enabled_.load(); }
+  bool compression_enabled() const { return compression_.load(); }
 
   bool tuning() const { return tuning_.load(); }
   double best_score() const { return best_score_.load(); }  // bytes/sec
 
  private:
   struct Categorical {
-    bool hier_allreduce, hier_allgather, cache_enabled;
+    bool hier_allreduce, hier_allgather, cache_enabled, compression;
   };
 
   void ApplyPoint(const std::vector<double>& point);
@@ -107,6 +114,7 @@ class ParameterManager {
   std::atomic<bool> hier_allreduce_;
   std::atomic<bool> hier_allgather_;
   std::atomic<bool> cache_enabled_;
+  std::atomic<bool> compression_;
   std::atomic<bool> tuning_;
   std::atomic<double> best_score_;
 

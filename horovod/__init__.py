@@ -36,14 +36,24 @@ _SPECIAL = {"horovod.tensorflow.keras": "horovod_tpu.keras"}
 class _AliasLoader(importlib.abc.Loader):
     def __init__(self, impl):
         self._impl = impl
+        self._impl_spec = None
 
     def create_module(self, spec):
         # hand the machinery the ALREADY-imported implementation module
-        # so sys.modules['horovod.X'] is horovod_tpu.X itself
+        # so sys.modules['horovod.X'] is horovod_tpu.X itself; capture
+        # its own spec BEFORE the machinery rebinds module.__spec__ to
+        # the horovod.* alias spec
+        self._impl_spec = getattr(self._impl, "__spec__", None)
         return self._impl
 
     def exec_module(self, module):
-        pass  # already executed under its horovod_tpu name
+        # already executed under its horovod_tpu name; restore the
+        # implementation spec the import machinery just overwrote so
+        # importlib.reload() re-executes the real module (with the alias
+        # spec it was a silent no-op: this loader's exec_module does
+        # nothing) and find_spec stays consistent with __name__
+        if self._impl_spec is not None:
+            module.__spec__ = self._impl_spec
 
 
 class _AliasFinder(importlib.abc.MetaPathFinder):

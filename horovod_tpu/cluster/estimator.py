@@ -136,21 +136,28 @@ def _spmd_streamed_batches(store, num_ranks, batch_per_rank, epochs):
     """Zip one streamed iterator per shard into mesh-ordered global
     batches: shard r's rows land in mesh position r, matching the
     in-memory path's layout.  Memory bound: one row group per shard in
-    flight (the reference's Petastorm readers stream the same way)."""
+    flight (the reference's Petastorm readers stream the same way).
+
+    The equal-shard trim is applied PER EPOCH, like the in-memory path:
+    every epoch restarts each shard at its first row and takes exactly
+    ``steps_per_epoch`` (smallest shard's batch count) global batches.
+    A run-level trim (zip until the shortest iterator exhausts) would
+    let epoch boundaries drift across unequal shards, pairing rows from
+    different epoch phases in multi-epoch runs."""
+    import itertools
+
     from horovod_tpu.utils.data import ParquetShardIterator
 
-    its = [iter(ParquetShardIterator(store, r, num_ranks,
-                                     batch_per_rank, epochs=epochs))
-           for r in range(num_ranks)]
-    while True:
-        parts = []
-        for it in its:
-            nxt = next(it, None)
-            if nxt is None:  # shortest shard done == equal-shard trim
-                return
-            parts.append(nxt)
-        yield {k: np.concatenate([p[k] for p in parts])
-               for k in parts[0]}
+    steps_per_epoch = max(
+        _min_shard_rows(store, num_ranks) // batch_per_rank, 1)
+    for _ in range(epochs):
+        its = [itertools.islice(
+            iter(ParquetShardIterator(store, r, num_ranks,
+                                      batch_per_rank, epochs=1)),
+            steps_per_epoch) for r in range(num_ranks)]
+        for parts in zip(*its):
+            yield {k: np.concatenate([p[k] for p in parts])
+                   for k in parts[0]}
 
 
 def _train_spmd(model, loss_fn, store, epochs, batch_size, learning_rate,

@@ -124,7 +124,8 @@ class ParameterManager:
                  bayes_opt_max_samples=20, gp_noise=0.8, log_path=None,
                  fusion_threshold_bytes=64 * 1024 * 1024, cycle_time_ms=1.0,
                  hierarchical_allreduce=False, hierarchical_allgather=False,
-                 cache_enabled=True):
+                 cache_enabled=True, compression=False,
+                 compression_available=False):
         self._lib = _lib()
         self._h = self._lib.hvd_pm_create(
             warmup_samples, steady_state_samples, bayes_opt_max_samples,
@@ -132,7 +133,9 @@ class ParameterManager:
             fusion_threshold_bytes, cycle_time_ms,
             1 if hierarchical_allreduce else 0,
             1 if hierarchical_allgather else 0,
-            1 if cache_enabled else 0)
+            1 if cache_enabled else 0,
+            1 if compression else 0,
+            1 if compression_available else 0)
 
     def record(self, nbytes):
         self._lib.hvd_pm_record(self._h, int(nbytes))
@@ -159,6 +162,10 @@ class ParameterManager:
     @property
     def cache_enabled(self):
         return bool(self._lib.hvd_pm_cache_enabled(self._h))
+
+    @property
+    def compression_enabled(self):
+        return bool(self._lib.hvd_pm_compression_enabled(self._h))
 
     @property
     def tuning(self):

@@ -32,6 +32,10 @@ class Config:
     # CHANGES the reduction result (adasum of per-group averages, the
     # reference's NCCL+MPI Adasum), it is not a schedule-only switch.
     adasum_hierarchical: bool = False
+    # Default on-the-wire allreduce compression ("none" | "bf16" |
+    # "fp16" | "int8") for requests that don't pass one explicitly;
+    # autotune may toggle it between the configured value and "none".
+    compression: str = "none"
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -70,4 +74,14 @@ class Config:
                 env_util.HVD_HIERARCHICAL_ALLGATHER),
             adasum_hierarchical=env_util.get_bool(
                 env_util.HVD_ADASUM_HIERARCHICAL),
+            compression=_validated_compression(env_util.get_str(
+                env_util.HVD_TPU_COMPRESSION, "none")),
         )
+
+
+def _validated_compression(name: str) -> str:
+    """Fail at init() with a clear message rather than at the first
+    allreduce when HVD_TPU_COMPRESSION holds a typo."""
+    from horovod_tpu.common.compression import resolve_compression
+
+    return resolve_compression(name)

@@ -7,6 +7,12 @@ Average / Sum / Adasum) plus the internal request types
 
 import enum
 
+# Block-scaled int8 wire format: one fp32 scale per this many elements.
+# Lives here (jax-free) because BOTH data planes must agree on it — the
+# compiled XLA programs (common/compression.py quantizers) and the
+# numpy TCP ring codecs (ops/tcp_dataplane.py).
+INT8_BLOCK = 256
+
 
 class ReduceOp(enum.IntEnum):
     AVERAGE = 0

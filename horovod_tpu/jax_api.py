@@ -21,8 +21,24 @@ Two usage styles:
 import jax
 import optax
 
-from horovod_tpu.common.compression import Compression
+from horovod_tpu.common.compression import (Compression,
+                                            quantized_allreduce,
+                                            quantized_reduce_scatter)
 from horovod_tpu.common.ops_enum import Adasum, Average, ReduceOp, Sum
+
+
+def _single_axis(named_axes, what):
+    """The quantized collectives decompose the reduction into
+    all_to_all + all_gather over ONE mesh axis; reject multi-axis
+    reductions loudly instead of silently falling back."""
+    if isinstance(named_axes, str):
+        return named_axes
+    if len(named_axes) == 1:
+        return named_axes[0]
+    raise ValueError(
+        f"{what} with int8 compression requires a single mesh axis, got "
+        f"{tuple(named_axes)}; reduce over a flattened axis or use bf16 "
+        f"compression")
 
 
 def allreduce_gradients(grads, named_axes=("hvd",), op=Average,
@@ -30,16 +46,36 @@ def allreduce_gradients(grads, named_axes=("hvd",), op=Average,
     """Reduce a gradient pytree across the given mesh axes.
 
     Must be called inside a context where ``named_axes`` are bound
-    (``shard_map`` / ``pmap``).  Compression casts leaves (bf16 by default
-    policy) before the collective and restores dtype after, trading HBM/ICI
-    bandwidth for precision exactly like the reference's fp16 compression
-    (``horovod/torch/compression.py:45``) — but bf16-native.
+    (``shard_map`` / ``pmap``).  Cast compression (bf16/fp16) narrows
+    leaves before the collective and restores dtype after, trading
+    HBM/ICI bandwidth for precision exactly like the reference's fp16
+    compression (``horovod/torch/compression.py:45``) — but bf16-native.
+    ``Compression.int8`` runs the block-scaled quantized decomposition
+    instead (quantized reduce-scatter + fp32 accumulate + quantized
+    allgather): per-rank block scales cannot ride a plain ``psum``.
     """
     op = ReduceOp(op)
     if op == Adasum:
         from horovod_tpu.ops.adasum import adasum_reduce_pytree
         return adasum_reduce_pytree(grads, named_axes=named_axes,
                                     compression=compression)
+
+    if getattr(compression, "block_quantized", False):
+        axis = _single_axis(named_axes, "allreduce_gradients")
+        block = compression.block
+
+        def reduce_quantized(g):
+            if not jax.numpy.issubdtype(g.dtype, jax.numpy.floating) \
+                    or g.size < block:
+                # exact passthrough, same gate as the eager executor
+                return (jax.lax.pmean(g, named_axes) if op == Average
+                        else jax.lax.psum(g, named_axes))
+            red = quantized_allreduce(g.reshape(-1), axis, block)
+            if op == Average:
+                red = red / jax.lax.psum(1, axis)
+            return red.astype(g.dtype).reshape(g.shape)
+
+        return jax.tree.map(reduce_quantized, grads)
 
     def reduce_leaf(g):
         compressed, ctx = compression.compress(g)
@@ -125,10 +161,18 @@ def ShardedDistributedOptimizer(optimizer, axis_name="hvd", op=Average,
         raise ValueError(
             "ShardedDistributedOptimizer does not support Adasum; use "
             "DistributedOptimizer(op=Adasum)")
+    quantized = getattr(compression, "block_quantized", False)
 
     def _layout(flat):
         n = jax.lax.psum(1, axis_name)  # concrete inside shard_map
-        return n, shard_chunk_size(flat.size, n)
+        chunk = shard_chunk_size(flat.size, n)
+        if quantized:
+            # block-align the shard so the quantized reduce-scatter's
+            # per-destination chunks land on scale-block boundaries;
+            # init and update share this layout, so the optimizer-state
+            # shape is stable either way
+            chunk = -(-chunk // compression.block) * compression.block
+        return n, chunk
 
     def _my_shard(flat):
         n, chunk = _layout(flat)
@@ -144,11 +188,22 @@ def ShardedDistributedOptimizer(optimizer, axis_name="hvd", op=Average,
         flat_g, unravel = ravel_pytree(grads)
         n, chunk = _layout(flat_g)
 
-        compressed, ctx = compression.compress(flat_g)
-        padded = jnp.pad(compressed, (0, n * chunk - flat_g.size))
-        g_shard = jax.lax.psum_scatter(
-            padded.reshape(n, chunk), axis_name, scatter_dimension=0)
-        g_shard = compression.decompress(g_shard, ctx)
+        if quantized and jnp.issubdtype(flat_g.dtype, jnp.floating):
+            # quantized reduce-scatter: each rank's contribution to every
+            # shard travels as int8 + block scales, the owned shard
+            # accumulates in fp32 — half of the quantized allreduce (the
+            # allgather of UPDATES below stays full precision)
+            padded = jnp.pad(flat_g.astype(jnp.float32),
+                             (0, n * chunk - flat_g.size))
+            g_shard = quantized_reduce_scatter(
+                padded.reshape(n, chunk), axis_name,
+                compression.block).astype(flat_g.dtype)
+        else:
+            compressed, ctx = compression.compress(flat_g)
+            padded = jnp.pad(compressed, (0, n * chunk - flat_g.size))
+            g_shard = jax.lax.psum_scatter(
+                padded.reshape(n, chunk), axis_name, scatter_dimension=0)
+            g_shard = compression.decompress(g_shard, ctx)
         if op_ == Average:
             g_shard = g_shard / n
 

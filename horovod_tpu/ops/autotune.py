@@ -41,6 +41,7 @@ def default_params(config):
         "hierarchical_allreduce": config.hierarchical_allreduce,
         "hierarchical_allgather": config.hierarchical_allgather,
         "cache_enabled": True,
+        "compression": getattr(config, "compression", "none"),
         "tuning": False,
         "best_score_bytes_per_sec": 0.0,
     }
@@ -65,6 +66,13 @@ class AutotuneManager:
             return None
 
     def __init__(self, config):
+        # The tuner explores compression as on/off; the NAME of the
+        # compressor stays the operator's configured choice (numerics
+        # are the operator's call, whether they pay for themselves is
+        # the tuner's).  With no compressor configured the toggle is
+        # excluded from the walk entirely.
+        self._compression = str(getattr(config, "compression", "none"))
+        comp_on = self._compression != "none"
         self._pm = ParameterManager(
             warmup_samples=int(
                 getattr(config, "autotune_warmup_samples", 3)),
@@ -78,7 +86,8 @@ class AutotuneManager:
             fusion_threshold_bytes=int(config.fusion_threshold_bytes),
             cycle_time_ms=float(config.cycle_time_ms),
             hierarchical_allreduce=bool(config.hierarchical_allreduce),
-            hierarchical_allgather=bool(config.hierarchical_allgather))
+            hierarchical_allgather=bool(config.hierarchical_allgather),
+            compression=comp_on, compression_available=comp_on)
         self._start = time.monotonic()
         self._lock = threading.Lock()
         self._seq = 0
@@ -123,6 +132,8 @@ class AutotuneManager:
             "hierarchical_allreduce": pm.hierarchical_allreduce,
             "hierarchical_allgather": pm.hierarchical_allgather,
             "cache_enabled": pm.cache_enabled,
+            "compression": (self._compression if pm.compression_enabled
+                            else "none"),
             "tuning": pm.tuning,
             "best_score_bytes_per_sec": pm.best_score,
         }

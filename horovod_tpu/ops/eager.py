@@ -55,9 +55,19 @@ def _require_rank_context(state, name):
 
 
 def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
-            prescale_factor=1.0, postscale_factor=1.0, splits=None) -> Handle:
+            prescale_factor=1.0, postscale_factor=1.0, splits=None,
+            compression=None) -> Handle:
     state = basics._get_state()
     _require_rank_context(state, name)
+    from horovod_tpu.common.compression import resolve_compression
+
+    # None -> the configured default (HVD_TPU_COMPRESSION / autotune);
+    # accepts a canonical name or a Compression class.  Adasum combines
+    # full-precision vectors by construction, so it never compresses.
+    compression = resolve_compression(
+        compression, default=getattr(state.config, "compression", "none"))
+    if req_type == RequestType.ADASUM:
+        compression = "none"
     # rank indexes the executor's device list (global in gmesh mode, local
     # otherwise).  The tcp plane keeps tensors as numpy: a device commit
     # there would let jax narrow 64-bit dtypes before the exact numpy
@@ -85,33 +95,43 @@ def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
         rank=basics.rank(), req_type=req_type, name=name, tensor=committed,
         handle=handle, op=op, root_rank=root_rank,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        splits=splits))
+        splits=splits, compression=compression))
     return handle
 
 
 # ------------------------------------------------------------- allreduce ----
 def allreduce_async(tensor, average=None, name=None, op=None,
-                    prescale_factor=1.0, postscale_factor=1.0) -> Handle:
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    compression=None) -> Handle:
+    """``compression``: ``None`` (use the configured default), a name
+    ("none" / "bf16" / "fp16" / "int8") or a
+    :class:`horovod_tpu.Compression` member — selects the on-the-wire
+    representation of this allreduce (reference: the ``compression``
+    argument of ``hvd.DistributedOptimizer``, fp16 in the paper)."""
     op = _resolve_op(op, average)
     req_type = RequestType.ADASUM if op == Adasum else RequestType.ALLREDUCE
     return _submit(req_type, tensor, name or _auto_name("allreduce"),
                    op=op, prescale_factor=prescale_factor,
-                   postscale_factor=postscale_factor)
+                   postscale_factor=postscale_factor,
+                   compression=compression)
 
 
 def allreduce(tensor, average=None, name=None, op=None,
-              prescale_factor=1.0, postscale_factor=1.0):
+              prescale_factor=1.0, postscale_factor=1.0, compression=None):
     return synchronize(allreduce_async(
         tensor, average=average, name=name, op=op,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor))
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        compression=compression))
 
 
-def grouped_allreduce(tensors, average=None, name=None, op=None):
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      compression=None):
     """Allreduce a list of tensors as one negotiation group; fusion batches
     them into single XLA programs."""
     base = name or _auto_name("grouped_allreduce")
     handles = [
-        allreduce_async(t, average=average, name=f"{base}.{i}", op=op)
+        allreduce_async(t, average=average, name=f"{base}.{i}", op=op,
+                        compression=compression)
         for i, t in enumerate(tensors)
     ]
     return [synchronize(h) for h in handles]

@@ -57,10 +57,11 @@ class MetaReq:
 
     __slots__ = ("name", "req_type", "op", "dtype", "shape", "dims0",
                  "splits", "root_rank", "prescale", "postscale", "ranks",
-                 "error")
+                 "error", "compression")
 
     def __init__(self, name, req_type, op, dtype, shape, dims0, splits,
-                 root_rank, prescale, postscale, ranks, error=None):
+                 root_rank, prescale, postscale, ranks, error=None,
+                 compression="none"):
         self.error = error  # intra-process validation failure, if any
         self.name = name
         self.req_type = int(req_type)
@@ -73,6 +74,7 @@ class MetaReq:
         self.prescale = prescale
         self.postscale = postscale
         self.ranks = tuple(ranks)     # local ranks that submitted
+        self.compression = compression  # process-resolved wire compression
 
 
 class CycleMsg:
@@ -93,12 +95,14 @@ class LogEntry:
 
     __slots__ = ("seq", "kind", "req_type", "names", "shapes", "dtype",
                  "op", "prescale", "postscale", "root_rank", "all_dims0",
-                 "splits_matrix", "error", "last_rank", "joined", "params")
+                 "splits_matrix", "error", "last_rank", "joined", "params",
+                 "compression")
 
     def __init__(self, seq, kind, req_type=None, names=(), shapes=(),
                  dtype=None, op=0, prescale=1.0, postscale=1.0,
                  root_rank=-1, all_dims0=None, splits_matrix=None,
-                 error=None, last_rank=-1, joined=(), params=None):
+                 error=None, last_rank=-1, joined=(), params=None,
+                 compression="none"):
         self.seq = seq
         self.kind = kind    # "group" | "error" | "join_done" | "params"
         self.req_type = req_type
@@ -115,6 +119,7 @@ class LogEntry:
         self.last_rank = last_rank
         self.joined = tuple(joined)   # global joined snapshot at emit time
         self.params = params          # tuned knob dict ("params" entries)
+        self.compression = compression  # coordinator-resolved wire format
 
 
 class CycleResp:
@@ -256,7 +261,7 @@ class MetaCoordinatorService(network.MuxService):
                 return ("single", item[0])
             return PythonController.allreduce_bucket_key(
                 meta["dtype"], meta["op"], meta["prescale"],
-                meta["postscale"])
+                meta["postscale"], meta.get("compression", "none"))
 
         def nbytes(item):
             _, meta = item
@@ -291,6 +296,7 @@ class MetaCoordinatorService(network.MuxService):
                     dtype=first_meta["dtype"], op=first_meta["op"],
                     prescale=first_meta["prescale"],
                     postscale=first_meta["postscale"],
+                    compression=first_meta.get("compression", "none"),
                     joined=sorted(self._joined)))
             else:
                 name, meta = bucket[0]
@@ -367,7 +373,11 @@ class MetaCoordinatorService(network.MuxService):
         meta = {"req_type": first.req_type, "dtype": first.dtype,
                 "op": first.op, "prescale": first.prescale,
                 "postscale": first.postscale, "root_rank": first.root_rank,
-                "shape": first.shape}
+                "shape": first.shape,
+                # cross-process wire-format resolution, same rule as the
+                # in-process controllers: unanimous wins, else exact
+                "compression": PythonController.resolve_group_compression(
+                    getattr(r, "compression", "none") for r in reqs)}
 
         if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM):
             if any(r.shape != first.shape for r in reqs):
@@ -666,7 +676,9 @@ class GlobalMeshController(PythonController):
             shape=shape, dims0=dims0, splits=splits,
             root_rank=first.root_rank, prescale=first.prescale_factor,
             postscale=first.postscale_factor, ranks=sorted(reqs.keys()),
-            error=error)
+            error=error,
+            compression=self.resolve_group_compression(
+                r.compression for r in reqs.values()))
 
     # ------------------------------------------------------------- execution
     def _apply(self, entry):
@@ -714,7 +726,8 @@ class GlobalMeshController(PythonController):
                         if entry.splits_matrix is not None else None),
                 op=ReduceOp(entry.op), prescale_factor=entry.prescale,
                 postscale_factor=entry.postscale,
-                all_dims0=entry.all_dims0))
+                all_dims0=entry.all_dims0,
+                compression=getattr(entry, "compression", "none")))
             self._timeline.end(name)
 
         # execution + error surfacing shared with the in-process

@@ -21,7 +21,7 @@ import threading
 
 from horovod_tpu.common import wire
 from horovod_tpu.common.ops_enum import ReduceOp, ResponseType
-from horovod_tpu.ops.python_controller import GroupEntry
+from horovod_tpu.ops.python_controller import GroupEntry, PythonController
 from horovod_tpu.utils.logging import get_logger
 
 _LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(__file__)),
@@ -150,7 +150,8 @@ def _load_lib():
     lib.hvd_pm_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                   ctypes.c_double, ctypes.c_char_p,
                                   ctypes.c_int64, ctypes.c_double,
-                                  ctypes.c_int, ctypes.c_int, ctypes.c_int]
+                                  ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_int]
     lib.hvd_pm_destroy.argtypes = [ctypes.c_void_p]
     lib.hvd_pm_record.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.hvd_pm_update.restype = ctypes.c_int
@@ -161,7 +162,7 @@ def _load_lib():
     lib.hvd_pm_cycle_ms.argtypes = [ctypes.c_void_p]
     for fn in ("hvd_pm_hierarchical_allreduce",
                "hvd_pm_hierarchical_allgather", "hvd_pm_cache_enabled",
-               "hvd_pm_tuning"):
+               "hvd_pm_compression_enabled", "hvd_pm_tuning"):
         getattr(lib, fn).restype = ctypes.c_int
         getattr(lib, fn).argtypes = [ctypes.c_void_p]
     lib.hvd_pm_best_score.restype = ctypes.c_double
@@ -294,6 +295,11 @@ class NativeController:
                     lib.hvd_core_param_hierarchical_allgather(core)),
                 "cache_enabled": bool(
                     lib.hvd_core_param_cache_enabled(core)),
+                # the embedded core's tuner predates the compression
+                # knob; the configured value is reported so the params
+                # surface stays uniform across controllers
+                "compression": getattr(self._config, "compression",
+                                       "none"),
                 "tuning": bool(lib.hvd_core_autotune_tuning(core)),
                 "best_score_bytes_per_sec": float(
                     lib.hvd_core_autotune_best_score(core)),
@@ -391,14 +397,27 @@ class NativeController:
                         for rank, r in requests.items()},
                 op=ReduceOp(resp["op"]),
                 prescale_factor=resp["prescale"],
-                postscale_factor=resp["postscale"]))
+                postscale_factor=resp["postscale"],
+                compression=PythonController.resolve_group_compression(
+                    getattr(r, "compression", "none")
+                    for r in requests.values())))
 
         try:
             if rtype in (ResponseType.ALLREDUCE,):
-                self._executor.allreduce_fused(
-                    groups, op=ReduceOp(resp["op"]),
-                    prescale_factor=resp["prescale"],
-                    postscale_factor=resp["postscale"])
+                # The C++ core's fusion key predates the compression
+                # knob, so a fused response can mix wire formats —
+                # partition here so compressed and uncompressed entries
+                # never execute as one program (each partition is still
+                # one compiled XLA program).
+                by_comp = {}
+                for g in groups:
+                    by_comp.setdefault(g.compression, []).append(g)
+                for comp, subset in by_comp.items():
+                    self._executor.allreduce_fused(
+                        subset, op=ReduceOp(resp["op"]),
+                        prescale_factor=resp["prescale"],
+                        postscale_factor=resp["postscale"],
+                        compression=comp)
             elif rtype == ResponseType.ADASUM:
                 for g in groups:
                     self._executor.adasum(g)

@@ -48,6 +48,7 @@ class EagerRequest:
     prescale_factor: float = 1.0
     postscale_factor: float = 1.0
     splits: list | None = None
+    compression: str = "none"
 
     def signature(self):
         """Everything validation checks, flattened into a hashable key
@@ -58,7 +59,8 @@ class EagerRequest:
         dtype = np.dtype(tensor.dtype).name if tensor is not None else None
         return (self.req_type, dtype, shape, self.op, self.root_rank,
                 self.prescale_factor, self.postscale_factor,
-                tuple(self.splits) if self.splits is not None else None)
+                tuple(self.splits) if self.splits is not None else None,
+                self.compression)
 
 
 class _NameEntry:
@@ -77,11 +79,11 @@ class GroupEntry:
 
     __slots__ = ("name", "shape", "dtype", "tensors", "handles", "root_rank",
                  "splits", "op", "prescale_factor", "postscale_factor",
-                 "all_dims0")
+                 "all_dims0", "compression")
 
     def __init__(self, name, shape, dtype, tensors, handles, root_rank=-1,
                  splits=None, op=ReduceOp.SUM, prescale_factor=1.0,
-                 postscale_factor=1.0, all_dims0=None):
+                 postscale_factor=1.0, all_dims0=None, compression="none"):
         self.name = name
         self.shape = shape
         self.dtype = dtype
@@ -93,6 +95,7 @@ class GroupEntry:
         self.prescale_factor = prescale_factor
         self.postscale_factor = postscale_factor
         self.all_dims0 = all_dims0
+        self.compression = compression
 
 
 class PythonController:
@@ -165,6 +168,11 @@ class PythonController:
         self._executor.hierarchical_allgather = \
             params["hierarchical_allgather"]
         self._sig_cache.enabled = params["cache_enabled"]
+        if "compression" in params:
+            # the DEFAULT wire compression for allreduces that didn't
+            # pass one explicitly; requests already in flight keep the
+            # compression they were submitted with
+            self._config.compression = params["compression"]
 
     def enqueue(self, request: EagerRequest):
         with self._lock:
@@ -319,6 +327,16 @@ class PythonController:
         self._sig_cache.store(
             name, (r.signature() for r in entry.requests.values()))
 
+    @staticmethod
+    def resolve_group_compression(compressions):
+        """Cross-rank compression resolution: unanimous choice wins,
+        disagreement resolves to "none" (exact) rather than erroring —
+        an autotune publication applying at slightly different times on
+        different ranks must not kill in-flight collectives (same spirit
+        as the tcp coordinator resolving ring-vs-payload)."""
+        comps = set(compressions)
+        return comps.pop() if len(comps) == 1 else "none"
+
     def _build_group(self, name, entry):
         """Build the executor GroupEntry from an already-validated (or
         cache-hit) table entry."""
@@ -334,7 +352,9 @@ class PythonController:
             root_rank=any_req.root_rank,
             splits={rank: r.splits for rank, r in requests.items()},
             op=any_req.op, prescale_factor=any_req.prescale_factor,
-            postscale_factor=any_req.postscale_factor)
+            postscale_factor=any_req.postscale_factor,
+            compression=self.resolve_group_compression(
+                r.compression for r in requests.values()))
 
     # ------------------------------------------------------------- validation
     @staticmethod
@@ -417,10 +437,15 @@ class PythonController:
 
     # ----------------------------------------------------------------- fusion
     @staticmethod
-    def allreduce_bucket_key(dtype, op, prescale, postscale):
+    def allreduce_bucket_key(dtype, op, prescale, postscale,
+                             compression="none"):
         """Bucket-compatibility key shared with the gmesh coordinator
-        (reference: FuseResponses fuses dtype/op/scale-homogeneous runs)."""
-        return (np.dtype(dtype).name, int(op), prescale, postscale)
+        (reference: FuseResponses fuses dtype/op/scale-homogeneous runs).
+        Compression is part of the key: a compressed and an uncompressed
+        request must never fuse into one program — they have different
+        wire formats and different numerics."""
+        return (np.dtype(dtype).name, int(op), prescale, postscale,
+                compression)
 
     def _dispatch(self, responses):
         """Fuse compatible allreduces into <= fusion_threshold buckets
@@ -440,7 +465,7 @@ class PythonController:
                 return ("single", id(group))  # never fuses
             return self.allreduce_bucket_key(
                 group.dtype, group.op, group.prescale_factor,
-                group.postscale_factor)
+                group.postscale_factor, group.compression)
 
         def nbytes(item):
             _, group = item
@@ -465,7 +490,8 @@ class PythonController:
         self._executor.allreduce_fused(
             groups, op=first.op,
             prescale_factor=first.prescale_factor,
-            postscale_factor=first.postscale_factor)
+            postscale_factor=first.postscale_factor,
+            compression=first.compression)
         self._timeline_end_groups(groups)
 
     def _execute_single(self, req_type, group):

@@ -60,7 +60,7 @@ TIMELINE_SCOPE = "timeline"
 class CollectiveMsg:
     def __init__(self, name, rank, req_type, op, payload, shape, dtype,
                  root_rank=-1, splits=None, prescale=1.0, postscale=1.0,
-                 ring=False, sig=None):
+                 ring=False, sig=None, compression="none"):
         self.name = name
         self.rank = rank
         self.req_type = int(req_type)
@@ -74,13 +74,14 @@ class CollectiveMsg:
         self.postscale = postscale
         self.ring = ring
         self.sig = sig                  # signature digest (response cache)
+        self.compression = compression  # requested wire compression
 
 
 class ResultMsg:
     def __init__(self, payload=None, shape=None, dtype=None, error=None,
                  recv_splits=None, ring_go=False, participants=None,
                  dims0=None, ring_id=None, params_seq=0, params=None,
-                 resend=False):
+                 resend=False, compression="none"):
         self.payload = payload
         self.shape = shape
         self.dtype = dtype
@@ -93,6 +94,7 @@ class ResultMsg:
         self.params_seq = params_seq    # autotune publication counter
         self.params = params            # tuned knob dict (rank 0 -> all)
         self.resend = resend    # ring infeasible: resubmit with payload
+        self.compression = compression  # coordinator-resolved wire format
 
 
 class JoinMsg:
@@ -141,7 +143,8 @@ def _signature(msg) -> bytes:
     cache key is tensor name + params, ``response_cache.h:45``)."""
     parts = (msg.req_type, msg.op, msg.dtype, tuple(msg.shape),
              msg.root_rank, tuple(msg.splits or ()), msg.prescale,
-             msg.postscale, bool(msg.ring))
+             msg.postscale, bool(msg.ring),
+             getattr(msg, "compression", "none"))
     return hashlib.sha1(repr(parts).encode()).digest()
 
 
@@ -386,9 +389,21 @@ class CoordinatorService(network.MuxService):
             if ring and rtype == RequestType.ALLREDUCE:
                 participants = sorted(reqs.keys())
                 self._ring_seq += 1
+                # coordinator-resolved wire format (same role as the
+                # ring-vs-payload resolution): unanimous choice wins,
+                # disagreement — e.g. tuned params applied at slightly
+                # different times on different ranks — resolves to the
+                # exact path instead of erroring
+                from horovod_tpu.ops.python_controller import \
+                    PythonController
+
+                comp = PythonController.resolve_group_compression(
+                    getattr(r, "compression", "none")
+                    for r in reqs.values())
                 return {r: ResultMsg(ring_go=True,
                                      participants=participants,
-                                     ring_id=self._ring_seq)
+                                     ring_id=self._ring_seq,
+                                     compression=comp)
                         for r in reqs}
             if ring and rtype == RequestType.ADASUM:
                 participants = sorted(reqs.keys())
@@ -697,7 +712,8 @@ class TcpController:
                 shape=arr.shape, dtype=wire_dtype,
                 root_rank=request.root_rank, splits=request.splits,
                 prescale=request.prescale_factor,
-                postscale=request.postscale_factor, ring=ring)
+                postscale=request.postscale_factor, ring=ring,
+                compression=getattr(request, "compression", "none"))
             msg.sig = _signature(msg)
             self._timeline.begin(request.name,
                                  f"NEGOTIATE_{rtype.name}")
@@ -749,7 +765,8 @@ class TcpController:
                     op_average=(ReduceOp(request.op) == ReduceOp.AVERAGE),
                     world_size=self._size,
                     prescale=request.prescale_factor,
-                    postscale=request.postscale_factor, timeout=timeout)
+                    postscale=request.postscale_factor, timeout=timeout,
+                    compression=getattr(resp, "compression", "none"))
             elif rtype == RequestType.ADASUM:
                 out = self._ring.adasum(
                     resp.ring_id, arr, resp.participants, timeout=timeout)
@@ -814,6 +831,8 @@ class TcpController:
             self._config.fusion_threshold_bytes = \
                 params["fusion_threshold_bytes"]
             self._config.cycle_time_ms = params["cycle_time_ms"]
+            if "compression" in params:
+                self._config.compression = params["compression"]
 
     def tuned_params(self):
         """Same surface as the native controller (reference:

@@ -29,6 +29,7 @@ import threading
 
 import numpy as np
 
+from horovod_tpu.common.ops_enum import INT8_BLOCK
 from horovod_tpu.run.service import network
 
 # payloads at or above this ride the ring; below it the coordinator star
@@ -36,6 +37,73 @@ from horovod_tpu.run.service import network
 DEFAULT_RING_THRESHOLD = 1 << 20
 # broadcast pipeline chunk
 BCAST_CHUNK = 1 << 22
+
+
+# ------------------------------------------------------- compressed codecs
+# enc(float64 1-D chunk) -> wire bytes; dec(blob, n) -> float64 [n].
+# int8 blobs are [ceil(n/256) fp32 scales][ceil(n/256)*256 int8 values]
+# (~27% of the fp64-path's fp32-equivalent bytes); cast codecs are plain
+# dtype reinterpretations.
+def _enc_int8(chunk):
+    # all math in float32 with in-place rint/clip: the encoder sits on
+    # the ring's critical path and f64 temporaries double its memory
+    # traffic (the quantization error bound doesn't need f64 — the
+    # scale only has to be within an ulp of max|x|/127)
+    n = chunk.size
+    nb = -(-n // INT8_BLOCK)
+    x = np.ascontiguousarray(chunk, dtype=np.float32)
+    if nb * INT8_BLOCK != n:
+        x = np.concatenate(
+            [x, np.zeros(nb * INT8_BLOCK - n, np.float32)])
+    blocks = x.reshape(nb, INT8_BLOCK)
+    maxabs = np.maximum(blocks.max(axis=1), -blocks.min(axis=1))
+    scale = np.where(maxabs > 0, maxabs / np.float32(127.0),
+                     np.float32(1.0)).astype(np.float32)
+    # divide like the jnp quantizer — a reciprocal multiply overflows to
+    # inf for denormal scales and would send the block's zeros through
+    # 0 * inf = NaN into an undefined NaN->int8 cast
+    q = blocks / scale[:, None]
+    np.rint(q, out=q)
+    np.clip(q, -127, 127, out=q)
+    return scale.tobytes() + q.astype(np.int8).tobytes()
+
+
+def _dec_int8(blob, n):
+    nb = -(-n // INT8_BLOCK)
+    scale = np.frombuffer(blob[:nb * 4], np.float32)
+    q = np.frombuffer(blob, np.int8, offset=nb * 4).reshape(
+        nb, INT8_BLOCK).astype(np.float32)
+    # float32 out: these ARE the wire values (int8 x fp32 scale); the
+    # caller's float64 accumulator upcasts on +=
+    q *= scale[:, None]
+    return q.reshape(-1)[:n]
+
+
+def _cast_codec(wire_dtype):
+    dt = np.dtype(wire_dtype)
+
+    def enc(chunk):
+        return np.ascontiguousarray(chunk.astype(dt)).tobytes()
+
+    def dec(blob, n):
+        # float32 is exact for bf16/fp16 wire values; the caller's
+        # float64 accumulator upcasts on +=
+        return np.frombuffer(blob, dtype=dt)[:n].astype(np.float32)
+
+    return enc, dec
+
+
+def _codecs():
+    # bfloat16 comes from ml_dtypes (a jax dependency) — resolved lazily
+    # so importing this module never pulls it in on the no-accelerator
+    # path until a compressed collective actually runs
+    import ml_dtypes
+
+    return {
+        "int8": (_enc_int8, _dec_int8),
+        "bf16": _cast_codec(ml_dtypes.bfloat16),
+        "fp16": _cast_codec(np.float16),
+    }
 
 
 class ChunkMsg:
@@ -138,22 +206,34 @@ class RingPlane:
 
     # ------------------------------------------------------------- allreduce
     def allreduce(self, ring_id, arr, participants, *, op_average,
-                  world_size, prescale=1.0, postscale=1.0, timeout=None):
+                  world_size, prescale=1.0, postscale=1.0, timeout=None,
+                  compression="none"):
         """Ring allreduce over ``participants`` (sorted rank ids; must
         include ``self.rank``).  Joined ranks simply aren't in the ring —
-        their zero stand-ins are additive identities."""
+        their zero stand-ins are additive identities.
+
+        ``compression`` ("int8" / "bf16" / "fp16", floats only) moves
+        the bulk bytes in the compressed wire format; accumulation stays
+        float64 either way and integer dtypes always take the exact
+        path."""
         participants = sorted(participants)
         p = len(participants)
         idx = participants.index(self.rank)
         from horovod_tpu.common.ops_enum import is_float_dtype
 
         out_dtype = arr.dtype
-        acc_dtype = np.float64 if is_float_dtype(arr.dtype) else np.int64
+        float_in = is_float_dtype(arr.dtype)
+        acc_dtype = np.float64 if float_in else np.int64
         flat = arr.reshape(-1).astype(acc_dtype)
         if prescale != 1.0:
             flat = flat * prescale
+        codec = (_codecs().get(compression)
+                 if float_in and compression not in (None, "none") else None)
         if p == 1:
             total = flat
+        elif codec is not None:
+            total = self._allreduce_compressed(ring_id, flat, participants,
+                                               idx, codec, timeout)
         else:
             right = participants[(idx + 1) % p]
             left = participants[(idx - 1) % p]
@@ -182,6 +262,46 @@ class RingPlane:
         if postscale != 1.0:
             total = total * postscale
         return total.astype(out_dtype).reshape(arr.shape)
+
+    def _allreduce_compressed(self, ring_id, flat, participants, idx,
+                              codec, timeout):
+        """Compressed bulk exchange (EQuARX-style block scaling mapped
+        onto the p2p transport).  Reduce-scatter leg: each rank encodes
+        its contribution to every destination chunk ONCE at the source
+        and ships it straight to the chunk's owner — same (p-1)/p bytes
+        per rank as the classic ring's reduce-scatter, but one
+        quantization per contribution instead of a requantize at every
+        hop.  The owner accumulates all contributions in float64,
+        encodes its reduced chunk once, and the allgather leg rotates
+        the compressed blobs around the ring verbatim.  Every rank
+        decodes the SAME blobs (the owner included), so the result stays
+        rank-consistent like the exact ring."""
+        enc, dec = codec
+        p = len(participants)
+        chunks = np.array_split(flat, p)
+        sizes = [c.size for c in chunks]
+        for d in range(p):
+            if d != idx:
+                self.send(participants[d], ((ring_id, "qrs", d)),
+                          enc(np.ascontiguousarray(chunks[d])))
+        acc = chunks[idx].astype(np.float64, copy=True)
+        for src_i, src in enumerate(participants):
+            if src_i == idx:
+                continue
+            blob = self.recv(((ring_id, "qrs", idx)), src, timeout=timeout)
+            acc += dec(blob, sizes[idx])
+        # allgather: rotate the compressed reduced chunks p-1 times
+        right = participants[(idx + 1) % p]
+        left = participants[(idx - 1) % p]
+        blobs = {idx: enc(np.ascontiguousarray(acc))}
+        carry = idx
+        for s in range(p - 1):
+            self.send(right, ((ring_id, "qag", s)), blobs[carry])
+            recv_owner = (idx - 1 - s) % p
+            blobs[recv_owner] = self.recv(((ring_id, "qag", s)), left,
+                                          timeout=timeout)
+            carry = recv_owner
+        return np.concatenate([dec(blobs[i], sizes[i]) for i in range(p)])
 
     # --------------------------------------------------------------- adasum
     def adasum(self, ring_id, arr, participants, *, timeout=None):

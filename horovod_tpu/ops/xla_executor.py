@@ -35,7 +35,11 @@ try:  # jax >= 0.5 exposes shard_map at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
-from horovod_tpu.common.ops_enum import ReduceOp
+from horovod_tpu.common.compression import (INT8_BLOCK,
+                                            quantized_all_gather,
+                                            quantized_reduce_scatter,
+                                            resolve_compression)
+from horovod_tpu.common.ops_enum import ReduceOp, is_float_dtype
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils.logging import get_logger
 
@@ -188,17 +192,41 @@ class XlaExecutor:
                               self.devices[rank])
 
     # -------------------------------------------------------------- allreduce
-    def allreduce_fused(self, entries, op, prescale_factor, postscale_factor):
+    def _effective_compression(self, compression, dtype, total):
+        """Resolve the on-the-wire compression for a fused group: exact
+        passthrough for non-float dtypes, for tensors too small to pay
+        the scale overhead, for single-rank meshes, and for casts that
+        would be no-ops (bf16 of bf16, fp16 of fp16).  Deterministic in
+        (dtype, total), so every process of a multi-process job resolves
+        the coordinator's bucket identically."""
+        comp = resolve_compression(compression) if compression else "none"
+        if comp == "none":
+            return comp
+        npdt = np.dtype(dtype)
+        if not is_float_dtype(npdt) or self.num_ranks == 1:
+            return "none"
+        if comp == "bf16" and npdt.name == "bfloat16":
+            return "none"
+        if comp == "fp16" and npdt == np.float16:
+            return "none"
+        if comp == "int8" and total < INT8_BLOCK:
+            return "none"
+        return comp
+
+    def allreduce_fused(self, entries, op, prescale_factor, postscale_factor,
+                        compression="none"):
         """Execute a fused allreduce group.
 
         ``entries`` is a list of group entries with ``.shape``, ``.dtype``,
         ``.tensors`` (rank -> committed array, or None for joined ranks) and
-        ``.handles`` (rank -> Handle).  All entries share one dtype.
+        ``.handles`` (rank -> Handle).  All entries share one dtype (and
+        one ``compression`` — the bucket key separates them).
         """
         shapes = tuple(tuple(e.shape) for e in entries)
         sizes = [_prod(s) for s in shapes]
         total = sum(sizes)
         dtype = entries[0].dtype
+        comp = self._effective_compression(compression, dtype, total)
 
         bufs = []
         for rank in self.local_ranks:
@@ -223,10 +251,22 @@ class XlaExecutor:
         hierarchical = bool(self.hierarchical_allreduce
                             and self.hier_mesh is not None)
         key = (shapes, np.dtype(dtype).name, int(op),
-               float(prescale_factor), float(postscale_factor), hierarchical)
+               float(prescale_factor), float(postscale_factor), hierarchical,
+               comp)
         fn = self._allreduce_cache.get(key)
+        if fn is None and comp == "int8":
+            fn = self._build_int8_allreduce(
+                shapes, sizes, total, dtype, op, prescale_factor,
+                postscale_factor, hierarchical)
+            self._allreduce_cache[key] = fn
         if fn is None:
             num_ranks = self.num_ranks
+            # Cast compression (bf16/fp16): the collective itself runs in
+            # the narrow dtype — XLA fuses the casts into the program and
+            # every leg (ICI and DCN) moves half the bytes (reference:
+            # fp16 compression, horovod/torch/compression.py:45).
+            wire_dt = {"bf16": jnp.bfloat16,
+                       "fp16": jnp.float16}.get(comp)
             # Integer tensors: the reduction stays exact in the integer
             # dtype and ALL scaling (pre x post x 1/n, which commutes
             # with the sum) happens once in float32 with a cast back —
@@ -239,6 +279,8 @@ class XlaExecutor:
                 x = shard
                 if prescale_factor != 1.0 and not int_dtype:
                     x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+                if wire_dt is not None:
+                    x = x.astype(wire_dt)
                 return jax.lax.psum(x, AXIS)
 
             def hier_body(shard):
@@ -248,6 +290,8 @@ class XlaExecutor:
                 x = shard.reshape(-1)
                 if prescale_factor != 1.0 and not int_dtype:
                     x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+                if wire_dt is not None:
+                    x = x.astype(wire_dt)
                 local = self.hier_mesh.shape["local"]
                 align = local * FUSION_ALIGN_ELEMS
                 padded = -(-total // align) * align
@@ -268,6 +312,8 @@ class XlaExecutor:
                     red = _shard_map(flat_body, mesh=self.mesh,
                                      in_specs=P(AXIS), out_specs=P())(g)
                 flat = red.reshape(-1)
+                if wire_dt is not None:
+                    flat = flat.astype(dtype)
                 if int_dtype:
                     factor = prescale_factor * postscale_factor
                     if op == ReduceOp.AVERAGE:
@@ -303,6 +349,57 @@ class XlaExecutor:
         for entry, out in zip(entries, outs):
             for rank, handle in entry.handles.items():
                 handle.set_result(self._shard_for(out, rank))
+
+    def _build_int8_allreduce(self, shapes, sizes, total, dtype, op,
+                              prescale_factor, postscale_factor,
+                              hierarchical):
+        """Compile the block-scaled int8 fused allreduce (EQuARX,
+        arXiv:2506.17615): quantize inside the jitted program, exchange
+        int8 + fp32 block scales via ``all_to_all`` (the reduce-scatter
+        leg), accumulate in fp32, requantize the reduced chunk before the
+        allgather leg, dequantize on unpack.  Each element passes through
+        exactly two quantizations regardless of rank count.  On the
+        hierarchical mesh the quantized legs run over the fast "local"
+        axis and the owned chunk crosses DCN once in fp32 (already
+        1/local_size of the payload)."""
+        num_ranks = self.num_ranks
+        hier = bool(hierarchical and self.hier_mesh is not None)
+        mesh = self.hier_mesh if hier else self.mesh
+        axis = "local" if hier else AXIS
+        n_split = mesh.shape["local"] if hier else num_ranks
+        chunk = -(-total // (n_split * INT8_BLOCK)) * INT8_BLOCK
+        padded = chunk * n_split
+        in_spec = P(("cross", "local")) if hier else P(AXIS)
+
+        def body(shard):  # [1, total] on one rank
+            x = shard.reshape(-1).astype(jnp.float32)
+            if prescale_factor != 1.0:
+                x = x * prescale_factor
+            x = jnp.pad(x, (0, padded - total))
+            red = quantized_reduce_scatter(x.reshape(n_split, chunk), axis)
+            if hier:
+                red = jax.lax.psum(red, "cross")
+            full = quantized_all_gather(red, axis)
+            return full[:total][None]
+
+        def fused(g):
+            red = _shard_map_gathered(body, mesh, in_spec, P())(g)
+            flat = red.reshape(-1)  # fp32 accumulate
+            if op == ReduceOp.AVERAGE:
+                flat = flat / num_ranks
+            if postscale_factor != 1.0:
+                flat = flat * postscale_factor
+            flat = flat.astype(dtype)
+            outs = []
+            offset = 0
+            for size, shape in zip(sizes, shapes):
+                outs.append(
+                    jax.lax.slice(flat, (offset,),
+                                  (offset + size,)).reshape(shape))
+                offset += size
+            return tuple(outs)
+
+        return jax.jit(fused, donate_argnums=0)
 
     # -------------------------------------------------------------- allgather
     def allgather(self, entry):

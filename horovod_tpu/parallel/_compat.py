@@ -25,6 +25,17 @@ def shard_map_unchecked(*args, **kwargs):
     return shard_map(*args, **kwargs)
 
 
+def axis_size(axis_name):
+    """``lax.axis_size`` where it exists (newer jax); ``psum(1, axis)``
+    on older releases — equally constant-folded inside shard_map/pmap,
+    so call sites can treat the result as a static int either way."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def shard_map_kernel_body(*args, **kwargs):
     """shard_map for bodies that may call Pallas kernels: checking stays ON
     when lowering for real TPU, and is disabled only on the CPU backend,

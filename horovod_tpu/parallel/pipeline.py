@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from horovod_tpu.parallel._compat import shard_map_unchecked
+from horovod_tpu.parallel._compat import axis_size, shard_map_unchecked
 
 
 def pipeline_apply(stage_fn, stage_params, microbatches, *, axis_name="pp"):
@@ -34,7 +34,7 @@ def pipeline_apply(stage_fn, stage_params, microbatches, *, axis_name="pp"):
     Returns ``[M, mb, ...]`` outputs, valid on every shard (the last
     stage's results are broadcast back with a masked psum).
     """
-    s = lax.axis_size(axis_name)
+    s = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m = microbatches.shape[0]
     ticks = m + s - 1

@@ -28,7 +28,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from horovod_tpu.parallel._compat import shard_map
+from horovod_tpu.parallel._compat import axis_size
+# unchecked: jax's replication checker mis-infers through the
+# grad-of-cond in the ring step on some releases (the error text
+# itself prescribes check_rep=False as the workaround)
+from horovod_tpu.parallel._compat import shard_map_unchecked as shard_map
 
 
 _NEG_INF = -1e30
@@ -87,7 +91,7 @@ def ring_attention(q, k, v, *, axis_name, causal=False, scale=None,
     streaming-softmax combine as the dense path, so results are exact
     either way.
     """
-    p_size = lax.axis_size(axis_name)
+    p_size = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name) if query_chunk_idx is None \
         else query_chunk_idx
     b, tq, h, d = q.shape

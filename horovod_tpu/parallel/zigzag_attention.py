@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from horovod_tpu.parallel._compat import shard_map
+from horovod_tpu.parallel._compat import axis_size, shard_map
 from horovod_tpu.parallel.ring_attention import (_NEG_INF, _block_attend,
                                                  _combine)
 
@@ -119,7 +119,7 @@ def zigzag_ring_attention(q, k, v, *, axis_name, scale=None,
     causal — for the non-causal case the plain ring is already
     balanced; use :func:`ring_attention`.
     """
-    p_size = lax.axis_size(axis_name)
+    p_size = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, t2, h, d = q.shape
     if t2 % 2:

@@ -15,6 +15,7 @@ _PARAMS = {
     "hierarchical_allreduce": (env_util.HVD_HIERARCHICAL_ALLREDUCE, "params.hierarchical_allreduce"),
     "hierarchical_allgather": (env_util.HVD_HIERARCHICAL_ALLGATHER, "params.hierarchical_allgather"),
     "adasum_hierarchical": (env_util.HVD_ADASUM_HIERARCHICAL, "params.adasum_hierarchical"),
+    "compression": (env_util.HVD_TPU_COMPRESSION, "params.compression"),
     "autotune": (env_util.HVD_AUTOTUNE, "autotune.enabled"),
     "autotune_log_file": (env_util.HVD_AUTOTUNE_LOG, "autotune.log_file"),
     "autotune_warmup_samples": (env_util.HVD_AUTOTUNE_WARMUP_SAMPLES, "autotune.warmup_samples"),

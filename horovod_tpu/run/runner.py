@@ -104,6 +104,12 @@ def make_parser():
                             "hierarchical Adasum (adasum of per-group "
                             "averages — numerically different from flat "
                             "Adasum)")
+    group.add_argument("--compression",
+                       choices=["none", "bf16", "fp16", "int8"],
+                       default=None,
+                       help="Default on-the-wire allreduce compression "
+                            "(HVD_TPU_COMPRESSION); int8 is block-scaled "
+                            "quantization — see docs/compression.md.")
     group.add_argument("--controller", choices=["native", "python", "tcp"],
                        default=None)
 

@@ -316,7 +316,8 @@ def prefetch_to_device(iterator, size=2, *, sharding=None, mesh=None,
 
     # start staging NOW (not at first next()): the whole point is the
     # first batch being on device before the loop asks for it
-    threading.Thread(target=producer, daemon=True).start()
+    producer_thread = threading.Thread(target=producer, daemon=True)
+    producer_thread.start()
 
     def consume():
         try:
@@ -330,9 +331,29 @@ def prefetch_to_device(iterator, size=2, *, sharding=None, mesh=None,
                 yield item
         finally:
             # consumer done (exhausted, errored, or closed early):
-            # release the producer and any queued device batches
+            # release the producer and any queued device batches.  One
+            # drain pass is not enough: a producer already inside q.put
+            # when stop is set can land one more item after the drain,
+            # pinning a device-resident batch until garbage collection.
+            # _put re-checks stop before every attempt, so that window
+            # closes within one put timeout (0.2s) — keep draining until
+            # the producer exits or that window has passed; never block
+            # on the SOURCE iterator, which may legally stall.
+            import time as _time
+
             stop.set()
+            deadline = _time.monotonic() + 1.0
             while True:
+                try:
+                    q.get_nowait()
+                    continue
+                except queue.Empty:
+                    pass
+                producer_thread.join(timeout=0.05)
+                if not producer_thread.is_alive() \
+                        or _time.monotonic() > deadline:
+                    break
+            while True:  # whatever landed during the final join
                 try:
                     q.get_nowait()
                 except queue.Empty:

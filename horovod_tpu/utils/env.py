@@ -34,6 +34,9 @@ HVD_CONTROLLER = "HVD_CONTROLLER"                      # native | python | tcp
 HVD_CPU_OPERATIONS = "HVD_CPU_OPERATIONS"              # xla | ring | python
 HVD_ADASUM_CHUNK_SIZE = "HVD_ADASUM_CHUNK_SIZE"
 HVD_NUM_STREAMS = "HVD_NUM_STREAMS"
+# default on-the-wire allreduce compression: none | bf16 | fp16 | int8
+# (block-scaled int8, EQuARX arXiv:2506.17615)
+HVD_TPU_COMPRESSION = "HVD_TPU_COMPRESSION"
 
 # --- launcher -> worker contract (reference: gloo_run.py:152-157,261-273) ----
 HVD_RANK = "HVD_RANK"

@@ -245,3 +245,24 @@ def test_mpi_args_flag_splits():
     import shlex
     assert shlex.split(args.mpi_args) == [
         "--mca", "btl_tcp_if_include", "eth0"]
+
+
+def test_compression_tri_surface(monkeypatch, tmp_path):
+    """--compression / params.compression / HVD_TPU_COMPRESSION all land
+    on Config.compression, CLI winning over YAML."""
+    from horovod_tpu.common.config import Config
+
+    args = _parse(["-np", "2", "--compression", "int8"])
+    env = config_parser.env_from_args(args)
+    assert env[env_util.HVD_TPU_COMPRESSION] == "int8"
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("params:\n  compression: bf16\n")
+    args = _parse(["-np", "2"])
+    config_parser.apply_config_to_args(
+        args, config_parser.load_config_file(str(cfg)))
+    env = config_parser.env_from_args(args)
+    assert env[env_util.HVD_TPU_COMPRESSION] == "bf16"
+
+    monkeypatch.setenv(env_util.HVD_TPU_COMPRESSION, "fp16")
+    assert Config.from_env().compression == "fp16"

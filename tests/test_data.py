@@ -251,3 +251,41 @@ def test_prefetch_rejects_bad_args():
     mesh = make_mesh({"dp": 8})
     with pytest.raises(ValueError, match="not both"):
         prefetch_to_device(iter([]), sharding=object(), mesh=mesh)
+
+
+def test_prefetch_close_releases_all_staged_batches(monkeypatch):
+    """Early close must promptly release EVERY device-staged batch —
+    including one a producer mid-``q.put`` lands after the first drain
+    pass (the round-5 shutdown race): no batch may stay pinned in the
+    queue waiting for garbage collection."""
+    import time
+    import weakref
+
+    import jax
+
+    refs = []
+    real_put = jax.device_put
+
+    def tracking_put(x):
+        out = real_put(x)
+        refs.append(weakref.ref(out))
+        return out
+
+    monkeypatch.setattr(jax, "device_put", tracking_put)
+
+    def src():
+        for i in range(10):
+            yield np.full((4,), i, np.float32)
+
+    it = prefetch_to_device(src(), size=2)
+    first = next(it)
+    it.close()
+    del first
+    # keep `it` alive: the leak mode was "pinned in the queue until the
+    # GENERATOR is collected" — releasing must not depend on that
+    deadline = time.time() + 3.0
+    while any(r() is not None for r in refs) and time.time() < deadline:
+        time.sleep(0.05)
+    alive = sum(r() is not None for r in refs)
+    assert alive == 0, f"{alive} staged device batches still pinned"
+    assert it is not None

@@ -174,3 +174,33 @@ def test_unmodified_reference_style_tf_script_under_horovodrun(tmp_path):
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout[-2000:]}\nstderr:\n{result.stderr[-2000:]}"
     assert "TF_REFERENCE_STYLE_OK" in result.stdout
+
+
+def test_alias_modules_keep_own_spec_and_support_reload():
+    """The alias loader must restore the implementation module's own
+    __spec__ (ADVICE round 5): with the alias spec left in place,
+    importlib.reload() dispatched to the no-op alias loader and was a
+    silent no-op, and find_spec disagreed with __name__."""
+    script = (
+        "import importlib, importlib.util\n"
+        "import horovod.torch as t\n"
+        "assert t.__name__ == 'horovod_tpu.torch', t.__name__\n"
+        "assert t.__spec__ is not None\n"
+        "assert t.__spec__.name == 'horovod_tpu.torch', t.__spec__.name\n"
+        "# reload must actually re-execute the implementation module:\n"
+        "# delete a module-level binding and check re-execution"
+        " restores it\n"
+        "del t.DistributedOptimizer\n"
+        "t2 = importlib.reload(t)\n"
+        "assert t2 is t\n"
+        "assert hasattr(t, 'DistributedOptimizer'), 'reload was a no-op'\n"
+        "assert t.__spec__.name == 'horovod_tpu.torch'\n"
+        "print('ALIAS_SPEC_OK')\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    result = subprocess.run([sys.executable, "-c", script], env=env,
+                            capture_output=True, text=True, timeout=180)
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "ALIAS_SPEC_OK" in result.stdout

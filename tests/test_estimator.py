@@ -297,3 +297,34 @@ def test_keras_estimator_validation_split_row_weighted(tmp_path):
     full = fitted.evaluate(x_val, y_val)
     np.testing.assert_allclose(metrics[0]["val_loss"], full,
                                rtol=5e-3, atol=1e-5)
+
+
+def test_spmd_streamed_batches_trim_per_epoch(tmp_path):
+    """Unequal shards: every epoch must restart EVERY shard at its first
+    row and yield exactly steps_per_epoch (smallest shard) global
+    batches — the run-level zip let epoch boundaries drift, pairing a
+    large shard's epoch-1 tail with a small shard's epoch-2 head
+    (ADVICE round 5)."""
+    import numpy as np
+    import pytest
+
+    pytest.importorskip("pyarrow")
+    from horovod_tpu.cluster.estimator import _spmd_streamed_batches
+    from horovod_tpu.cluster.parquet_store import ParquetStore
+
+    rows = 40
+    store = ParquetStore(str(tmp_path / "store"), rows_per_row_group=8)
+    store.materialize({"x": np.arange(rows * 2, dtype=np.float32)
+                            .reshape(rows, 2),
+                       "y": np.arange(rows, dtype=np.int64)})
+    # 5 row groups over 2 shards: shard 0 holds 24 rows, shard 1 holds
+    # 16 -> steps_per_epoch = 16 // 4 = 4
+    batches = list(_spmd_streamed_batches(store, 2, 4, epochs=2))
+    assert len(batches) == 8, len(batches)
+    # epoch 2 must replay epoch 1 exactly (no shuffle, per-epoch reset)
+    for step in range(4):
+        np.testing.assert_array_equal(batches[step]["y"],
+                                      batches[4 + step]["y"])
+    # within one global batch both halves come from the SAME epoch
+    # phase: shard 0's first batch starts at its first row
+    assert batches[0]["y"][0] == 0

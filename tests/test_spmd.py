@@ -146,9 +146,8 @@ def test_adasum_spmd_matches_reference(hvd_init, mesh):
 
     data = jax.device_put(jnp.asarray(per_rank),
                           NamedSharding(mesh, P("hvd")))
-    out = jax.jit(shard_map(
+    out = jax.jit(shard_map_unchecked(
         body, mesh=mesh, in_specs=(P("hvd"),), out_specs=P(),
-        check_vma=False,
     ))(data.reshape(8, 1, 16))
     np.testing.assert_allclose(np.asarray(out).reshape(-1), expected,
                                rtol=1e-4, atol=1e-5)
@@ -167,10 +166,9 @@ def test_adasum_vhdd_matches_reference(hvd_init):
         per_rank = rng.randn(8, n).astype(np.float32)
         expected = adasum_reference(list(per_rank))
 
-        out = jax.jit(shard_map(
+        out = jax.jit(shard_map_unchecked(
             lambda g: adasum_vhdd(g[0], "x")[None],
             mesh=mesh, in_specs=(P("x"),), out_specs=P(),
-            check_vma=False,
         ))(jnp.asarray(per_rank).reshape(8, 1, n))
         np.testing.assert_allclose(np.asarray(out).reshape(-1), expected,
                                    rtol=1e-4, atol=1e-5)
@@ -191,10 +189,9 @@ def test_adasum_hierarchical_matches_reference(hvd_init):
     group_b = per_rank[4:].sum(axis=0) / 4.0
     expected = adasum_reference([group_a, group_b])
 
-    out = jax.jit(shard_map(
+    out = jax.jit(shard_map_unchecked(
         lambda g: adasum_reduce_hierarchical(g[0])[None],
         mesh=mesh, in_specs=(P(("cross", "local")),), out_specs=P(),
-        check_vma=False,
     ))(jnp.asarray(per_rank).reshape(8, 1, 33))
     np.testing.assert_allclose(np.asarray(out).reshape(-1), expected,
                                rtol=1e-4, atol=1e-5)
