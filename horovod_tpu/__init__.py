@@ -23,6 +23,7 @@ from horovod_tpu.common.basics import (  # noqa: F401
     init,
     shutdown,
     is_initialized,
+    abort,
     rank,
     size,
     local_rank,
@@ -42,6 +43,10 @@ from horovod_tpu.common.basics import (  # noqa: F401
     ddl_built,
     mpi_threads_supported,
     is_homogeneous,
+)
+from horovod_tpu.common.handles import (  # noqa: F401
+    HvdAbortedError,
+    HvdError,
 )
 from horovod_tpu.common.ops_enum import Average, Sum, Adasum  # noqa: F401
 from horovod_tpu.ops.eager import (  # noqa: F401

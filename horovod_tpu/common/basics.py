@@ -57,6 +57,13 @@ def init(comm=None, controller=None):
         if controller:
             config.controller = controller
 
+        # deterministic fault injection (docs/fault_tolerance.md): arm
+        # the process-wide injector before any controller/transport code
+        # runs, keyed by this process's launcher rank
+        from horovod_tpu.common import faults
+        faults.configure(config.fault_spec,
+                         rank=env_util.get_int(env_util.HVD_RANK, 0))
+
         env_topology = topology_mod.from_env()
         explicit = (controller or
                     env_util.get_str(env_util.HVD_CONTROLLER))
@@ -176,6 +183,27 @@ def shutdown():
 
 def is_initialized() -> bool:
     return _state is not None
+
+
+def abort(reason="aborted by user"):
+    """Broadcast a coordinated abort for the in-flight collective round
+    (docs/fault_tolerance.md).
+
+    Every rank — including ranks currently blocked inside a collective —
+    purges its in-flight ring state and raises
+    :class:`horovod_tpu.HvdAbortedError` (naming this rank as the
+    origin) within ``HVD_TPU_ABORT_TIMEOUT``.  Use it when this rank
+    detects an unrecoverable condition (corrupt batch, failed health
+    check) and the whole job must unwind symmetrically instead of
+    leaving peers hanging in a half-finished round.
+    """
+    state = _get_state()
+    do_abort = getattr(state.controller, "abort", None)
+    if do_abort is None:
+        raise NotImplementedError(
+            f"controller {state.config.controller!r} does not support "
+            f"coordinated abort")
+    do_abort(rank(), reason)
 
 
 def _get_state() -> _GlobalState:

@@ -36,6 +36,15 @@ class Config:
     # "fp16" | "int8") for requests that don't pass one explicitly;
     # autotune may toggle it between the configured value and "none".
     compression: str = "none"
+    # Fault-tolerant runtime knobs (docs/fault_tolerance.md): bound on
+    # abort propagation, heartbeat period, missed-heartbeat window
+    # (0 disables liveness tracking), and the deterministic fault spec.
+    abort_timeout_seconds: float = env_util.DEFAULT_ABORT_TIMEOUT_SECONDS
+    heartbeat_interval_seconds: float = \
+        env_util.DEFAULT_HEARTBEAT_INTERVAL_SECONDS
+    liveness_timeout_seconds: float = \
+        env_util.DEFAULT_LIVENESS_TIMEOUT_SECONDS
+    fault_spec: str | None = None
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -76,7 +85,41 @@ class Config:
                 env_util.HVD_ADASUM_HIERARCHICAL),
             compression=_validated_compression(env_util.get_str(
                 env_util.HVD_TPU_COMPRESSION, "none")),
+            abort_timeout_seconds=env_util.get_float(
+                env_util.HVD_TPU_ABORT_TIMEOUT,
+                env_util.DEFAULT_ABORT_TIMEOUT_SECONDS),
+            heartbeat_interval_seconds=env_util.get_float(
+                env_util.HVD_TPU_HEARTBEAT_INTERVAL,
+                env_util.DEFAULT_HEARTBEAT_INTERVAL_SECONDS),
+            liveness_timeout_seconds=env_util.get_float(
+                env_util.HVD_TPU_LIVENESS_TIMEOUT,
+                env_util.DEFAULT_LIVENESS_TIMEOUT_SECONDS),
+            fault_spec=_validated_fault_spec(env_util.get_str(
+                env_util.HVD_TPU_FAULT_SPEC)),
         )
+
+
+def effective_heartbeat_interval(config) -> float:
+    """The heartbeat period both controllers actually run: the
+    configured interval clamped to at most a quarter of the abort
+    deadline (so abort propagation meets it); 0 only when the interval
+    AND the abort timeout are both disabled."""
+    interval = config.heartbeat_interval_seconds
+    if config.abort_timeout_seconds > 0:
+        interval = min(interval or 1e9,
+                       config.abort_timeout_seconds / 4.0)
+    return interval
+
+
+def _validated_fault_spec(text):
+    """Same fail-at-init rule as compression: a fault spec with a typo
+    would otherwise silently never fire and the chaos run would prove
+    nothing."""
+    if text:
+        from horovod_tpu.common.faults import parse_fault_spec
+
+        parse_fault_spec(text)
+    return text
 
 
 def _validated_compression(name: str) -> str:

@@ -1,0 +1,170 @@
+"""Deterministic fault injection for the collective runtime.
+
+``HVD_TPU_FAULT_SPEC`` holds a comma-separated list of fault specs; each
+spec triggers one failure at an exact step of an instrumented point, so
+tests and chaos runs (``bin/hvd-chaos``) can reproduce a failure mode
+bit-for-bit instead of waiting for it to happen in production:
+
+    HVD_TPU_FAULT_SPEC="rank1:allreduce:2:crash,*:connect:1:refuse"
+
+Grammar (one spec)::
+
+    <target>:<point>:<step>:<action>
+
+    target  rank<N> — only rank N trips the fault; * — any rank
+    point   an instrumented site name.  Shipping points:
+              allreduce / broadcast / allgather / alltoall / adasum
+                  (controller submit path, before negotiation)
+              ring      (ring data plane, after the coordinator's go-ahead
+                         — i.e. mid-collective)
+              send / recv   (ring chunk transport)
+              connect   (any control/data-plane TCP connection attempt)
+    step    1-based hit count of that point in this process: the fault
+            fires on exactly the step-th call
+    action  crash  — hard-exit the process (os._exit(1)): a dead rank
+            drop   — silently skip the operation: a silent packet/worker
+            refuse — raise ConnectionRefusedError: a transport blip
+
+Counters are per-process and per-point.  The module is inert (one dict
+lookup per check) when no spec is configured.
+"""
+
+import os
+import sys
+import threading
+
+_ACTIONS = ("crash", "drop", "refuse")
+
+
+class FaultSpec:
+    __slots__ = ("rank", "point", "step", "action")
+
+    def __init__(self, rank, point, step, action):
+        self.rank = rank        # int, or None for "*"
+        self.point = point
+        self.step = step
+        self.action = action
+
+    def __repr__(self):
+        target = "*" if self.rank is None else f"rank{self.rank}"
+        return f"{target}:{self.point}:{self.step}:{self.action}"
+
+
+def parse_fault_spec(text):
+    """Parse a spec string into FaultSpec objects; raises ValueError with
+    the offending fragment so a typo fails the job at init, not at the
+    (never-reached) injection point."""
+    specs = []
+    for part in (p.strip() for p in (text or "").split(",")):
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) != 4:
+            raise ValueError(
+                f"fault spec {part!r}: expected "
+                f"<target>:<point>:<step>:<action>")
+        target, point, step_s, action = fields
+        if target == "*":
+            rank = None
+        elif target.startswith("rank"):
+            try:
+                rank = int(target[4:])
+            except ValueError:
+                raise ValueError(
+                    f"fault spec {part!r}: bad target {target!r}") from None
+        else:
+            raise ValueError(
+                f"fault spec {part!r}: target must be rank<N> or *")
+        try:
+            step = int(step_s)
+        except ValueError:
+            raise ValueError(
+                f"fault spec {part!r}: step must be an integer") from None
+        if step < 1:
+            raise ValueError(f"fault spec {part!r}: step is 1-based")
+        if action not in _ACTIONS:
+            raise ValueError(
+                f"fault spec {part!r}: action must be one of {_ACTIONS}")
+        if not point:
+            raise ValueError(f"fault spec {part!r}: empty point")
+        specs.append(FaultSpec(rank, point, step, action))
+    return specs
+
+
+class FaultInjector:
+    """Counts hits per point and returns the matching action, if any."""
+
+    def __init__(self, specs, rank=0):
+        self._specs = list(specs)
+        self._rank = rank
+        self._counts = {}
+        self._lock = threading.Lock()
+
+    def fire(self, point):
+        with self._lock:
+            n = self._counts.get(point, 0) + 1
+            self._counts[point] = n
+        for spec in self._specs:
+            if (spec.point == point and spec.step == n
+                    and spec.rank in (None, self._rank)):
+                return spec.action
+        return None
+
+
+_injector = None
+_configured = False
+_config_lock = threading.Lock()
+
+
+def configure(spec_text, rank=0):
+    """Install the process-wide injector (``hvd.init()`` calls this with
+    the resolved config + rank; tests call it directly)."""
+    global _injector, _configured
+    with _config_lock:
+        specs = parse_fault_spec(spec_text) if spec_text else []
+        _injector = FaultInjector(specs, rank=rank) if specs else None
+        _configured = True
+
+
+def _auto_configure():
+    """Fallback for points hit before ``hvd.init()`` (e.g. a connect
+    during rendezvous): read the env contract directly.  Only WORKER
+    processes (HVD_RANK present) arm the injector — the launcher/driver
+    shares the spec env var but must neither trip rank-0 faults itself
+    nor advance step counters the workers' determinism depends on."""
+    from horovod_tpu.utils import env as env_util
+
+    rank = os.environ.get(env_util.HVD_RANK)
+    if rank is None:
+        configure(None)
+    else:
+        configure(os.environ.get(env_util.HVD_TPU_FAULT_SPEC),
+                  rank=env_util.get_int(env_util.HVD_RANK, 0))
+
+
+def check(point) -> bool:
+    """Trip any fault armed for this hit of ``point``.
+
+    Returns True when the caller must DROP the operation; raises
+    ConnectionRefusedError for ``refuse``; ``crash`` never returns.
+    """
+    if not _configured:
+        _auto_configure()
+    injector = _injector
+    if injector is None:
+        return False
+    action = injector.fire(point)
+    if action is None:
+        return False
+    if action == "drop":
+        print(f"[hvd-fault] dropping {point} (injected)",
+              file=sys.stderr, flush=True)
+        return True
+    if action == "refuse":
+        raise ConnectionRefusedError(
+            f"injected connection refusal at {point} (HVD_TPU_FAULT_SPEC)")
+    # crash: bypass every handler — this models a rank dying mid-step
+    print(f"[hvd-fault] crashing at {point} (injected)",
+          file=sys.stderr, flush=True)
+    sys.stderr.flush()
+    os._exit(1)

@@ -13,6 +13,23 @@ class HvdError(RuntimeError):
     """Raised when a collective fails (reference: Response::ERROR path)."""
 
 
+class HvdAbortedError(HvdError):
+    """Raised on EVERY rank when the collective runtime performs a
+    coordinated abort — a rank crashed, went silent past the liveness
+    window, hit an unrecoverable transport error, or the stall inspector
+    promoted a stalled tensor into a shutdown.  Symmetric by design: all
+    survivors raise this one typed error (naming the origin rank) within
+    ``HVD_TPU_ABORT_TIMEOUT`` instead of hanging or failing each with a
+    different exception and leaked ring state."""
+
+    def __init__(self, origin_rank, reason):
+        super().__init__(
+            f"collective runtime aborted (origin rank {origin_rank}): "
+            f"{reason}")
+        self.origin_rank = origin_rank
+        self.reason = reason
+
+
 class Handle:
     """Completion handle for one rank's view of one collective."""
 
@@ -25,11 +42,18 @@ class Handle:
         self.name = name
 
     def set_result(self, result):
+        # first completion wins: an abort broadcast and the op's own
+        # failure path may both reach the same handle
+        if self._event.is_set():
+            return
         self._result = result
         self._event.set()
 
     def set_error(self, message):
-        self._error = HvdError(message)
+        if self._event.is_set():
+            return
+        self._error = (message if isinstance(message, HvdError)
+                       else HvdError(message))
         self._event.set()
 
     def poll(self) -> bool:

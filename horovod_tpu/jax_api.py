@@ -268,8 +268,22 @@ def broadcast_parameters(params, root_rank=0):
                               name=f"broadcast.parameters.{i}")
         for i, leaf in enumerate(leaves)
     ]
-    return jax.tree.unflatten(treedef,
-                              [eager.synchronize(h) for h in handles])
+    # drain EVERY handle before raising: abandoning the rest mid-pytree
+    # on the first failure (e.g. an HvdAbortedError) would leave their
+    # completions unobserved and, on the tcp plane, chunks parked in the
+    # peer mailbox
+    from horovod_tpu.common.handles import HvdError
+
+    results, first_error = [], None
+    for handle in handles:
+        try:
+            results.append(eager.synchronize(handle))
+        except HvdError as exc:
+            if first_error is None:
+                first_error = exc
+    if first_error is not None:
+        raise first_error
+    return jax.tree.unflatten(treedef, results)
 
 
 def broadcast_optimizer_state(opt_state, root_rank=0):

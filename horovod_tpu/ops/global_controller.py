@@ -34,6 +34,7 @@ import time
 
 import numpy as np
 
+from horovod_tpu.common.handles import HvdAbortedError
 from horovod_tpu.common.ops_enum import ReduceOp, RequestType
 from horovod_tpu.common.fusion import plan_buckets
 from horovod_tpu.ops.python_controller import GroupEntry, PythonController
@@ -96,15 +97,16 @@ class LogEntry:
     __slots__ = ("seq", "kind", "req_type", "names", "shapes", "dtype",
                  "op", "prescale", "postscale", "root_rank", "all_dims0",
                  "splits_matrix", "error", "last_rank", "joined", "params",
-                 "compression")
+                 "compression", "origin")
 
     def __init__(self, seq, kind, req_type=None, names=(), shapes=(),
                  dtype=None, op=0, prescale=1.0, postscale=1.0,
                  root_rank=-1, all_dims0=None, splits_matrix=None,
                  error=None, last_rank=-1, joined=(), params=None,
-                 compression="none"):
+                 compression="none", origin=-1):
         self.seq = seq
-        self.kind = kind    # "group" | "error" | "join_done" | "params"
+        self.kind = kind  # "group" | "error" | "join_done" | "params"
+        #                   | "abort"
         self.req_type = req_type
         self.names = tuple(names)
         self.shapes = tuple(tuple(s) for s in shapes)
@@ -120,6 +122,7 @@ class LogEntry:
         self.joined = tuple(joined)   # global joined snapshot at emit time
         self.params = params          # tuned knob dict ("params" entries)
         self.compression = compression  # coordinator-resolved wire format
+        self.origin = origin          # abort origin rank ("abort" entries)
 
 
 class CycleResp:
@@ -148,7 +151,7 @@ class MetaCoordinatorService(network.MuxService):
 
     def __init__(self, num_processes, local_sizes, key, fusion_threshold,
                  stall_warning_sec=60.0, stall_shutdown_sec=0.0,
-                 autotune=None):
+                 autotune=None, liveness_timeout_sec=0.0):
         self._nproc = num_processes
         self._local_sizes = local_sizes      # ranks per process
         self._rank_pid = {}
@@ -170,6 +173,14 @@ class MetaCoordinatorService(network.MuxService):
         self._acked = {}                 # pid -> highest seq acknowledged
         self._seq = 0
         self._join_epoch = 0  # completed join_done rounds
+        self._liveness = liveness_timeout_sec
+        # seeded for EVERY pid at construction: a process that dies
+        # before its first CycleMsg must still trip the liveness window
+        # (safe: the jax.distributed barrier precedes controller start,
+        # so all processes exist by now and report within a heartbeat)
+        self._last_seen = {p: time.monotonic()
+                           for p in range(num_processes)}
+        self._aborted = None             # (origin_rank, reason), sticky
         self._log = get_logger()
         super().__init__(self.NAME, key)
 
@@ -177,7 +188,52 @@ class MetaCoordinatorService(network.MuxService):
     def _handle(self, req, client_address):
         if isinstance(req, CycleMsg):
             return self._handle_cycle(req)
+        if isinstance(req, network.HeartbeatMsg):
+            # dedicated liveness beat (``rank`` carries the pid): keeps
+            # last_seen fresh even while the sender's coordination loop
+            # is blocked inside a long collective execution or compile
+            with self._cv:
+                self._last_seen[req.rank] = time.monotonic()
+                self._check_liveness()
+                return network.HeartbeatReply(abort=self._aborted)
+        if isinstance(req, network.AbortMsg):
+            with self._cv:
+                self._initiate_abort(req.origin_rank, req.reason)
+            return network.AckResponse()
         return super()._handle(req, client_address)
+
+    # -------------------------------------------------- abort + liveness
+    def _initiate_abort(self, origin_rank, reason):
+        """Emit one globally-ordered abort entry (caller holds the lock):
+        every process applies it at the same point of the response
+        stream and fails all of its ranks with the same typed error."""
+        if self._aborted is not None:
+            return
+        self._aborted = (origin_rank, reason)
+        self._table.clear()
+        self._log.error("coordinated abort (origin rank %s): %s",
+                        origin_rank, reason)
+        self._emit(LogEntry(self._next_seq(), "abort", error=reason,
+                            origin=origin_rank))
+
+    def _check_liveness(self):
+        """A process silent past the liveness window is presumed dead —
+        convert the silence into an abort naming its first global rank
+        (caller holds the lock).  Fully-joined processes are exempt:
+        they legitimately go quiet (and may exit) once no collective
+        needs them."""
+        if self._liveness <= 0 or self._aborted is not None:
+            return
+        now = time.monotonic()
+        required = self._required_pids()
+        dead = sorted(p for p, ts in self._last_seen.items()
+                      if now - ts > self._liveness and p in required)
+        if dead:
+            base = sum(self._local_sizes[:dead[0]])
+            self._initiate_abort(
+                base,
+                f"process {dead[0]} (ranks from {base}) sent no heartbeat "
+                f"for more than {self._liveness:g}s (presumed dead)")
 
     def _required_pids(self):
         """Processes that still host at least one non-joined rank."""
@@ -191,6 +247,8 @@ class MetaCoordinatorService(network.MuxService):
 
     def _handle_cycle(self, msg):
         with self._cv:
+            self._last_seen[msg.pid] = time.monotonic()
+            self._check_liveness()
             self._acked[msg.pid] = max(self._acked.get(msg.pid, 0),
                                        msg.last_seq)
             self._trim_log()
@@ -206,7 +264,9 @@ class MetaCoordinatorService(network.MuxService):
             inflight = {n for e in self._log_entries
                         if e.seq > msg.last_seq for n in e.names}
             for req in msg.reqs:
-                if req.name in inflight:
+                if req.name in inflight or self._aborted is not None:
+                    # post-abort requests would never complete — the
+                    # abort entry below fails them process-side instead
                     continue
                 entry = self._table.get(req.name)
                 if entry is None:
@@ -454,11 +514,21 @@ class MetaCoordinatorService(network.MuxService):
                     int(self._stall_warning))
                 entry.stall_warned = True
             if self._stall_shutdown > 0 and age > self._stall_shutdown:
-                del self._table[name]
-                self._emit(LogEntry(
-                    self._next_seq(), "error", names=[name],
-                    error=(f"stalled tensor '{name}' exceeded shutdown "
-                           f"threshold of {self._stall_shutdown}s")))
+                # promoted into a coordinated abort: the first silent
+                # REQUIRED process names the origin rank (a fully-joined
+                # process legitimately submits nothing and must not take
+                # the blame), and EVERY process's ranks fail with the
+                # same typed error (not just this name's waiters)
+                waiting = sorted(self._required_pids()
+                                 - set(entry.reqs.keys()))
+                origin = (sum(self._local_sizes[:waiting[0]])
+                          if waiting else -1)
+                self._initiate_abort(
+                    origin,
+                    f"stalled tensor '{name}' exceeded shutdown "
+                    f"threshold of {self._stall_shutdown}s (waiting on "
+                    f"processes {waiting})")
+                return
 
 
 # ----------------------------------------------------------------- controller
@@ -482,6 +552,9 @@ class GlobalMeshController(PythonController):
         self._join_epoch = 0  # join_done rounds observed
         self._send_fail_since = None
         self._last_seq = 0
+        self._last_cycle_sent = time.monotonic()
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
         self._coordinator = None
         self._client_addrs = None
         self._client_obj = None
@@ -520,6 +593,14 @@ class GlobalMeshController(PythonController):
             from horovod_tpu.ops.autotune import AutotuneManager
             self._coord_autotune = AutotuneManager.create(self._config,
                                                           self._log)
+            # liveness is only meaningful while heartbeats flow: with
+            # them off, a quiet-but-healthy process (long compile, gap
+            # between steps) would read as dead
+            from horovod_tpu.common.config import \
+                effective_heartbeat_interval
+            liveness = (self._config.liveness_timeout_seconds
+                        if effective_heartbeat_interval(self._config) > 0
+                        else 0.0)
             self._coordinator = MetaCoordinatorService(
                 self._nproc,
                 [self._local_size] * self._nproc,
@@ -527,7 +608,8 @@ class GlobalMeshController(PythonController):
                 self._config.fusion_threshold_bytes,
                 stall_warning_sec=self._config.stall_warning_seconds,
                 stall_shutdown_sec=self._config.stall_shutdown_seconds,
-                autotune=self._coord_autotune)
+                autotune=self._coord_autotune,
+                liveness_timeout_sec=liveness)
             tagged = [(iface, ip, self._coordinator.port)
                       for iface, ip in network.local_interfaces().items()]
             tagged.append(("lo", "127.0.0.1", self._coordinator.port))
@@ -552,6 +634,45 @@ class GlobalMeshController(PythonController):
             self._client_addrs = self._filter_ifaces(tagged)
         super().start()
 
+        # dedicated liveness heartbeat, SEPARATE from the coordination
+        # loop: the loop executes collectives synchronously, and a long
+        # XLA compile inside one would otherwise read as a dead process
+        # at the coordinator.  Same clamp as the tcp controller
+        # (heartbeats fully off only when interval AND abort timeout
+        # are 0).
+        from horovod_tpu.common.config import effective_heartbeat_interval
+        interval = effective_heartbeat_interval(self._config)
+        if self._nproc > 1 and interval > 0:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, args=(interval,),
+                daemon=True, name="hvd-gmesh-heartbeat")
+            self._hb_thread.start()
+
+    def _heartbeat_loop(self, interval):
+        hb_client = network.MuxClient(self._client_addrs, self._key,
+                                      timeout=max(interval, 2.0),
+                                      retry_for=0)
+        try:
+            while not self._hb_stop.wait(timeout=interval):
+                try:
+                    reply = hb_client.send(
+                        network.HeartbeatMsg(self._pid),
+                        timeout=max(interval * 2, 5.0))
+                except Exception:  # noqa: BLE001 — the coordination
+                    # loop's own send/backoff path owns dead-coordinator
+                    # handling; a failed beat just means a stale
+                    # last_seen entry
+                    continue
+                ab = getattr(reply, "abort", None)
+                if ab is not None:
+                    # record for the loop to apply at its next safe
+                    # point (the loop owns the table); do NOT re-send an
+                    # AbortMsg like the public override would
+                    PythonController.abort(self, *ab)
+                    return
+        finally:
+            hb_client.close()
+
     @staticmethod
     def _filter_ifaces(tagged):
         iface = os.environ.get(env_util.HVD_IFACE)
@@ -567,7 +688,22 @@ class GlobalMeshController(PythonController):
                 self._client_addrs, self._key, timeout=30)
         return self._client_obj
 
+    def abort(self, origin_rank, reason):
+        """Broadcast a coordinated abort: best-effort notify the
+        metadata coordinator (which relays the globally-ordered abort
+        entry to every process), then fail locally."""
+        try:
+            self._client().send(network.AbortMsg(origin_rank, reason),
+                                timeout=5.0)
+        except Exception:  # noqa: BLE001 — local abort still proceeds
+            pass
+        super().abort(origin_rank, reason)
+
     def shutdown(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
         super().shutdown()
         from horovod_tpu.utils.timeline import publish_and_merge
 
@@ -586,6 +722,13 @@ class GlobalMeshController(PythonController):
 
     # --------------------------------------------------------- the wire cycle
     def _run_cycle(self, pending):
+        with self._lock:
+            aborted = self._shutdown_error
+        if aborted is not None:
+            # post-abort: fail fast instead of polling dead peers
+            for request in pending:
+                request.handle.set_error(aborted)
+            return
         with self._lock:
             self._joined_view = set(self._joined)
 
@@ -608,8 +751,14 @@ class GlobalMeshController(PythonController):
 
         with self._lock:
             join_outstanding = bool(self._join_handles)
+        # idle processes still report in every heartbeat interval: the
+        # coordinator's liveness window needs a steady last-seen signal,
+        # and the empty CycleMsg doubles as the abort-state poll
+        hb = self._config.heartbeat_interval_seconds
+        heartbeat_due = (self._nproc > 1 and hb > 0
+                         and time.monotonic() - self._last_cycle_sent >= hb)
         if not (new_reqs or newly_joined or self._reported
-                or join_outstanding):
+                or join_outstanding or heartbeat_due):
             return
 
         msg = CycleMsg(self._pid, new_reqs, newly_joined, self._last_seq,
@@ -633,14 +782,18 @@ class GlobalMeshController(PythonController):
                 self._client_obj = None
             outage = time.monotonic() - self._send_fail_since
             if outage > _SEND_FAIL_LIMIT_S:
-                raise RuntimeError(
-                    f"coordinator unreachable for {int(outage)}s: "
-                    f"{exc}") from exc  # _loop fails all handles
+                # dead coordinator -> typed abort, not a hang: same
+                # surface as every other unrecoverable runtime failure
+                self._apply_abort(HvdAbortedError(
+                    0, f"coordinator unreachable for {int(outage)}s: "
+                       f"{exc}"))
+                return
             time.sleep(min(0.05 * 2 ** min(
                 int(outage), 6), 2.0))  # backoff, then retry
             self._wakeup.set()
             return
         self._send_fail_since = None
+        self._last_cycle_sent = time.monotonic()
         # reported only once the coordinator actually received them
         self._reported.update(r.name for r in new_reqs)
         self._joined_reported.update(newly_joined)
@@ -684,6 +837,16 @@ class GlobalMeshController(PythonController):
     def _apply(self, entry):
         if entry.kind == "params":
             self._apply_tuned(entry.params)
+            return
+
+        if entry.kind == "abort":
+            # coordinated abort: one typed error for every local rank's
+            # in-flight handle; the controller stays poisoned so later
+            # enqueues fail fast instead of waiting on dead peers
+            self._reported.clear()
+            self._joined_reported.clear()
+            self._apply_abort(HvdAbortedError(
+                getattr(entry, "origin", -1), entry.error))
             return
 
         if entry.kind == "error":

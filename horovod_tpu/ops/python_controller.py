@@ -31,6 +31,7 @@ import time
 import numpy as np
 
 from horovod_tpu.common.fusion import plan_buckets
+from horovod_tpu.common.handles import HvdAbortedError
 from horovod_tpu.common.ops_enum import ReduceOp, RequestType
 from horovod_tpu.common.response_cache import SignatureCache
 from horovod_tpu.utils.logging import get_logger
@@ -114,6 +115,7 @@ class PythonController:
         self._join_handles = {}
         self._running = False
         self._shutdown_error = None
+        self._abort_request = None  # (origin_rank, reason), loop-applied
         self._thread = None
         self._log = get_logger()
         self._sig_cache = SignatureCache(
@@ -210,6 +212,35 @@ class PythonController:
                         "horovod_tpu has been shut down")
             self._table.clear()
 
+    # ----------------------------------------------------------------- abort
+    def abort(self, origin_rank, reason):
+        """Coordinated abort (``hvd.abort()`` / a rank detecting an
+        unrecoverable failure): every in-flight and future collective
+        fails with one typed ``HvdAbortedError``.  The table is owned by
+        the coordination thread, so the abort is recorded here and
+        applied at the next cycle boundary — bounded by cycle_time."""
+        with self._lock:
+            if self._abort_request is None:
+                self._abort_request = (origin_rank, reason)
+        self._wakeup.set()
+
+    def _apply_abort(self, exc):
+        """Fail everything in flight with the typed error and poison the
+        controller so later enqueues fail fast (coordination-thread
+        context — the only legal place to touch the table)."""
+        self._log.error(str(exc))
+        with self._lock:
+            self._shutdown_error = exc
+            queued, self._queue = self._queue, []
+            join_handles = dict(self._join_handles)
+            self._join_handles.clear()
+            self._joined.clear()
+        for request in queued:
+            request.handle.set_error(exc)
+        for handle in join_handles.values():
+            handle.set_error(exc)
+        self._fail_all(exc)
+
     # ------------------------------------------------------- coordinator loop
     def _loop(self):
         while True:
@@ -221,6 +252,12 @@ class PythonController:
                 if not self._running:
                     return
                 pending, self._queue = self._queue, []
+                abort_req, self._abort_request = self._abort_request, None
+            if abort_req is not None:
+                for request in pending:
+                    request.handle.set_error(HvdAbortedError(*abort_req))
+                self._apply_abort(HvdAbortedError(*abort_req))
+                continue
             self._timeline.mark_cycle()
             try:
                 self._run_cycle(pending)
@@ -535,9 +572,16 @@ class PythonController:
                 # reference: stall_inspector.cc InvalidateStalledCachedTensors
                 self._sig_cache.evict(name)
             if shutdown_after > 0 and age > shutdown_after:
-                message = (f"stalled tensor '{name}' exceeded shutdown "
-                           f"threshold of {shutdown_after}s")
-                self._log.error(message)
-                self._shutdown_error = message
-                self._fail_all(message)
+                # promoted from a log line into a coordinated abort: one
+                # typed error on every rank, naming the first lagging
+                # rank as the origin
+                missing = sorted(set(range(self._size))
+                                 - set(entry.requests.keys())
+                                 - self._joined_view)
+                origin = missing[0] if missing else -1
+                self._apply_abort(HvdAbortedError(
+                    origin,
+                    f"stalled tensor '{name}' exceeded shutdown "
+                    f"threshold of {shutdown_after}s (waiting on ranks "
+                    f"{missing})"))
                 return

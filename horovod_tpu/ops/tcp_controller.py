@@ -41,6 +41,8 @@ import time
 
 import numpy as np
 
+from horovod_tpu.common import faults
+from horovod_tpu.common.handles import HvdAbortedError, HvdError
 from horovod_tpu.common.ops_enum import (ReduceOp, RequestType,
                                          is_float_dtype)
 from horovod_tpu.common.response_cache import SignatureCache
@@ -81,7 +83,7 @@ class ResultMsg:
     def __init__(self, payload=None, shape=None, dtype=None, error=None,
                  recv_splits=None, ring_go=False, participants=None,
                  dims0=None, ring_id=None, params_seq=0, params=None,
-                 resend=False, compression="none"):
+                 resend=False, compression="none", aborted=None):
         self.payload = payload
         self.shape = shape
         self.dtype = dtype
@@ -95,6 +97,7 @@ class ResultMsg:
         self.params = params            # tuned knob dict (rank 0 -> all)
         self.resend = resend    # ring infeasible: resubmit with payload
         self.compression = compression  # coordinator-resolved wire format
+        self.aborted = aborted  # (origin_rank, reason) coordinated abort
 
 
 class JoinMsg:
@@ -103,12 +106,14 @@ class JoinMsg:
 
 
 class JoinDoneMsg:
-    def __init__(self, last_rank):
+    def __init__(self, last_rank, abort=None):
         self.last_rank = last_rank
+        self.abort = abort              # (origin_rank, reason) | None
 
 
 class ShutdownMsg:
-    pass
+    def __init__(self, rank=None):
+        self.rank = rank  # deregisters the rank from liveness tracking
 
 
 def _wire_dtype(arr):
@@ -169,14 +174,17 @@ class CoordinatorService(network.MuxService):
 
     def __init__(self, size, key, stall_warning_sec=60.0,
                  stall_shutdown_sec=0.0, cache_capacity=1024,
-                 autotune=None):
+                 autotune=None, liveness_timeout_sec=0.0):
         self._size = size
         self._stall_warning = stall_warning_sec
         self._stall_shutdown = stall_shutdown_sec
+        self._liveness = liveness_timeout_sec
         self._cv = threading.Condition()
         self._forming = {}          # name -> _Entry
         self._joined = set()
         self._join_waiters = []     # (rank, Event, [last_rank])
+        self._last_seen = {}        # rank -> monotonic ts of last message
+        self._abort = None          # (origin_rank, reason), sticky
         self._sig_cache = SignatureCache(cache_capacity)
         self._ring_seq = 0               # unique id per ring round
         self._autotune = autotune        # rank-0-owned manager | None
@@ -187,13 +195,77 @@ class CoordinatorService(network.MuxService):
 
     # ----------------------------------------------------------- negotiation
     def _handle(self, req, client_address):
+        rank = getattr(req, "rank", None)
+        if rank is not None:
+            with self._cv:
+                self._last_seen[rank] = time.monotonic()
         if isinstance(req, CollectiveMsg):
             return self._handle_collective(req)
         if isinstance(req, JoinMsg):
             return self._handle_join(req)
+        if isinstance(req, network.HeartbeatMsg):
+            self._check_liveness()
+            return network.HeartbeatReply(abort=self._abort)
+        if isinstance(req, network.AbortMsg):
+            self._initiate_abort(req.origin_rank, req.reason)
+            return network.AckResponse()
         if isinstance(req, ShutdownMsg):
+            # a cleanly-departing rank stops heartbeating BY DESIGN: it
+            # must leave the liveness table, or a survivor doing slow
+            # post-training work would trip a spurious "presumed dead"
+            # abort on its stale last-seen entry
+            if req.rank is not None:
+                with self._cv:
+                    self._last_seen.pop(req.rank, None)
             return network.AckResponse()
         return super()._handle(req, client_address)
+
+    # -------------------------------------------------- abort + liveness
+    def _abort_result(self):
+        origin, reason = self._abort
+        return ResultMsg(
+            error=f"collective runtime aborted (origin rank {origin}): "
+                  f"{reason}",
+            aborted=(origin, reason))
+
+    def _initiate_abort(self, origin_rank, reason):
+        """Coordinated abort (reference analog: the stall inspector's
+        shutdown path, promoted from a log line into action): fail every
+        negotiating rank NOW with one typed, symmetric error; ranks not
+        currently negotiating learn the abort from their next heartbeat
+        reply.  Sticky — the surviving ranks are expected to unwind."""
+        with self._cv:
+            if self._abort is not None:
+                return
+            self._abort = (origin_rank, reason)
+            forming, self._forming = self._forming, {}
+            waiters, self._join_waiters = self._join_waiters, []
+            self._joined.clear()
+        self._log.error("coordinated abort (origin rank %s): %s",
+                        origin_rank, reason)
+        for entry in forming.values():
+            entry.results = {r: self._abort_result()
+                             for r in entry.requests}
+            entry.done.set()
+        for _, event, slot in waiters:
+            slot[0] = None  # join handler converts to a typed error
+            event.set()
+
+    def _check_liveness(self):
+        """Convert a silently-dead peer (no message within the liveness
+        window) into a coordinated abort instead of an indefinite wait."""
+        if self._liveness <= 0 or self._abort is not None:
+            return
+        now = time.monotonic()
+        with self._cv:
+            dead = sorted(r for r, ts in self._last_seen.items()
+                          if now - ts > self._liveness
+                          and r not in self._joined)
+        if dead:
+            self._initiate_abort(
+                dead[0],
+                f"rank {dead[0]} sent no heartbeat for more than "
+                f"{self._liveness:g}s (presumed dead)")
 
     def _ready(self, entry):
         """Ready once every live (non-joined) rank has contributed — a
@@ -204,6 +276,8 @@ class CoordinatorService(network.MuxService):
 
     def _handle_collective(self, req):
         with self._cv:
+            if self._abort is not None:
+                return self._abort_result()
             entry = self._forming.get(req.name)
             if entry is None:
                 entry = _Entry(req.req_type)
@@ -222,6 +296,14 @@ class CoordinatorService(network.MuxService):
         deadline = (time.monotonic() + self._stall_shutdown
                     if self._stall_shutdown > 0 else None)
         while not entry.done.wait(timeout=1.0):
+            if self._abort is not None:
+                # abort raced entry creation: take the typed result (and
+                # drop the orphaned entry so it can't pin the join
+                # barrier)
+                with self._cv:
+                    if self._forming.get(req.name) is entry:
+                        del self._forming[req.name]
+                return self._abort_result()
             age = time.monotonic() - entry.first_ts
             if age > self._stall_warning and not entry.stall_warned:
                 with self._cv:
@@ -237,19 +319,23 @@ class CoordinatorService(network.MuxService):
                     "for more than %ds", req.name, ready, missing,
                     int(self._stall_warning))
             if deadline is not None and time.monotonic() > deadline:
-                # fail EVERY waiter and clear the entry: a poisoned name
-                # must not block the join barrier or reject resubmissions
-                # forever (reference: stall shutdown fails all pending)
-                message = (f"stalled tensor '{req.name}' exceeded shutdown "
-                           f"threshold of {self._stall_shutdown}s")
+                # stall shutdown, promoted into a coordinated abort: the
+                # first missing rank is the culprit, EVERY rank (not just
+                # this entry's waiters) raises the same typed error, and
+                # ring state everywhere is purged via the abort broadcast
                 with self._cv:
-                    if self._forming.get(req.name) is entry:
-                        del self._forming[req.name]
-                        entry.results = {r: ResultMsg(error=message)
-                                         for r in entry.requests}
-                        entry.done.set()
-                        self._check_join_barrier()
+                    missing = [r for r in range(self._size)
+                               if r not in entry.requests
+                               and r not in self._joined]
+                origin = missing[0] if missing else req.rank
+                self._initiate_abort(
+                    origin,
+                    f"stalled tensor '{req.name}' exceeded shutdown "
+                    f"threshold of {self._stall_shutdown}s (waiting on "
+                    f"ranks {missing})")
                 break
+        if self._abort is not None and req.rank not in entry.results:
+            return self._abort_result()
         return entry.results.get(req.rank,
                                  ResultMsg(error="internal: no result"))
 
@@ -257,6 +343,8 @@ class CoordinatorService(network.MuxService):
         event = threading.Event()
         slot = [None]
         with self._cv:
+            if self._abort is not None:
+                return JoinDoneMsg(None, abort=self._abort)
             self._joined.add(req.rank)
             self._join_waiters.append((req.rank, event, slot))
             # a rank joining may complete entries now only missing it
@@ -265,6 +353,8 @@ class CoordinatorService(network.MuxService):
                     self._complete(name, entry)
             self._check_join_barrier()
         event.wait()
+        if slot[0] is None and self._abort is not None:
+            return JoinDoneMsg(None, abort=self._abort)
         return JoinDoneMsg(slot[0])
 
     def _check_join_barrier(self):
@@ -561,6 +651,11 @@ class TcpController:
         self._autotune = None       # rank 0 only
         self._tuned = None          # last applied (seq, params)
         self._tuned_lock = threading.Lock()
+        self._abort_state = None    # (origin_rank, reason), sticky
+        self._abort_lock = threading.Lock()
+        self._inflight = {}         # id(handle) -> handle (abort fan-out)
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
         self._log = get_logger()
 
     # -------------------------------------------------------------- lifecycle
@@ -586,7 +681,8 @@ class TcpController:
                 stall_warning_sec=self._config.stall_warning_seconds,
                 stall_shutdown_sec=self._config.stall_shutdown_seconds,
                 cache_capacity=self._config.cache_capacity,
-                autotune=self._autotune)
+                autotune=self._autotune,
+                liveness_timeout_sec=self._config.liveness_timeout_seconds)
             tagged = [(iface, ip, self._coordinator.port)
                       for iface, ip in network.local_interfaces().items()]
             tagged.append(("lo", "127.0.0.1", self._coordinator.port))
@@ -616,6 +712,10 @@ class TcpController:
 
         # peer mailbox for the ring data plane
         self._peer_service = PeerService(self._key)
+        # a peer-pushed abort must fail negotiation-blocked handles too,
+        # not only blocked ring recvs (no re-fan-out: the pusher
+        # already reached every peer)
+        self._peer_service.abort_callback = self._on_peer_abort
         if addr is not None:
             from horovod_tpu.run import http_client
             tagged = [(iface, ip, self._peer_service.port)
@@ -627,22 +727,55 @@ class TcpController:
             self._ring = RingPlane(self._rank, self._peer_service,
                                    self._resolve_peer)
 
-    def _resolve_peer(self, rank):
+        # peer liveness: a background heartbeat per worker keeps the
+        # coordinator's last-seen table fresh AND carries the abort
+        # state back, so a rank blocked on ring chunks (never touching
+        # the control plane) still observes a coordinated abort within
+        # one heartbeat interval
+        from horovod_tpu.common.config import effective_heartbeat_interval
+        interval = effective_heartbeat_interval(self._config)
+        if self._size > 1 and interval > 0:
+            # one synchronous beat before init returns: the coordinator
+            # knows this rank exists BEFORE any user collective can run,
+            # so a crash at ANY later point falls inside the liveness
+            # window.  Failing this beat is fatal — a silently-skipped
+            # registration would leave this rank invisible to liveness
+            # (the monitor only watches ranks it has seen), reopening
+            # the unbounded-hang window for the peers.  The mux client's
+            # own connect retry already absorbed transient blips.
+            try:
+                self._client().send(network.HeartbeatMsg(self._rank),
+                                    timeout=30.0)
+            except Exception as exc:
+                raise RuntimeError(
+                    f"rank {self._rank} could not register with the "
+                    f"coordinator at startup: {exc}") from exc
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, args=(interval,),
+                daemon=True, name="hvd-heartbeat")
+            self._hb_thread.start()
+
+    def _peer_addrs(self, rank, resolve_timeout, retry_for=None):
         from horovod_tpu.run import http_client
 
         addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
         port = os.environ.get(env_util.HVD_RENDEZVOUS_PORT)
-        blob = http_client.get(
-            addr, int(port), PEERS_SCOPE, str(rank),
-            timeout=env_util.get_float(
-                env_util.HVD_START_TIMEOUT, 120.0)).decode()
+        kwargs = {} if retry_for is None else {"retry_for": retry_for}
+        blob = http_client.get(addr, int(port), PEERS_SCOPE, str(rank),
+                               timeout=resolve_timeout,
+                               **kwargs).decode()
         tagged = []
         for part in blob.split(";"):
             iface, rest = part.split("=", 1)
             ip, p = rest.rsplit(":", 1)
             tagged.append((iface, ip, int(p)))
-        return network.MuxClient(self._filter_ifaces(tagged), self._key,
-                                 timeout=30)
+        return self._filter_ifaces(tagged)
+
+    def _resolve_peer(self, rank):
+        return network.MuxClient(
+            self._peer_addrs(rank, env_util.get_float(
+                env_util.HVD_START_TIMEOUT, 120.0)),
+            self._key, timeout=30)
 
     @staticmethod
     def _filter_ifaces(tagged):
@@ -674,8 +807,156 @@ class TcpController:
         threading.Thread(target=target, args=args, daemon=True,
                          name="hvd-tcp-req").start()
 
+    # ------------------------------------------------------- fault tolerance
+    def _heartbeat_loop(self, interval):
+        # a DEDICATED no-retry client: the shared mux's connect retry
+        # (HVD_TPU_CONNECT_RETRY_SECONDS per attempt) would stretch the
+        # dead-coordinator budget below to a multiple of itself, and a
+        # failed heartbeat must be cheap to observe
+        hb_client = network.MuxClient(self._client_addrs, self._key,
+                                      timeout=max(interval, 2.0),
+                                      retry_for=0)
+        fail_since = None
+        try:
+            while True:
+                try:
+                    reply = hb_client.send(
+                        network.HeartbeatMsg(self._rank),
+                        timeout=max(interval * 2, 5.0))
+                except Exception as exc:  # noqa: BLE001 — outage
+                    now = time.monotonic()
+                    fail_since = (fail_since if fail_since is not None
+                                  else now)
+                    # the abort deadline, not the liveness window,
+                    # bounds how long this rank may spin against a dead
+                    # coordinator
+                    budget = (self._config.abort_timeout_seconds
+                              or self._config.liveness_timeout_seconds)
+                    if budget > 0 and now - fail_since > budget:
+                        # a dead coordinator must fail the job, not
+                        # hang it: self-abort naming the coordinator
+                        self._local_abort(
+                            0, f"coordinator unreachable for "
+                               f"{int(now - fail_since)}s: {exc}")
+                        return
+                else:
+                    fail_since = None
+                    ab = getattr(reply, "abort", None)
+                    if ab is not None:
+                        self._learned_abort(*ab)
+                        return
+                # first beat went out BEFORE the first wait: the
+                # coordinator learns this rank exists the moment init
+                # completes, so a rank that dies at any later point is
+                # inside the liveness window from its very first
+                # collective
+                if self._hb_stop.wait(timeout=interval):
+                    return
+        finally:
+            hb_client.close()
+
+    def _local_abort(self, origin_rank, reason, fan_out=True):
+        """Apply a coordinated abort on this worker: purge the ring
+        mailbox (waking every blocked ``recv`` with the typed error) and
+        fail all in-flight handles symmetrically.  ``fan_out=False``
+        when the abort ARRIVED as a peer push — the pushing rank already
+        reached everyone, and N ranks each re-pushing to N-1 peers would
+        be an O(N^2) storm of fresh rendezvous lookups mid-failure."""
+        with self._abort_lock:
+            if self._abort_state is not None:
+                return
+            self._abort_state = (origin_rank, reason)
+            inflight = list(self._inflight.values())
+            self._inflight.clear()
+        self._log.error("aborting collectives (origin rank %s): %s",
+                        origin_rank, reason)
+        # push to every peer mailbox BEFORE waking local waiters: a
+        # waiter's thread may exit the process (taking the coordinator
+        # with it on rank 0) the moment it observes the error, and the
+        # peers must have heard by then — heartbeats remain the backstop
+        # for peers the push cannot reach
+        if fan_out:
+            self._push_abort_to_peers(origin_rank, reason)
+        if self._peer_service is not None:
+            self._peer_service.abort(origin_rank, reason)
+        exc = HvdAbortedError(origin_rank, reason)
+        for handle in inflight:
+            handle.set_error(exc)
+
+    def _on_peer_abort(self, origin_rank, reason):
+        """PeerService push receipt: apply locally, no re-fan-out."""
+        self._local_abort(origin_rank, reason, fan_out=False)
+
+    def _learned_abort(self, origin_rank, reason):
+        """Abort learned from a live coordinator (heartbeat reply,
+        negotiation/join response).  Only rank 0 re-pushes to peers: its
+        process HOSTS the coordinator, so its exit would cut the relay
+        before slower ranks hear — every other rank can rely on its own
+        heartbeat, keeping the fan-out O(N) instead of O(N^2)."""
+        self._local_abort(origin_rank, reason,
+                          fan_out=(self._rank == 0))
+
+    def _push_abort_to_peers(self, origin_rank, reason, budget=2.0):
+        """Best-effort direct abort fan-out to every peer's mailbox
+        service (bounded: dead peers refuse the connect instantly,
+        unreachable ones are cut off by the join budget).  Reuses the
+        ring's live peer connections where they exist; otherwise one
+        short-budget resolve + connect per peer."""
+        if self._ring is None:
+            return
+
+        def push_one(rank):
+            try:
+                cached = self._ring.cached_peer(rank)
+                if cached is not None:
+                    cached.post(network.AbortMsg(origin_rank, reason))
+                    return
+                client = network.MuxClient(
+                    self._peer_addrs(rank, resolve_timeout=2.0,
+                                     retry_for=0),
+                    self._key, timeout=2, retry_for=0)
+                try:
+                    client.post(network.AbortMsg(origin_rank, reason))
+                finally:
+                    client.close()
+            except Exception:  # noqa: BLE001 — heartbeat backstop
+                pass
+
+        threads = [threading.Thread(target=push_one, args=(r,),
+                                    daemon=True, name="hvd-abort-push")
+                   for r in range(self._size) if r != self._rank]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + budget
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+    def _report_abort(self, origin_rank, reason):
+        """Broadcast an abort: best-effort notify the coordinator (which
+        relays it to every rank via heartbeat replies and negotiation
+        responses), then apply it locally."""
+        try:
+            self._client().send(network.AbortMsg(origin_rank, reason),
+                                timeout=5.0)
+        except Exception:  # noqa: BLE001 — local abort still proceeds
+            pass
+        self._local_abort(origin_rank, reason)
+
+    def abort(self, origin_rank, reason):
+        """Any rank may broadcast an abort for the in-flight round
+        (``hvd.abort()``); all ranks raise ``HvdAbortedError`` within
+        the abort deadline."""
+        self._report_abort(origin_rank, reason)
+
     # ------------------------------------------------------------ producer API
     def enqueue(self, request):
+        with self._abort_lock:
+            ab = self._abort_state
+            if ab is None:
+                self._inflight[id(request.handle)] = request.handle
+        if ab is not None:
+            request.handle.set_error(HvdAbortedError(*ab))
+            return
         self._spawn(self._run_one, request)
 
     def _use_ring(self, req_type, nbytes):
@@ -698,10 +979,17 @@ class TcpController:
                               RequestType.BROADCAST))
 
     def _run_one(self, request, force_payload=False):
+        dropped = False
         try:
             arr = np.asarray(request.tensor)
             arr, wire_dtype = _wire_dtype(arr)
             rtype = RequestType(request.req_type)
+            if not force_payload and faults.check(rtype.name.lower()):
+                # injected drop: this rank silently never contributes —
+                # the handle is failed by the eventual stall/liveness
+                # abort (it stays registered in _inflight)
+                dropped = True
+                return
             ring = (not force_payload
                     and self._use_ring(request.req_type, arr.nbytes))
             msg = CollectiveMsg(
@@ -717,9 +1005,27 @@ class TcpController:
             msg.sig = _signature(msg)
             self._timeline.begin(request.name,
                                  f"NEGOTIATE_{rtype.name}")
-            resp = self._client().send(msg)
+            try:
+                resp = self._client().send(msg)
+            except (ConnectionError, TimeoutError, OSError) as exc:
+                # the control plane is gone (mux retry budget spent):
+                # surface the SAME typed, symmetric error as the
+                # heartbeat self-abort, not a one-off transport string
+                self._local_abort(
+                    0, f"coordinator unreachable during negotiation of "
+                       f"'{request.name}': {exc}")
+                request.handle.set_error(
+                    HvdAbortedError(*self._abort_state))
+                return
             self._timeline.end(request.name)
             self._maybe_apply_params(resp)
+            ab = getattr(resp, "aborted", None)
+            if ab is not None:
+                # coordinated abort: fail EVERY in-flight handle (this
+                # one included) with the one typed error + purge rings
+                self._learned_abort(*ab)
+                request.handle.set_error(HvdAbortedError(*ab))
+                return
             if resp.error is not None:
                 request.handle.set_error(resp.error)
                 return
@@ -729,6 +1035,14 @@ class TcpController:
                 self._run_one(request, force_payload=True)
                 return
             if resp.ring_go:
+                # "ring" fires AFTER negotiation: crash models a rank
+                # dying mid-collective with peers already committed;
+                # drop models a rank silently abandoning the round (its
+                # handle stays registered for the eventual abort, and
+                # the peers' recv backstop converts the silence)
+                if faults.check("ring"):
+                    dropped = True
+                    return
                 out = self._run_ring(rtype, request, arr, resp)
             else:
                 self._timeline.begin(request.name, rtype.name)
@@ -750,14 +1064,30 @@ class TcpController:
                 request.handle.set_result((result, resp.recv_splits))
             else:
                 request.handle.set_result(result)
+        except HvdError as exc:  # typed (e.g. HvdAbortedError): keep it
+            request.handle.set_error(exc)
         except Exception as exc:  # noqa: BLE001 — surface on the handle
             request.handle.set_error(str(exc))
+        finally:
+            if not dropped:
+                with self._abort_lock:
+                    self._inflight.pop(id(request.handle), None)
 
     def _run_ring(self, rtype, request, arr, resp):
         """Execute the worker-ring data plane after the coordinator's
         metadata go-ahead."""
         self._timeline.begin(request.name, f"RING_{rtype.name}")
-        timeout = (self._config.stall_shutdown_seconds or None)
+        # every ring recv is time-bounded even with the stall shutdown
+        # off: post-negotiation all participants are committed, so a
+        # chunk that never arrives (silently dropped on the wire, sender
+        # wedged but still heartbeating) is a failure to detect — the
+        # timeout converts it into a coordinated abort below instead of
+        # an indefinite wait.  4x the abort deadline leaves generous
+        # room for a slow multi-hundred-MB ring step.
+        timeout = (self._config.stall_shutdown_seconds
+                   or (self._config.abort_timeout_seconds * 4
+                       if self._config.abort_timeout_seconds > 0
+                       else None))
         try:
             if rtype == RequestType.ALLREDUCE:
                 out = self._ring.allreduce(
@@ -785,12 +1115,24 @@ class TcpController:
                     b, dtype=arr.dtype).reshape((d,) + trailing)
                     for b, d in zip(blocks, resp.dims0)]
                 out = np.concatenate(parts, axis=0)
-        except BaseException:
+        except HvdAbortedError:
+            # already a coordinated abort (the peer mailbox was purged
+            # wholesale when it was applied) — just propagate the type
+            raise
+        except BaseException as exc:
             # drop any chunks of the aborted round so nothing lingers
-            # (a retry gets a fresh ring_id and can never match them)
+            # (a retry gets a fresh ring_id and can never match them) …
             if self._peer_service is not None:
                 self._peer_service.purge(resp.ring_id)
-            raise
+            # … then turn the local failure (recv timeout, codec error,
+            # dead neighbor) into a coordinated abort: the OTHER ranks of
+            # this round are blocked on chunks this rank will never send,
+            # and without the broadcast they would hang or time out
+            # asymmetrically with leaked mailbox state
+            reason = (f"ring {rtype.name.lower()} '{request.name}' failed "
+                      f"on rank {self._rank}: {exc}")
+            self._report_abort(self._rank, reason)
+            raise HvdAbortedError(self._rank, reason) from exc
         finally:
             self._timeline.end(request.name, {"bytes": arr.nbytes})
         return out
@@ -799,10 +1141,25 @@ class TcpController:
         def run():
             try:
                 resp = self._client().send(JoinMsg(rank))
+                ab = getattr(resp, "abort", None)
+                if ab is not None:
+                    self._learned_abort(*ab)
+                    handle.set_error(HvdAbortedError(*ab))
+                    return
                 handle.set_result(resp.last_rank)
             except Exception as exc:  # noqa: BLE001
                 handle.set_error(str(exc))
+            finally:
+                with self._abort_lock:
+                    self._inflight.pop(id(handle), None)
 
+        with self._abort_lock:
+            ab = self._abort_state
+            if ab is None:
+                self._inflight[id(handle)] = handle
+        if ab is not None:
+            handle.set_error(HvdAbortedError(*ab))
+            return
         self._spawn(run)
 
     # -------------------------------------------------------------- autotune
@@ -845,6 +1202,16 @@ class TcpController:
         return default_params(self._config)
 
     def shutdown(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+        if self._size > 1 and self._mux is not None \
+                and self._abort_state is None:
+            try:  # deregister from liveness (best-effort)
+                self._mux.send(ShutdownMsg(self._rank), timeout=5.0)
+            except Exception:  # noqa: BLE001 — coordinator may be gone
+                pass
         self._merge_timelines()
         if self._mux is not None:
             self._mux.close()

@@ -29,6 +29,8 @@ import threading
 
 import numpy as np
 
+from horovod_tpu.common import faults
+from horovod_tpu.common.handles import HvdAbortedError
 from horovod_tpu.common.ops_enum import INT8_BLOCK
 from horovod_tpu.run.service import network
 
@@ -122,23 +124,39 @@ class PeerService(network.MuxService):
     NAME = "horovod_tpu peer"
 
     # purged ring ids remembered so late-arriving chunks of aborted
-    # rounds are dropped instead of leaking in the mailbox forever
+    # rounds are dropped instead of leaking in the mailbox forever.
+    # Bounded LRU: re-purging a hot id refreshes its slot instead of
+    # evicting a different recent id, and total memory is O(KEEP)
+    # however long the job runs.
     _PURGED_KEEP = 256
 
     def __init__(self, key):
         self._cv = threading.Condition()
         self._mailbox = {}   # (tag, src) -> payload
-        self._purged = collections.deque(maxlen=self._PURGED_KEEP)
-        self._purged_set = set()  # O(1) membership for the hot path
+        self._purged = collections.OrderedDict()  # ring_id -> None (LRU)
+        self._aborted = None  # (origin_rank, reason) once abort observed
+        # set by the controller: called (origin, reason) when a PEER
+        # pushes an abort here, so in-flight negotiation handles fail
+        # too, not just blocked ring recvs
+        self.abort_callback = None
         super().__init__(self.NAME, key)
 
     def _handle(self, req, client_address):
         if isinstance(req, ChunkMsg):
             with self._cv:
-                if req.tag[0] in self._purged_set:
+                if self._aborted is not None \
+                        or req.tag[0] in self._purged:
                     return network.AckResponse()  # aborted round, drop
                 self._mailbox[(req.tag, req.src)] = req.payload
                 self._cv.notify_all()
+            return network.AckResponse()
+        if isinstance(req, network.AbortMsg):
+            # direct peer-to-peer abort fan-out: delivery does not
+            # depend on the coordinator (or its host process) surviving
+            self.abort(req.origin_rank, req.reason)
+            callback = self.abort_callback
+            if callback is not None:
+                callback(req.origin_rank, req.reason)
             return network.AckResponse()
         return super()._handle(req, client_address)
 
@@ -148,6 +166,8 @@ class PeerService(network.MuxService):
         deadline = (_time.monotonic() + timeout) if timeout else None
         with self._cv:
             while (tag, src) not in self._mailbox:
+                if self._aborted is not None:
+                    raise HvdAbortedError(*self._aborted)
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - _time.monotonic()
@@ -163,12 +183,23 @@ class PeerService(network.MuxService):
         the coordinator-assigned ring id, so a retry — which gets a NEW
         id — can never consume stale data)."""
         with self._cv:
-            if len(self._purged) == self._purged.maxlen:
-                self._purged_set.discard(self._purged[0])
-            self._purged.append(ring_id)
-            self._purged_set.add(ring_id)
+            self._purged[ring_id] = None
+            self._purged.move_to_end(ring_id)
+            while len(self._purged) > self._PURGED_KEEP:
+                self._purged.popitem(last=False)
             for key in [k for k in self._mailbox if k[0][0] == ring_id]:
                 del self._mailbox[key]
+
+    def abort(self, origin_rank, reason):
+        """Coordinated abort observed: fail every blocked ``recv`` with
+        the typed error, drop all buffered chunks and refuse new ones —
+        no mailbox state survives the abort (sticky; the job is over)."""
+        with self._cv:
+            if self._aborted is not None:
+                return
+            self._aborted = (origin_rank, reason)
+            self._mailbox.clear()
+            self._cv.notify_all()
 
 
 class RingPlane:
@@ -189,13 +220,25 @@ class RingPlane:
                 client = self._clients[rank] = self._resolve(rank)
             return client
 
+    def cached_peer(self, rank):
+        """The already-connected client for ``rank``, or None — the
+        abort fan-out prefers live connections over re-resolving peers
+        through the rendezvous mid-failure."""
+        with self._lock:
+            return self._clients.get(rank)
+
     def send(self, dst, tag, payload: bytes):
         # fire-and-forget: the mailbox is tag-keyed, so ordering doesn't
         # need acks, and ring steps stay bandwidth-bound (no ack RTT on
         # the critical path)
+        if faults.check("send"):
+            return  # injected drop: the chunk vanishes on the wire
         self._peer(dst).post(ChunkMsg(tag, self.rank, payload))
 
     def recv(self, tag, src, timeout=None) -> bytes:
+        if faults.check("recv"):
+            raise TimeoutError(
+                f"no chunk {tag!r} from rank {src} (injected recv fault)")
         return self._service.recv(tag, src, timeout=timeout)
 
     def close(self):

@@ -32,6 +32,13 @@ _PARAMS = {
     "controller": (env_util.HVD_CONTROLLER, "params.controller"),
     "start_timeout": (env_util.HVD_START_TIMEOUT, "timeouts.start_timeout"),
     "network_interface": (env_util.HVD_IFACE, "network.interface"),
+    "abort_timeout": (env_util.HVD_TPU_ABORT_TIMEOUT,
+                      "fault_tolerance.abort_timeout"),
+    "heartbeat_interval": (env_util.HVD_TPU_HEARTBEAT_INTERVAL,
+                           "fault_tolerance.heartbeat_interval"),
+    "liveness_timeout": (env_util.HVD_TPU_LIVENESS_TIMEOUT,
+                         "fault_tolerance.liveness_timeout"),
+    "fault_spec": (env_util.HVD_TPU_FAULT_SPEC, "fault_tolerance.spec"),
 }
 
 # negation flags -> env var forced to "0" (reference: --no-autotune etc.)

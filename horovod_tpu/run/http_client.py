@@ -1,46 +1,80 @@
-"""Rendezvous KV client (reference: ``horovod/run/http/http_client.py``)."""
+"""Rendezvous KV client (reference: ``horovod/run/http/http_client.py``).
+
+Every verb rides the same bounded transient-failure retry with
+exponential backoff + jitter: a single TCP blip (driver briefly
+saturated, RST mid-handshake) must not lose a worker's result after
+hours of training, and the jitter keeps N ranks that hit the same blip
+from re-knocking in lockstep (a thundering herd the fixed-interval
+retry used to produce).
+"""
 
 import time
 import urllib.error
 import urllib.request
 
+DEFAULT_RETRY_FOR = 30.0
 
-def put(addr, port, scope, key, value: bytes, retry_for=30.0):
-    """PUT with a bounded transient-failure retry: a single TCP blip
-    must not lose a worker's result after hours of training."""
+
+def _backoff_delay(attempt):
+    # one jitter policy for the whole transport layer
+    from horovod_tpu.run.service.network import backoff_delay
+
+    return backoff_delay(attempt, cap=1.0)
+
+
+def request(method, addr, port, scope, key, data=None,
+            retry_for=DEFAULT_RETRY_FOR) -> bytes:
+    """One KV request with bounded transient-failure retry (any verb).
+
+    HTTP errors are NOT retried — the server spoke, so the failure is
+    semantic (404 missing key, 400 bad path) and the caller owns it.
+    """
+    url = f"http://{addr}:{port}/{scope}/{key}"
     deadline = time.monotonic() + retry_for
+    attempt = 0
     while True:
-        req = urllib.request.Request(
-            f"http://{addr}:{port}/{scope}/{key}", data=value,
-            method="PUT")
+        req = urllib.request.Request(url, data=data, method=method)
         try:
-            with urllib.request.urlopen(req, timeout=30):
-                return
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.read()
+        except urllib.error.HTTPError:
+            raise
         except (urllib.error.URLError, OSError):
-            if time.monotonic() > deadline:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise
-            time.sleep(0.25)
+            time.sleep(min(_backoff_delay(attempt), max(remaining, 0.0)))
+            attempt += 1
 
 
-def get(addr, port, scope, key, timeout=None):
-    """GET; if ``timeout`` is set, poll until the key appears."""
+def put(addr, port, scope, key, value: bytes, retry_for=DEFAULT_RETRY_FOR):
+    request("PUT", addr, port, scope, key, data=value, retry_for=retry_for)
+
+
+def delete(addr, port, scope, key, retry_for=DEFAULT_RETRY_FOR):
+    request("DELETE", addr, port, scope, key, retry_for=retry_for)
+
+
+def get(addr, port, scope, key, timeout=None, retry_for=DEFAULT_RETRY_FOR):
+    """GET; if ``timeout`` is set, poll until the key appears.
+
+    Two independent budgets: ``retry_for`` bounds transport-blip
+    retries inside each attempt, ``timeout`` bounds the 404 wait for a
+    key another rank has not published yet.
+    """
     deadline = None if timeout is None else time.monotonic() + timeout
     while True:
+        # clip the transport-retry budget to the caller's deadline: a
+        # poll bounded by HVD_START_TIMEOUT must not overshoot it just
+        # because the server is unreachable rather than missing the key
+        budget = retry_for if deadline is None else max(
+            0.0, min(retry_for, deadline - time.monotonic()))
         try:
-            with urllib.request.urlopen(
-                    f"http://{addr}:{port}/{scope}/{key}",
-                    timeout=30) as resp:
-                return resp.read()
+            return request("GET", addr, port, scope, key,
+                           retry_for=budget)
         except urllib.error.HTTPError as exc:
             if exc.code != 404:
                 raise
             if deadline is None or time.monotonic() > deadline:
                 raise KeyError(f"{scope}/{key} not found in rendezvous")
             time.sleep(0.05)
-        except (urllib.error.URLError, OSError):
-            # transient transport blip (driver briefly saturated, TCP
-            # RST): retry within the budget instead of crashing the
-            # worker — a spurious crash tears down the whole job
-            if deadline is None or time.monotonic() > deadline:
-                raise
-            time.sleep(0.25)

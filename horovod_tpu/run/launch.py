@@ -8,12 +8,25 @@ other rank.
 
 import os
 import shlex
+import signal as signal_mod
 import sys
 import threading
 
 from horovod_tpu.run import safe_shell_exec
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils.logging import get_logger
+
+
+def describe_exit(code) -> str:
+    """Human-readable exit status: negative Popen codes are signal
+    deaths and deserve the signal's name, not a bare '-9'."""
+    if code < 0:
+        try:
+            name = signal_mod.Signals(-code).name
+        except ValueError:
+            name = f"signal {-code}"
+        return f"killed by {name}"
+    return f"exit code {code}"
 
 LOCAL_HOSTS = ("localhost", "127.0.0.1")
 
@@ -93,15 +106,17 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
                extra_env=None, ssh_port=None, verbose=False,
                output_filename=None) -> int:
     """Launch one process per slot; kill everything on first failure.
-    Returns the FIRST failure's exit code (or 0) — after the
-    kill-on-first-failure fan-out, later ranks die with signal codes
-    (-15) that would mask the real error if rank order decided."""
+    Returns the CULPRIT's exit code (or 0): the first rank that failed
+    on its own — ranks the kill-on-first-failure fan-out subsequently
+    terminated report as victims (they die with signal codes like -15
+    that would mask the real error if arrival order decided)."""
     log = get_logger()
     failure = threading.Event()
-    first_failure = []  # [(rank, code)] — append under the lock, once
-    first_failure_lock = threading.Lock()
+    failures = []  # [(rank, code, was_victim)] in arrival order
+    failures_lock = threading.Lock()
 
     def run_rank(slot):
+        info = {}
         try:
             env = slot_env(slot, rendezvous_addr, rendezvous_port,
                            extra_env)
@@ -136,7 +151,7 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
                     stderr = _Tee(err_f, sys.stderr)
                 code = safe_shell_exec.execute(
                     cmd, env=full_env, stdout=stdout, stderr=stderr,
-                    events=[failure], stdin_data=stdin_data)
+                    events=[failure], stdin_data=stdin_data, info=info)
             finally:
                 for f in (out_f, err_f):
                     if f is not None:
@@ -147,9 +162,9 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
             log.error("launching rank %d failed: %s", slot.rank, exc)
             code = 1
         if code != 0:
-            with first_failure_lock:
-                if not first_failure:
-                    first_failure.append((slot.rank, code))
+            with failures_lock:
+                failures.append((slot.rank, code,
+                                 info.get("terminated_by_event", False)))
             failure.set()
 
     threads = [threading.Thread(target=run_rank, args=(s,), daemon=True)
@@ -170,9 +185,22 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
             t.join(timeout=15)
         raise
 
-    if first_failure:
-        rank, code = first_failure[0]
-        log.error("rank %d failed first with exit code %d "
-                  "(other ranks were terminated)", rank, code)
+    if failures:
+        # name the culprit: the first rank that failed on its OWN, not a
+        # victim the fan-out terminated.  (A victim that lost the report
+        # race can no longer steal the blame — its -15 masked the real
+        # error before.)  Known residual: a survivor that exits nonzero
+        # BECAUSE of a coordinated abort (HvdAbortedError) fails "on its
+        # own" from the launcher's viewpoint; it exits causally after
+        # the true culprit, so arrival order almost always ranks it
+        # second, but a ms-scale inversion is possible — the worker's
+        # own stderr (origin rank in the abort message) stays
+        # authoritative.  All-victims is a launcher interrupt edge
+        # case: fall back to arrival order.
+        culprits = [(r, c) for r, c, victim in failures if not victim]
+        rank, code = culprits[0] if culprits else failures[0][:2]
+        log.error("rank %d failed first (%s); %d other rank(s) were "
+                  "terminated", rank, describe_exit(code),
+                  len(failures) - 1)
         return code
     return 0

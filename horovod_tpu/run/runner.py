@@ -131,6 +131,28 @@ def make_parser():
     timeline.add_argument("--timeline-mark-cycles", action="store_true",
                           default=None)
 
+    fault = parser.add_argument_group("fault tolerance")
+    fault.add_argument("--abort-timeout", type=float, default=None,
+                       help="Bound (seconds) on 'abort initiated -> "
+                            "every rank raises HvdAbortedError' "
+                            "(HVD_TPU_ABORT_TIMEOUT; see "
+                            "docs/fault_tolerance.md).")
+    fault.add_argument("--heartbeat-interval", type=float, default=None,
+                       help="Peer/coordinator heartbeat period in "
+                            "seconds (HVD_TPU_HEARTBEAT_INTERVAL).")
+    fault.add_argument("--liveness-timeout", type=float, default=None,
+                       help="Missed-heartbeat window in seconds before a "
+                            "silent rank is declared dead and the round "
+                            "is aborted (HVD_TPU_LIVENESS_TIMEOUT; 0 "
+                            "disables).")
+    fault.add_argument("--fault-spec", default=None,
+                       help="Deterministic fault injection spec "
+                            "(HVD_TPU_FAULT_SPEC), e.g. "
+                            "'rank1:allreduce:2:crash'; see "
+                            "docs/fault_tolerance.md for the grammar. "
+                            "bin/hvd-chaos generates seeded random "
+                            "specs for soak runs.")
+
     stall = parser.add_argument_group("stall check")
     stall.add_argument("--no-stall-check", action="store_true", default=None)
     stall.add_argument("--stall-check", action="store_true", default=None,
@@ -309,11 +331,15 @@ def run_commandline(argv=None) -> int:
     import shlex
     command = " ".join(shlex.quote(c) for c in args.command)
     try:
-        return launch_job(slots, command, addr, port, extra_env=extra_env,
+        code = launch_job(slots, command, addr, port, extra_env=extra_env,
                           ssh_port=args.ssh_port, verbose=args.verbose,
                           output_filename=args.output_filename)
     finally:
         rendezvous.stop()
+    # a signal death surfaces as Popen's negative code; exit statuses
+    # are unsigned, so report it in the shell's 128+signum convention
+    # instead of the truncated-to-255 garbage sys.exit(-15) produces
+    return 128 - code if code < 0 else code
 
 
 def _delegate_launch(args, slots, extra_env):

@@ -40,7 +40,7 @@ def terminate_process_group(proc):
 
 
 def execute(command, env=None, stdout=None, stderr=None,
-            events=None, stdin_data=None) -> int:
+            events=None, stdin_data=None, info=None) -> int:
     """Run ``command`` (shell string or argv list) in a new process group.
 
     ``events``: optional list of ``threading.Event``; if any fires, the
@@ -49,6 +49,11 @@ def execute(command, env=None, stdout=None, stderr=None,
     ``stdin_data``: bytes written to the child's stdin then closed (used to
     ship the job secret to ssh-launched ranks without putting it on the
     remote command line).
+    ``info``: optional dict; ``info["terminated_by_event"]`` is set True
+    when the tree was killed by a fired event while still running — the
+    launcher uses it to tell the CULPRIT rank (failed on its own) from
+    the VICTIMS it subsequently terminated, so the job's reported
+    failure names the rank that actually broke.
     Returns the exit code.
     """
 
@@ -83,6 +88,8 @@ def execute(command, env=None, stdout=None, stderr=None,
         def watch(event=event):
             while not stop_watch.is_set():
                 if event.wait(timeout=0.1):
+                    if info is not None and proc.poll() is None:
+                        info["terminated_by_event"] = True
                     terminate_process_group(proc)
                     return
         t = threading.Thread(target=watch, daemon=True)

@@ -112,20 +112,25 @@ class DriverService(network.BasicService):
 
 
 class DriverClient(network.BasicClient):
-    def __init__(self, driver_addresses, key, timeout=10):
-        super().__init__(driver_addresses, key, timeout=timeout)
+    """Every driver request is idempotent (registrations overwrite the
+    same value, the rest are reads), so the transport may replay them in
+    full after a mid-request failure — rendezvous survives transient
+    RSTs instead of killing the worker."""
 
     def register_task(self, index, task_addresses, host_hash=None):
-        self.send(RegisterTaskRequest(index, task_addresses, host_hash))
+        self.send(RegisterTaskRequest(index, task_addresses, host_hash),
+                  idempotent=True)
 
     def all_task_addresses(self, index=-1):
-        return self.send(AllTaskAddressesRequest(index)).all_task_addresses
+        return self.send(AllTaskAddressesRequest(index),
+                         idempotent=True).all_task_addresses
 
     def register_task_to_task_addresses(self, index, reachable):
-        self.send(RegisterTaskToTaskAddressesRequest(index, reachable))
+        self.send(RegisterTaskToTaskAddressesRequest(index, reachable),
+                  idempotent=True)
 
     def wait_done(self):
-        return self.send(WaitDoneRequest()).done
+        return self.send(WaitDoneRequest(), idempotent=True).done
 
 
 def find_common_interfaces(driver, key, num_proc, timeout=60):

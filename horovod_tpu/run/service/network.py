@@ -17,13 +17,16 @@ stream in real time is outside this threat model — that requires TLS.)
 """
 
 import pickle
+import random
 import secrets as _secrets
 import socket
 import socketserver
 import struct
 import threading
+import time
 
 from horovod_tpu.run.service import secret
+from horovod_tpu.utils import env as env_util
 
 # Largest frame accepted before authentication.  Generous: the tcp star
 # data plane ships whole tensors (the bench sweep goes to 256 MB).
@@ -42,6 +45,59 @@ class PingResponse:
 
 class AckResponse:
     pass
+
+
+# Fault-tolerance control messages, shared by the tcp and global-mesh
+# coordinators (docs/fault_tolerance.md): any rank can broadcast an
+# abort for the in-flight round; heartbeats keep the coordinator's
+# last-seen table fresh and carry the abort state back.
+class AbortMsg:
+    def __init__(self, origin_rank, reason):
+        self.origin_rank = origin_rank
+        self.reason = reason
+
+
+class HeartbeatMsg:
+    def __init__(self, rank):
+        self.rank = rank
+
+
+class HeartbeatReply:
+    def __init__(self, abort=None):
+        self.abort = abort  # (origin_rank, reason) | None
+
+
+# ------------------------------------------------------- retry / backoff
+def backoff_delay(attempt, base=0.05, cap=2.0):
+    """Exponential backoff with jitter (50-100% of the exponential
+    step): simultaneous rank retries after a shared blip decorrelate
+    instead of synchronizing into a thundering herd."""
+    return min(cap, base * (1 << min(attempt, 16))) * \
+        (0.5 + random.random() * 0.5)
+
+
+def default_connect_retry():
+    return env_util.get_float(env_util.HVD_TPU_CONNECT_RETRY_SECONDS,
+                              env_util.DEFAULT_CONNECT_RETRY_SECONDS)
+
+
+def connect(addr, timeout):
+    """All control/data-plane TCP connects funnel through here: one
+    fault-injection point ("connect") covers rendezvous, negotiation and
+    the ring transport.  A "drop" at this point is a dropped SYN, which
+    the caller can only observe as a failed connect — same surface as
+    "refuse"."""
+    from horovod_tpu.common import faults
+
+    if faults.check("connect"):
+        raise ConnectionRefusedError(
+            "injected connection drop at connect (HVD_TPU_FAULT_SPEC)")
+    return socket.create_connection(addr, timeout=timeout)
+
+
+class _RetryableSendError(ConnectionError):
+    """Internal marker: the request may be safely retried in full
+    (nothing reached the service, or the request is idempotent)."""
 
 
 # ---------------------------------------------------------------- wire codec
@@ -163,12 +219,16 @@ class BasicClient:
     ``network.BasicClient``): tries each known (ip, port) until one
     answers, remembers the winner."""
 
-    def __init__(self, addresses, key, timeout=10, read_timeout="same"):
+    def __init__(self, addresses, key, timeout=10, read_timeout="same",
+                 retry_for=None):
         # addresses: {iface: [(ip, port)]} or flat [(ip, port)].
         # ``timeout`` bounds connection establishment; ``read_timeout``
         # bounds the response wait (None = wait forever — collectives
         # legitimately block until every rank contributes, and the
-        # coordinator owns stall detection).
+        # coordinator owns stall detection).  ``retry_for`` is the
+        # deadline budget for connect-phase retries with backoff+jitter
+        # (None = HVD_TPU_CONNECT_RETRY_SECONDS; 0 = a single sweep) —
+        # one RST during rendezvous must not kill the job.
         if isinstance(addresses, dict):
             flat = [a for addrs in addresses.values() for a in addrs]
         else:
@@ -181,9 +241,11 @@ class BasicClient:
         self._timeout = timeout
         self._read_timeout = timeout if read_timeout == "same" \
             else read_timeout
+        self._retry_for = (default_connect_retry() if retry_for is None
+                           else retry_for)
 
     def _send_one(self, addr, req):
-        with socket.create_connection(addr, timeout=self._timeout) as sock:
+        with connect(addr, self._timeout) as sock:
             sock.settimeout(self._read_timeout)
             write_message(sock, self._key, req, "q")
             resp = read_message(sock, self._key, "r")
@@ -191,14 +253,33 @@ class BasicClient:
             raise resp
         return resp
 
-    def send(self, req):
+    def send(self, req, idempotent=False):
         """Address failover happens ONLY at the connect phase.  Once a
         request has been written, any error propagates — retransmitting a
         non-idempotent message (e.g. a collective contribution that is
         merely slow to complete) would hit the coordinator's
-        duplicate-request detection and fail the job.  A cached winner
-        whose CONNECT fails is safe to fail over from (nothing was
-        sent), so the other addresses are retried then."""
+        duplicate-request detection and fail the job.  ``idempotent=True``
+        (registrations, probes, polls) lifts that rule: the whole request
+        is retried under the deadline budget even after a post-write
+        failure.  A cached winner whose CONNECT fails is safe to fail
+        over from (nothing was sent), so the other addresses are retried
+        then; when every address refuses, the sweep repeats with
+        exponential backoff + jitter until the ``retry_for`` budget is
+        spent."""
+        deadline = time.monotonic() + self._retry_for
+        attempt = 0
+        while True:
+            try:
+                return self._send_sweep(req, idempotent)
+            except _RetryableSendError as exc:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ConnectionError(str(exc)) from exc
+                time.sleep(min(backoff_delay(attempt), max(remaining, 0.0)))
+                attempt += 1
+
+    def _send_sweep(self, req, idempotent):
+        """One pass over the candidate addresses."""
         candidates = list(self._addresses)
         if self._good is not None and self._good in candidates:
             candidates.remove(self._good)
@@ -206,7 +287,7 @@ class BasicClient:
         last_error = None
         for addr in candidates:
             try:
-                sock = socket.create_connection(addr, timeout=self._timeout)
+                sock = connect(addr, self._timeout)
             except OSError as exc:
                 last_error = exc
                 if addr == self._good:
@@ -217,13 +298,18 @@ class BasicClient:
                     sock.settimeout(self._read_timeout)
                     write_message(sock, self._key, req, "q")
                     resp = read_message(sock, self._key, "r")
-            except OSError:
+            except OSError as exc:
+                if idempotent:
+                    # safe to resend in full: surface as retryable
+                    raise _RetryableSendError(
+                        f"idempotent request to {addr} failed after "
+                        f"write: {exc}") from exc
                 raise  # sent — do NOT failover to another address
             self._good = addr
             if isinstance(resp, Exception):
                 raise resp
             return resp
-        raise ConnectionError(
+        raise _RetryableSendError(
             f"could not reach service at any of {self._addresses}: "
             f"{last_error}")
 
@@ -341,7 +427,7 @@ class MuxClient:
     """Client for :class:`MuxService`: ONE persistent socket, concurrent
     in-flight requests demultiplexed by id.  Thread-safe."""
 
-    def __init__(self, addresses, key, timeout=10):
+    def __init__(self, addresses, key, timeout=10, retry_for=None):
         if isinstance(addresses, dict):
             flat = [a for addrs in addresses.values() for a in addrs]
         else:
@@ -351,6 +437,8 @@ class MuxClient:
         self._addresses = flat
         self._key = key
         self._timeout = timeout
+        self._retry_for = (default_connect_retry() if retry_for is None
+                           else retry_for)
         self._sock = None
         self._send_lock = threading.Lock()
         self._state_lock = threading.Lock()
@@ -362,26 +450,36 @@ class MuxClient:
         self._broken = None
 
     def _connect_locked(self):
-        """Establish the socket + reader (caller holds _state_lock)."""
+        """Establish the socket + reader (caller holds _state_lock).
+        Sweeps the address list with exponential backoff + jitter under
+        the ``retry_for`` deadline budget: a refused/reset connection
+        during rendezvous or negotiation is retried, not fatal."""
+        deadline = time.monotonic() + self._retry_for
+        attempt = 0
         last_error = None
-        for addr in self._addresses:
-            try:
-                sock = socket.create_connection(addr,
-                                                timeout=self._timeout)
-                sock.settimeout(None)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._sock = sock
-                self._broken = None
-                self._reader = threading.Thread(
-                    target=self._read_loop, args=(sock,), daemon=True,
-                    name="mux-client-reader")
-                self._reader.start()
-                return
-            except OSError as exc:
-                last_error = exc
-        raise ConnectionError(
-            f"could not reach service at any of {self._addresses}: "
-            f"{last_error}")
+        while True:
+            for addr in self._addresses:
+                try:
+                    sock = connect(addr, self._timeout)
+                    sock.settimeout(None)
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    self._sock = sock
+                    self._broken = None
+                    self._reader = threading.Thread(
+                        target=self._read_loop, args=(sock,), daemon=True,
+                        name="mux-client-reader")
+                    self._reader.start()
+                    return
+                except OSError as exc:
+                    last_error = exc
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ConnectionError(
+                    f"could not reach service at any of "
+                    f"{self._addresses}: {last_error}")
+            time.sleep(min(backoff_delay(attempt), max(remaining, 0.0)))
+            attempt += 1
 
     def _ensure_connected_locked(self):
         """Returns the live socket (caller holds _state_lock).  The

@@ -52,7 +52,11 @@ class TaskService(network.BasicService):
 
     def _handle(self, req, client_address):
         if isinstance(req, ProbeAddressesRequest):
-            client = network.BasicClient(req.addresses, self._key, timeout=3)
+            # retry_for=0: a probe's whole job is to report unreachable
+            # addresses quickly — backing off and retrying would turn
+            # every dead NIC into a multi-sweep stall
+            client = network.BasicClient(req.addresses, self._key,
+                                         timeout=3, retry_for=0)
             good = set(client.probe())
             reachable = {
                 iface: [a for a in addrs if a in good]
@@ -87,14 +91,18 @@ class TaskService(network.BasicService):
 
 class TaskClient(network.BasicClient):
     def probe_addresses(self, addresses):
-        return self.send(ProbeAddressesRequest(addresses)).reachable
+        return self.send(ProbeAddressesRequest(addresses),
+                         idempotent=True).reachable
 
     def run_command(self, command, env=None):
+        # NOT idempotent: a replay would double-start the command and
+        # the service rejects concurrent runs — post-write failures
+        # must surface, never retry
         self.send(RunCommandRequest(command, env))
 
     def command_exit_code(self):
-        resp = self.send(CommandExitCodeRequest())
+        resp = self.send(CommandExitCodeRequest(), idempotent=True)
         return resp.exit_code if resp.terminated else None
 
     def shutdown_task(self):
-        self.send(ShutdownTaskRequest())
+        self.send(ShutdownTaskRequest(), idempotent=True)

@@ -26,7 +26,11 @@ def _driver_watchdog(addr, port):
     while True:
         time.sleep(10.0)
         try:
-            http_client.get(addr, port, "ping", "ping", timeout=None)
+            # retry_for=0: the watchdog IS the retry loop — the verb's
+            # built-in transport retry would stretch the driver-lost
+            # window far past _DRIVER_LOST_AFTER_S
+            http_client.get(addr, port, "ping", "ping", timeout=None,
+                            retry_for=0)
             lost_since = None
         except KeyError:
             lost_since = None  # server answered (404): driver alive

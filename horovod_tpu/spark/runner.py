@@ -68,8 +68,10 @@ def _task_fn(index, num_proc, fn, args, kwargs, rendezvous_addr,
     probe_deadline = time_mod.monotonic() + 15.0
     while True:
         try:
+            # retry_for=0: this loop owns its own 15s fail-open budget;
+            # the verb's built-in transport retry would overrun it
             http_client.get(rendezvous_addr, int(rendezvous_port),
-                            "spark-start", str(index))
+                            "spark-start", str(index), retry_for=0)
             raise RuntimeError(
                 f"task for rank {index} appears to be a Spark retry; "
                 f"horovod jobs cannot retry individual ranks — fail "

@@ -38,6 +38,19 @@ HVD_NUM_STREAMS = "HVD_NUM_STREAMS"
 # (block-scaled int8, EQuARX arXiv:2506.17615)
 HVD_TPU_COMPRESSION = "HVD_TPU_COMPRESSION"
 
+# --- fault-tolerant collective runtime (docs/fault_tolerance.md) -------------
+# bound on "abort initiated anywhere -> every rank raises HvdAbortedError"
+HVD_TPU_ABORT_TIMEOUT = "HVD_TPU_ABORT_TIMEOUT"
+# peer/coordinator heartbeat period on the persistent connections, seconds
+HVD_TPU_HEARTBEAT_INTERVAL = "HVD_TPU_HEARTBEAT_INTERVAL"
+# missed-heartbeat window: a rank silent for longer is declared dead and
+# the coordinator converts the silence into a coordinated abort (0 = off)
+HVD_TPU_LIVENESS_TIMEOUT = "HVD_TPU_LIVENESS_TIMEOUT"
+# deadline budget for connection-establishment retry (backoff + jitter)
+HVD_TPU_CONNECT_RETRY_SECONDS = "HVD_TPU_CONNECT_RETRY_SECONDS"
+# deterministic fault injection spec (common/faults.py grammar)
+HVD_TPU_FAULT_SPEC = "HVD_TPU_FAULT_SPEC"
+
 # --- launcher -> worker contract (reference: gloo_run.py:152-157,261-273) ----
 HVD_RANK = "HVD_RANK"
 HVD_SIZE = "HVD_SIZE"
@@ -59,6 +72,10 @@ DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
 DEFAULT_CYCLE_TIME_MS = 1.0
 DEFAULT_CACHE_CAPACITY = 1024
 DEFAULT_STALL_WARNING_SECONDS = 60
+DEFAULT_ABORT_TIMEOUT_SECONDS = 30.0
+DEFAULT_HEARTBEAT_INTERVAL_SECONDS = 2.0
+DEFAULT_LIVENESS_TIMEOUT_SECONDS = 15.0
+DEFAULT_CONNECT_RETRY_SECONDS = 30.0
 
 
 def get_int(name, default=0):

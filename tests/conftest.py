@@ -64,6 +64,65 @@ def hvd_init(hvd):
     return hvd
 
 
+def spawn_tcp_ranks(n, script, extra_env=None, timeout=90):
+    """Launch ``n`` worker processes under the tcp-controller env
+    contract WITHOUT the hvdrun kill-on-first-failure fan-out — the
+    fault-tolerance tests need surviving ranks to keep running (and
+    observe the coordinated abort) after a sibling dies, which the
+    launcher would otherwise preempt with SIGTERM.
+
+    Returns [(returncode, stdout, stderr)] per rank.
+    """
+    import base64
+    import subprocess
+    import sys
+
+    from horovod_tpu.run.http_server import RendezvousServer
+    from horovod_tpu.run.service import secret
+
+    path = os.path.join("/tmp", f"hvd_ft_worker_{os.getpid()}.py")
+    with open(path, "w") as f:
+        f.write(script)
+    server = RendezvousServer()
+    port = server.start()
+    key = base64.b64encode(secret.make_secret_key()).decode()
+    procs = []
+    try:
+        for r in range(n):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = _REPO + os.pathsep + env.get(
+                "PYTHONPATH", "")
+            env.update({
+                "HVD_RANK": str(r), "HVD_SIZE": str(n),
+                "HVD_LOCAL_RANK": str(r), "HVD_LOCAL_SIZE": str(n),
+                "HVD_CROSS_RANK": "0", "HVD_CROSS_SIZE": "1",
+                "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HVD_RENDEZVOUS_PORT": str(port),
+                "HVD_SECRET_KEY": key,
+            })
+            if extra_env:
+                env.update(extra_env)
+            procs.append(subprocess.Popen(
+                [sys.executable, path], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        results = []
+        import time
+        deadline = time.monotonic() + timeout
+        for p in procs:
+            remaining = max(1.0, deadline - time.monotonic())
+            out, err = p.communicate(timeout=remaining)
+            results.append((p.returncode, out, err))
+        return results
+    except Exception:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        raise
+    finally:
+        server.stop()
+
+
 PYSPARK_SHIM = os.path.join(_REPO, "tests", "_pyspark_shim")
 
 

@@ -1,0 +1,489 @@
+"""Fault-tolerant collective runtime tests (docs/fault_tolerance.md).
+
+Unit layer: fault-spec grammar, the purge LRU bound, abort waking a
+blocked mailbox recv, connect retry with backoff.
+
+Integration layer: the crash / drop / refuse x allreduce / broadcast /
+allgather matrix against real worker processes on the tcp plane — each
+cell is driven by a deterministic ``HVD_TPU_FAULT_SPEC`` so the failure
+fires at an exact step, and the assertion is the acceptance criterion:
+every surviving rank raises ``HvdAbortedError`` naming the origin rank
+within the abort deadline, no hangs, no leaked mailbox chunks.
+"""
+
+import threading
+import time
+
+import pytest
+
+from conftest import spawn_tcp_ranks
+from horovod_tpu.common import faults
+from horovod_tpu.common.handles import HvdAbortedError
+
+
+# ------------------------------------------------------------ spec grammar --
+def test_fault_spec_grammar():
+    specs = faults.parse_fault_spec(
+        "rank1:allreduce:2:crash, rank0:send:5:drop ,*:connect:1:refuse")
+    assert [(s.rank, s.point, s.step, s.action) for s in specs] == [
+        (1, "allreduce", 2, "crash"),
+        (0, "send", 5, "drop"),
+        (None, "connect", 1, "refuse"),
+    ]
+    assert faults.parse_fault_spec("") == []
+    assert faults.parse_fault_spec(None) == []
+
+
+@pytest.mark.parametrize("bad", [
+    "rank1:allreduce:crash",          # missing field
+    "node1:allreduce:1:crash",        # bad target
+    "rank1:allreduce:0:crash",        # step is 1-based
+    "rank1:allreduce:x:crash",        # non-integer step
+    "rank1:allreduce:1:explode",      # unknown action
+    "rank1::1:crash",                 # empty point
+])
+def test_fault_spec_rejects_bad_grammar(bad):
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec(bad)
+
+
+def test_injector_fires_at_exact_step_for_matching_rank():
+    inj = faults.FaultInjector(
+        faults.parse_fault_spec("rank1:send:3:drop,*:recv:2:refuse"),
+        rank=1)
+    assert [inj.fire("send") for _ in range(4)] == [
+        None, None, "drop", None]
+    assert [inj.fire("recv") for _ in range(3)] == [None, "refuse", None]
+    # rank mismatch: counter still advances, fault never fires
+    other = faults.FaultInjector(
+        faults.parse_fault_spec("rank1:send:1:drop"), rank=0)
+    assert [other.fire("send") for _ in range(3)] == [None, None, None]
+
+
+def test_config_validates_fault_spec_at_init(monkeypatch):
+    from horovod_tpu.common.config import Config
+
+    monkeypatch.setenv("HVD_TPU_FAULT_SPEC", "rank1:allreduce:1:explode")
+    with pytest.raises(ValueError, match="action"):
+        Config.from_env()
+
+
+# --------------------------------------------------------- peer mailbox -----
+def _peer_service():
+    from horovod_tpu.ops.tcp_dataplane import PeerService
+    from horovod_tpu.run.service import secret
+
+    return PeerService(secret.make_secret_key())
+
+
+def _push_chunk(svc, ring_id, src=1, payload=b"x"):
+    from horovod_tpu.ops.tcp_dataplane import ChunkMsg
+
+    svc._handle(ChunkMsg(((ring_id, "rs", 0)), src, payload), None)
+
+
+def test_purged_ring_ids_are_a_bounded_lru():
+    svc = _peer_service()
+    try:
+        for ring_id in range(1000):
+            svc.purge(ring_id)
+        assert len(svc._purged) == svc._PURGED_KEEP
+        # late chunk of a recently purged round is dropped
+        _push_chunk(svc, 999)
+        assert svc._mailbox == {}
+        # re-purging a hot id refreshes its LRU slot instead of letting
+        # a newer purge evict it
+        svc.purge(1000 - svc._PURGED_KEEP)  # oldest retained id
+        svc.purge(2000)  # evicts the NEXT-oldest, not the refreshed one
+        assert (1000 - svc._PURGED_KEEP) in svc._purged
+        assert (1001 - svc._PURGED_KEEP) not in svc._purged
+        # an id evicted from the LRU is forgotten: its chunks land again
+        _push_chunk(svc, 0)
+        assert len(svc._mailbox) == 1
+    finally:
+        svc.shutdown()
+
+
+def test_abort_wakes_blocked_recv_and_purges_mailbox():
+    svc = _peer_service()
+    try:
+        _push_chunk(svc, 7, src=2)
+        assert len(svc._mailbox) == 1
+        caught = []
+
+        def blocked_recv():
+            try:
+                svc.recv(((99, "rs", 0)), 3, timeout=30)
+            except BaseException as exc:  # noqa: BLE001
+                caught.append(exc)
+
+        t = threading.Thread(target=blocked_recv, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        start = time.monotonic()
+        svc.abort(5, "injected test abort")
+        t.join(timeout=5)
+        assert not t.is_alive(), "abort did not wake the blocked recv"
+        assert time.monotonic() - start < 2.0
+        assert isinstance(caught[0], HvdAbortedError)
+        assert caught[0].origin_rank == 5
+        # no leaked chunks: buffer purged, late arrivals refused
+        assert svc._mailbox == {}
+        _push_chunk(svc, 8)
+        assert svc._mailbox == {}
+        # sticky: the next recv fails immediately too
+        with pytest.raises(HvdAbortedError):
+            svc.recv(((100, "rs", 0)), 1, timeout=5)
+    finally:
+        svc.shutdown()
+
+
+# ------------------------------------------------------- transport retry ----
+def test_basic_client_retries_refused_connects_with_backoff():
+    from horovod_tpu.run.service import network, secret
+
+    key = secret.make_secret_key()
+    svc = network.BasicService("retry target", key)
+    try:
+        faults.configure("*:connect:1:refuse,*:connect:2:refuse", rank=0)
+        client = network.BasicClient([("127.0.0.1", svc.port)], key,
+                                     retry_for=20)
+        resp = client.send(network.PingRequest())
+        assert isinstance(resp, network.PingResponse)
+    finally:
+        faults.configure(None)
+        svc.shutdown()
+
+
+def test_basic_client_retry_budget_zero_fails_fast():
+    from horovod_tpu.run.service import network, secret
+
+    client = network.BasicClient([("127.0.0.1", 1)],
+                                 secret.make_secret_key(),
+                                 timeout=1, retry_for=0)
+    start = time.monotonic()
+    with pytest.raises(ConnectionError):
+        client.send(network.PingRequest())
+    assert time.monotonic() - start < 5.0
+
+
+def test_mux_client_retries_refused_connects():
+    from horovod_tpu.run.service import network, secret
+
+    key = secret.make_secret_key()
+    svc = network.MuxService("mux retry target", key)
+    try:
+        faults.configure("*:connect:1:refuse", rank=0)
+        client = network.MuxClient([("127.0.0.1", svc.port)], key,
+                                   retry_for=20)
+        resp = client.send((network.PingRequest()), timeout=10)
+        assert isinstance(resp, network.PingResponse)
+        client.close()
+    finally:
+        faults.configure(None)
+        svc.shutdown()
+
+
+def test_http_client_all_verbs_with_retry():
+    from horovod_tpu.run import http_client
+    from horovod_tpu.run.http_server import RendezvousServer
+
+    server = RendezvousServer()
+    port = server.start()
+    try:
+        http_client.put("127.0.0.1", port, "s", "k", b"v")
+        assert http_client.get("127.0.0.1", port, "s", "k") == b"v"
+        http_client.delete("127.0.0.1", port, "s", "k")
+        with pytest.raises(KeyError):
+            http_client.get("127.0.0.1", port, "s", "k", timeout=0.2)
+    finally:
+        server.stop()
+    # dead endpoint: the bounded retry gives up within its budget
+    start = time.monotonic()
+    with pytest.raises(OSError):
+        http_client.get("127.0.0.1", port, "s", "k", retry_for=0.5)
+    assert time.monotonic() - start < 10.0
+
+
+# ----------------------------------------------- launcher culprit naming ----
+def test_safe_shell_exec_reports_event_termination():
+    import sys
+
+    from horovod_tpu.run import safe_shell_exec
+
+    # natural failure: no event involvement recorded
+    info = {}
+    code = safe_shell_exec.execute([sys.executable, "-c", "exit(3)"],
+                                   info=info)
+    assert code == 3
+    assert not info.get("terminated_by_event")
+
+    # event-driven kill: the victim is marked so the launcher does not
+    # blame it for the job failure
+    event = threading.Event()
+    info = {}
+    threading.Timer(0.3, event.set).start()
+    code = safe_shell_exec.execute(
+        [sys.executable, "-c", "import time; time.sleep(30)"],
+        events=[event], info=info)
+    assert code != 0
+    assert info.get("terminated_by_event") is True
+
+
+# ------------------------------------------------------ injected matrix -----
+# Worker for the crash/drop x op matrix: runs one collective; on a
+# coordinated abort it reports the origin rank, the elapsed time and
+# the mailbox residue so the test can assert the acceptance criterion.
+MATRIX_WORKER = r"""
+import os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+r = hvd.rank()
+op = os.environ["FT_OP"]
+n_elems = int(os.environ.get("FT_SIZE", "70000"))
+t = jnp.ones((n_elems,)) * (r + 1)
+start = time.monotonic()
+try:
+    if op == "allreduce":
+        hvd.allreduce(t, op=hvd.Sum, name="ft.tensor")
+    elif op == "broadcast":
+        hvd.broadcast(t, root_rank=0, name="ft.tensor")
+    else:
+        hvd.allgather(t, name="ft.tensor")
+    print(f"rank {r} COMPLETED", flush=True)
+except hvd.HvdAbortedError as exc:
+    elapsed = time.monotonic() - start
+    from horovod_tpu.common import basics
+    svc = basics._get_state().controller._peer_service
+    leaked = len(svc._mailbox) if svc is not None else 0
+    print(f"rank {r} ABORTED origin={exc.origin_rank} "
+          f"elapsed={elapsed:.1f} leaked={leaked}", flush=True)
+print(f"rank {r} DONE", flush=True)
+"""
+
+# tight failure-detection windows so each cell stays tier-1 fast; the
+# abort deadline stays well above them so elapsed < deadline is a real
+# bound, not a tautology
+_FT_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "HVD_TPU_HEARTBEAT_INTERVAL": "0.25",
+    "HVD_TPU_ABORT_TIMEOUT": "10",
+    "HVD_STALL_CHECK_TIME_SECONDS": "1",
+    "HVD_TCP_RING_THRESHOLD": "1024",
+}
+
+
+def _assert_aborted(out, rank, origin, deadline=10.0):
+    line = next(l for l in out.splitlines()
+                if l.startswith(f"rank {rank} ABORTED"))
+    fields = dict(kv.split("=") for kv in line.split()[3:])
+    allowed = origin if isinstance(origin, tuple) else (origin,)
+    assert fields["origin"] in {str(o) for o in allowed}, line
+    assert float(fields["elapsed"]) < deadline, line
+    assert fields["leaked"] == "0", line
+
+
+@pytest.mark.parametrize("op", ["allreduce", "broadcast", "allgather"])
+def test_injected_crash_aborts_survivor(op):
+    """Rank 1 hard-exits at its first <op> submit (pre-negotiation, so
+    this exercises the coordinator-star side): the liveness monitor
+    notices the silence and rank 0 raises HvdAbortedError(origin=1)."""
+    results = spawn_tcp_ranks(2, MATRIX_WORKER, extra_env={
+        **_FT_ENV,
+        "FT_OP": op,
+        "FT_SIZE": "8",  # below the ring threshold: star path
+        "HVD_TPU_LIVENESS_TIMEOUT": "2",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "20",
+        "HVD_TPU_FAULT_SPEC": f"rank1:{op}:1:crash",
+    })
+    code0, out0, err0 = results[0]
+    code1, out1, err1 = results[1]
+    assert code1 == 1, f"crashed rank: {out1}\n{err1}"
+    assert code0 == 0, f"survivor: {out0}\n{err0}"
+    _assert_aborted(out0, rank=0, origin=1)
+
+
+def test_injected_crash_mid_ring_allreduce():
+    """The acceptance scenario: rank 1 dies AFTER the coordinator's
+    ring go-ahead, with rank 0 already blocked on its chunks — the ring
+    path's worst case.  Liveness converts the silence into an abort and
+    the blocked recv wakes with the typed error, mailbox clean."""
+    results = spawn_tcp_ranks(2, MATRIX_WORKER, extra_env={
+        **_FT_ENV,
+        "FT_OP": "allreduce",
+        "FT_SIZE": "70000",  # above the ring threshold: ring path
+        "HVD_TPU_LIVENESS_TIMEOUT": "2",
+        # keep the ring recv timeout far beyond liveness so the typed
+        # abort (origin=the dead rank), not a local TimeoutError, is
+        # what wakes the survivor
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "30",
+        "HVD_TPU_FAULT_SPEC": "rank1:ring:1:crash",
+    })
+    code0, out0, err0 = results[0]
+    code1, out1, _ = results[1]
+    assert code1 == 1, f"crashed rank: {out1}"
+    assert code0 == 0, f"survivor: {out0}\n{err0}"
+    _assert_aborted(out0, rank=0, origin=1)
+
+
+@pytest.mark.parametrize("op", ["allreduce", "broadcast", "allgather"])
+def test_injected_drop_promotes_stall_into_abort(op):
+    """Rank 1 silently drops its contribution (the rank is alive and
+    heartbeating — liveness can't see it): the stall inspector promotes
+    the stalled tensor into a coordinated abort naming rank 1, and BOTH
+    ranks — including the dropper, whose handle would otherwise wait
+    forever — raise the same typed error."""
+    results = spawn_tcp_ranks(2, MATRIX_WORKER, extra_env={
+        **_FT_ENV,
+        "FT_OP": op,
+        "FT_SIZE": "8",
+        "HVD_TPU_LIVENESS_TIMEOUT": "30",  # must NOT fire: rank 1 lives
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "2",
+        "HVD_TPU_FAULT_SPEC": f"rank1:{op}:1:drop",
+    })
+    for rank, (code, out, err) in enumerate(results):
+        assert code == 0, f"rank {rank}: {out}\n{err}"
+        _assert_aborted(out, rank=rank, origin=1)
+
+
+def test_injected_send_drop_bounded_without_stall_shutdown():
+    """A chunk silently dropped on the wire AFTER negotiation is the
+    failure neither liveness (the sender is alive and heartbeating) nor
+    the stall inspector (negotiation completed) can see: the ring-recv
+    backstop (4x the abort deadline) must convert it into a coordinated
+    abort even with the stall shutdown off — the default config."""
+    results = spawn_tcp_ranks(2, MATRIX_WORKER, extra_env={
+        **_FT_ENV,
+        "FT_OP": "allreduce",
+        "FT_SIZE": "70000",  # ring path
+        "HVD_TPU_ABORT_TIMEOUT": "1",  # recv backstop = 4s
+        "HVD_TPU_LIVENESS_TIMEOUT": "30",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "0",
+        "HVD_TPU_FAULT_SPEC": "rank0:send:1:drop",
+    })
+    for rank, (code, out, err) in enumerate(results):
+        assert code == 0, f"rank {rank}: {out}\n{err}"
+        # whichever blocked rank's backstop fires first names itself
+        _assert_aborted(out, rank=rank, origin=(0, 1))
+
+
+@pytest.mark.parametrize("op", ["allreduce", "broadcast", "allgather"])
+def test_injected_connect_refusals_are_retried(op):
+    """Both ranks' first two connection attempts are refused: the
+    backoff retry carries rendezvous/negotiation through and the
+    collective completes exactly — a transport blip is not a failure."""
+    results = spawn_tcp_ranks(2, MATRIX_WORKER, extra_env={
+        **_FT_ENV,
+        "FT_OP": op,
+        "FT_SIZE": "70000",  # ring path: peer connects retried too
+        "HVD_TPU_LIVENESS_TIMEOUT": "30",
+        "HVD_TPU_FAULT_SPEC": "*:connect:1:refuse,*:connect:2:refuse",
+        "HVD_TPU_CONNECT_RETRY_SECONDS": "20",
+    })
+    for rank, (code, out, err) in enumerate(results):
+        assert code == 0, f"rank {rank}: {out}\n{err}"
+        assert f"rank {rank} COMPLETED" in out, f"{out}\n{err}"
+
+
+def test_user_abort_reaches_blocked_peer():
+    """hvd.abort() from one rank fails a peer blocked in negotiation
+    with the typed error naming the aborting rank."""
+    script = r"""
+import os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+r = hvd.rank()
+start = time.monotonic()
+try:
+    if r == 1:
+        time.sleep(1.0)  # let rank 0 block in negotiation first
+        hvd.abort("operator says no")
+        # the sticky abort fails this rank's own next submit too
+        try:
+            hvd.allreduce(jnp.ones((4,)), op=hvd.Sum, name="after")
+            print(f"rank {r} UNEXPECTED-OK", flush=True)
+        except hvd.HvdAbortedError:
+            print(f"rank {r} STICKY-OK", flush=True)
+    else:
+        hvd.allreduce(jnp.ones((4,)), op=hvd.Sum, name="ua.tensor")
+        print(f"rank {r} COMPLETED", flush=True)
+except hvd.HvdAbortedError as exc:
+    print(f"rank {r} ABORTED origin={exc.origin_rank} "
+          f"elapsed={time.monotonic() - start:.1f} leaked=0", flush=True)
+print(f"rank {r} DONE", flush=True)
+"""
+    results = spawn_tcp_ranks(2, script, extra_env={
+        **_FT_ENV,
+        "HVD_TPU_LIVENESS_TIMEOUT": "30",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "30",
+    })
+    code0, out0, err0 = results[0]
+    code1, out1, err1 = results[1]
+    assert code0 == 0, f"{out0}\n{err0}"
+    assert code1 == 0, f"{out1}\n{err1}"
+    _assert_aborted(out0, rank=0, origin=1)
+    assert "rank 1 STICKY-OK" in out1, out1
+
+
+def test_launcher_names_culprit_rank():
+    """End-to-end through hvdrun: a rank that dies on its own is named
+    as the culprit — the SIGTERMed victims can no longer steal the
+    blame with their -15 (satellite: exit-code/rank propagation)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = "/tmp/hvd_culprit_worker.py"
+    with open(path, "w") as f:
+        f.write(r"""
+import os, sys, time
+rank = int(os.environ["HVD_RANK"])
+if rank == 1:
+    time.sleep(0.5)
+    sys.exit(7)
+time.sleep(30)
+""")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    result = subprocess.run(
+        [sys.executable, os.path.join(repo, "bin", "hvdrun"), "-np", "2",
+         sys.executable, path],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert result.returncode == 7, result.stderr
+    assert "rank 1 failed first (exit code 7)" in result.stderr, \
+        result.stderr
+
+
+def test_hvd_chaos_prints_reproducible_spec():
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    chaos = os.path.join(repo, "bin", "hvd-chaos")
+
+    def spec_for(seed):
+        out = subprocess.run(
+            [sys.executable, chaos, "--seed", str(seed), "--faults", "2",
+             "--", "-np", "2", "--version"],
+            env=env, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        line = next(l for l in out.stdout.splitlines()
+                    if "HVD_TPU_FAULT_SPEC=" in l)
+        spec = line.split("HVD_TPU_FAULT_SPEC=")[1].strip("'\"")
+        faults.parse_fault_spec(spec)  # valid grammar
+        return spec
+
+    assert spec_for(7) == spec_for(7)       # same seed -> same spec
+    assert spec_for(7) != spec_for(8)       # different seed -> different
