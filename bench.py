@@ -19,6 +19,12 @@ Also reports (in the same JSON object, under ``extra``):
     ``allreduce_gbs_device``, the device-resident path (jax.Array in /
     jax.Array out, one host sync at the end) — the honest measure of
     the eager plane once data lives on device.
+  - ``allreduce_gbs_ring`` / ``allreduce_gbs_int8``: exact vs
+    block-scaled int8 loopback-TCP worker ring.
+  - ``allreduce_gbs_ring_pipelined``: the pipelined ring transfer
+    engine (native wire dtypes + segment overlap + socket striping)
+    swept over segment size and stripe count at 1/4/16/64 MB against
+    the seed-era serial f64-wire ring (docs/benchmarks.md).
 
 Structure: running ``python bench.py`` starts a supervisor that retries
 the actual measurement in a fresh subprocess (``--worker``), because a
@@ -403,6 +409,108 @@ def _bench_ring_allreduce_bandwidth(p=4):
     return out
 
 
+def _ring_harness(p, segment_bytes, stripes):
+    """In-process worker ring over real loopback TCP (the exact
+    transport of multi-process tcp mode): one PeerService mailbox +
+    RingPlane per rank, control MuxClients + bulk StripeClients."""
+    from horovod_tpu.ops.tcp_dataplane import PeerService, RingPlane
+    from horovod_tpu.run.service import network
+
+    key = b"0" * 32
+    services = [PeerService(key) for _ in range(p)]
+
+    def resolver(rank):
+        return network.MuxClient([("127.0.0.1", services[rank].port)],
+                                 key, timeout=60)
+
+    def resolve_bulk(rank):
+        return network.StripeClient(
+            [("127.0.0.1", services[rank].port)], key, timeout=60)
+
+    planes = [RingPlane(r, services[r], resolver, resolve_bulk,
+                        segment_bytes=segment_bytes, stripes=stripes)
+              for r in range(p)]
+    return services, planes
+
+
+def _ring_run_all(planes, fn):
+    import threading
+
+    errs = []
+
+    def run(r):
+        try:
+            fn(r)
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    threads = [threading.Thread(target=run, args=(r,))
+               for r in range(len(planes))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs:
+        raise errs[0]
+
+
+def _bench_ring_pipelined_bandwidth(p=4):
+    """Pipelined exact-ring sweep (ISSUE 3): effective GB/s of the
+    native-dtype segmented/striped ring vs the seed-era serial
+    f64-on-the-wire ring, across payload sizes and (segment, stripe)
+    settings.  Effective GB/s = payload bytes x iters / wall time
+    (algorithmic bandwidth, same convention as the eager sweep)."""
+    import numpy as np
+
+    sizes = [1 << 20, 1 << 22, 1 << 24, 1 << 26]
+    combos = [("seg256KB_s2", 1 << 18, 2), ("seg1MB_s1", 1 << 20, 1),
+              ("seg1MB_s2", 1 << 20, 2), ("seg1MB_s4", 1 << 20, 4),
+              ("seg4MB_s2", 1 << 22, 2)]
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        sizes = sizes[:2]
+        combos = combos[1:4]
+    services, planes = _ring_harness(p, 1 << 20, max(c[2] for c in combos))
+    ring_seq = [0]
+
+    def measure(data, run_one, iters=3):
+        ring_seq[0] += 1
+        _ring_run_all(planes, lambda r: run_one(r, ring_seq[0]))  # warmup
+        start = time.perf_counter()
+        for _ in range(iters):
+            ring_seq[0] += 1
+            _ring_run_all(planes, lambda r: run_one(r, ring_seq[0]))
+        return data[0].nbytes * iters / (time.perf_counter() - start) / 1e9
+
+    out = {}
+    try:
+        for nbytes in sizes:
+            rng = np.random.RandomState(0)
+            data = [rng.randn(nbytes // 4).astype(np.float32)
+                    for _ in range(p)]
+            label = f"{nbytes // (1 << 20)}MB"
+            row = {"seed": round(measure(data, lambda r, rid:
+                   planes[r].allreduce_seed(
+                       rid, data[r], list(range(p)), op_average=False,
+                       world_size=p, timeout=300)), 3)}
+            for name, seg, stripes in combos:
+                for plane in planes:
+                    plane.stripes = stripes
+                row[name] = round(measure(data, lambda r, rid:
+                    planes[r].allreduce(
+                        rid, data[r], list(range(p)), op_average=False,
+                        world_size=p, timeout=300,
+                        segment_bytes=seg)), 3)
+            best = max(v for k, v in row.items() if k != "seed")
+            row["speedup_vs_seed"] = round(best / row["seed"], 2)
+            out[label] = row
+    finally:
+        for plane in planes:
+            plane.close()
+        for svc in services:
+            svc.shutdown()
+    return out
+
+
 def worker():
     # watchdog: a held/unreachable TPU can make backend init BLOCK
     # (not fail); bail out so the supervisor's retry loop stays snappy
@@ -493,6 +601,7 @@ def worker():
             "allreduce_gbs_ring": None,
             "allreduce_gbs_int8": None,
             "allreduce_int8_speedup": None,
+            "allreduce_gbs_ring_pipelined": None,
         },
     }
     state["record"] = record
@@ -529,6 +638,12 @@ def worker():
         record["extra"]["allreduce_int8_speedup"] = ring["speedup"]
     except Exception as exc:  # never lose the headline to the ring leg
         sys.stderr.write(f"int8 ring bench failed: {exc!r}\n")
+    state["last"] = time.time()
+    try:
+        record["extra"]["allreduce_gbs_ring_pipelined"] = \
+            _bench_ring_pipelined_bandwidth()
+    except Exception as exc:  # never lose the headline to this leg
+        sys.stderr.write(f"pipelined ring bench failed: {exc!r}\n")
     state["last"] = time.time()
     # print BEFORE shutdown: a shutdown stall (relay death at the
     # barrier) must not cost a complete measurement.  Under the lock,

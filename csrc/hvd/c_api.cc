@@ -167,7 +167,9 @@ void* hvd_pm_create(int warmup, int steady_state, int bayes_max,
                     int64_t fusion_bytes, double cycle_ms,
                     int hier_allreduce, int hier_allgather,
                     int cache_enabled, int compression,
-                    int compression_available) {
+                    int compression_available,
+                    int64_t ring_segment_bytes, int ring_stripes,
+                    int ring_tunable) {
   hvd::ParameterManager::Options o;
   o.active = true;
   o.warmup_samples = warmup;
@@ -187,6 +189,9 @@ void* hvd_pm_create(int warmup, int steady_state, int bayes_max,
   o.cache_enabled = cache_enabled != 0;
   o.compression = compression != 0;
   o.compression_available = compression_available != 0;
+  o.ring_segment_bytes = ring_segment_bytes;
+  o.ring_stripes = ring_stripes;
+  o.ring_tunable = ring_tunable != 0;
   return new hvd::ParameterManager(o);
 }
 
@@ -229,6 +234,14 @@ int hvd_pm_cache_enabled(void* pm) {
 int hvd_pm_compression_enabled(void* pm) {
   return static_cast<hvd::ParameterManager*>(pm)->compression_enabled() ? 1
                                                                         : 0;
+}
+
+int64_t hvd_pm_ring_segment_bytes(void* pm) {
+  return static_cast<hvd::ParameterManager*>(pm)->ring_segment_bytes();
+}
+
+int hvd_pm_ring_stripes(void* pm) {
+  return static_cast<hvd::ParameterManager*>(pm)->ring_stripes();
 }
 
 int hvd_pm_tuning(void* pm) {

@@ -27,13 +27,16 @@ ParameterManager::ParameterManager(const Options& opts)
                                               (1024.0 * 1024.0)))),
       best_cycle_ms_(opts.cycle_time_ms),
       best_cat_{opts.hierarchical_allreduce, opts.hierarchical_allgather,
-                opts.cache_enabled, opts.compression},
+                opts.cache_enabled, opts.compression,
+                opts.ring_segment_bytes, opts.ring_stripes},
       fusion_bytes_(opts.fusion_threshold_bytes),
       cycle_ms_(opts.cycle_time_ms),
       hier_allreduce_(opts.hierarchical_allreduce),
       hier_allgather_(opts.hierarchical_allgather),
       cache_enabled_(opts.cache_enabled),
       compression_(opts.compression),
+      ring_segment_bytes_(opts.ring_segment_bytes),
+      ring_stripes_(opts.ring_stripes),
       tuning_(opts.active),
       best_score_(0.0) {
   if (!opts.active) return;
@@ -41,18 +44,43 @@ ParameterManager::ParameterManager(const Options& opts)
   // sequentially; same set here: hierarchy on/off, cache on/off, and —
   // when a compressor is configured — wire compression on/off).
   const bool comp = opts.compression;
+  const int64_t seg = opts.ring_segment_bytes;
+  const int str = opts.ring_stripes;
   walk_ = {
-      {false, false, true, comp},
-      {true, false, true, comp},
-      {false, true, true, comp},
-      {true, true, true, comp},
-      {false, false, false, comp},
+      {false, false, true, comp, seg, str},
+      {true, false, true, comp, seg, str},
+      {false, true, true, comp, seg, str},
+      {true, true, true, comp, seg, str},
+      {false, false, false, comp, seg, str},
   };
   if (opts.compression_available) {
     // one probe of the opposite compression state at the default
     // schedule configuration — enough for the score to decide whether
     // the quantize overhead pays for the wire savings on this job
-    walk_.push_back({false, false, true, !comp});
+    walk_.push_back({false, false, true, !comp, seg, str});
+  }
+  if (opts.ring_tunable) {
+    // ring transfer-engine probes around the configured values at the
+    // default schedule configuration: halve/double the pipeline
+    // segment, double the stripe count.  Clamped to sane spans —
+    // smaller segments trade per-frame overhead for overlap, more
+    // stripes trade connections for per-stream throughput, and the
+    // score decides what pays on this job's links.
+    if (seg > 0) {
+      // a probe whose clamp lands back on the configured value would
+      // duplicate an existing walk entry (the seed-dedup pass below
+      // only removes matches of the SEED categorical) and burn a full
+      // probe window re-measuring the same point
+      const int64_t seg_lo = std::max<int64_t>(seg / 2, 1 << 16);
+      const int64_t seg_hi = std::min<int64_t>(seg * 2, 1 << 26);
+      if (seg_lo != seg)
+        walk_.push_back({false, false, true, comp, seg_lo, str});
+      if (seg_hi != seg)
+        walk_.push_back({false, false, true, comp, seg_hi, str});
+    }
+    const int str_hi = std::min(str * 2, 8);
+    if (str_hi != str)
+      walk_.push_back({false, false, true, comp, seg, str_hi});
   }
   // The walk starts at the CONFIGURED categorical so the first tuning
   // samples — and everything published before the walk advances —
@@ -61,12 +89,14 @@ ParameterManager::ParameterManager(const Options& opts)
   // manager from the configured values before tuning).
   const Categorical seed{opts.hierarchical_allreduce,
                          opts.hierarchical_allgather, opts.cache_enabled,
-                         opts.compression};
+                         opts.compression, seg, str};
   auto same = [&seed](const Categorical& c) {
     return c.hier_allreduce == seed.hier_allreduce &&
            c.hier_allgather == seed.hier_allgather &&
            c.cache_enabled == seed.cache_enabled &&
-           c.compression == seed.compression;
+           c.compression == seed.compression &&
+           c.ring_segment_bytes == seed.ring_segment_bytes &&
+           c.ring_stripes == seed.ring_stripes;
   };
   walk_.erase(std::remove_if(walk_.begin(), walk_.end(), same), walk_.end());
   walk_.insert(walk_.begin(), seed);
@@ -76,7 +106,8 @@ ParameterManager::ParameterManager(const Options& opts)
       std::fprintf(log_,
                    "score_bytes_per_sec,fusion_threshold_mb,cycle_time_ms,"
                    "hierarchical_allreduce,hierarchical_allgather,"
-                   "cache_enabled,compression\n");
+                   "cache_enabled,compression,ring_segment_bytes,"
+                   "ring_stripes\n");
     }
   }
   bayes_ = std::make_unique<optim::BayesianOptimizer>(
@@ -104,6 +135,8 @@ void ParameterManager::ApplyPoint(const std::vector<double>& point) {
   hier_allgather_.store(cat.hier_allgather);
   cache_enabled_.store(cat.cache_enabled);
   compression_.store(cat.compression);
+  ring_segment_bytes_.store(cat.ring_segment_bytes);
+  ring_stripes_.store(cat.ring_stripes);
   discard_left_ = opts_.warmup_samples;
   window_scores_.clear();
   window_bytes_ = 0;
@@ -117,6 +150,8 @@ void ParameterManager::ApplyBest() {
   hier_allgather_.store(best_cat_.hier_allgather);
   cache_enabled_.store(best_cat_.cache_enabled);
   compression_.store(best_cat_.compression);
+  ring_segment_bytes_.store(best_cat_.ring_segment_bytes);
+  ring_stripes_.store(best_cat_.ring_stripes);
   tuning_.store(false);
   if (log_) {
     std::fflush(log_);
@@ -138,11 +173,13 @@ void ParameterManager::NextCategorical() {
 
 void ParameterManager::LogRow(double score) {
   if (!log_) return;
-  std::fprintf(log_, "%.1f,%.2f,%.2f,%d,%d,%d,%d\n", score,
+  std::fprintf(log_, "%.1f,%.2f,%.2f,%d,%d,%d,%d,%lld,%d\n", score,
                static_cast<double>(fusion_bytes_.load()) / (1024.0 * 1024.0),
                cycle_ms_.load(), hier_allreduce_.load() ? 1 : 0,
                hier_allgather_.load() ? 1 : 0, cache_enabled_.load() ? 1 : 0,
-               compression_.load() ? 1 : 0);
+               compression_.load() ? 1 : 0,
+               static_cast<long long>(ring_segment_bytes_.load()),
+               ring_stripes_.load());
 }
 
 bool ParameterManager::Update(double now_seconds) {

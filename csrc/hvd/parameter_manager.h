@@ -56,6 +56,14 @@ class ParameterManager {
     // when the operator configured a compressor.
     bool compression = false;
     bool compression_available = false;
+    // TCP-ring transfer-engine knobs (HVD_TPU_RING_SEGMENT_BYTES /
+    // HVD_TPU_RING_STRIPES).  Joined to the categorical walk only when
+    // `ring_tunable` (tcp-controller jobs — the knobs are inert on the
+    // in-process planes): a short probe set around the configured
+    // values, scored like every other categorical.
+    int64_t ring_segment_bytes = 1 << 20;
+    int ring_stripes = 2;
+    bool ring_tunable = false;
   };
 
   explicit ParameterManager(const Options& opts);
@@ -77,6 +85,8 @@ class ParameterManager {
   bool hierarchical_allgather() const { return hier_allgather_.load(); }
   bool cache_enabled() const { return cache_enabled_.load(); }
   bool compression_enabled() const { return compression_.load(); }
+  int64_t ring_segment_bytes() const { return ring_segment_bytes_.load(); }
+  int ring_stripes() const { return ring_stripes_.load(); }
 
   bool tuning() const { return tuning_.load(); }
   double best_score() const { return best_score_.load(); }  // bytes/sec
@@ -84,6 +94,8 @@ class ParameterManager {
  private:
   struct Categorical {
     bool hier_allreduce, hier_allgather, cache_enabled, compression;
+    int64_t ring_segment_bytes;
+    int ring_stripes;
   };
 
   void ApplyPoint(const std::vector<double>& point);
@@ -115,6 +127,8 @@ class ParameterManager {
   std::atomic<bool> hier_allgather_;
   std::atomic<bool> cache_enabled_;
   std::atomic<bool> compression_;
+  std::atomic<int64_t> ring_segment_bytes_;
+  std::atomic<int> ring_stripes_;
   std::atomic<bool> tuning_;
   std::atomic<double> best_score_;
 

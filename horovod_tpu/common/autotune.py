@@ -125,7 +125,9 @@ class ParameterManager:
                  fusion_threshold_bytes=64 * 1024 * 1024, cycle_time_ms=1.0,
                  hierarchical_allreduce=False, hierarchical_allgather=False,
                  cache_enabled=True, compression=False,
-                 compression_available=False):
+                 compression_available=False,
+                 ring_segment_bytes=1 << 20, ring_stripes=2,
+                 ring_tunable=False):
         self._lib = _lib()
         self._h = self._lib.hvd_pm_create(
             warmup_samples, steady_state_samples, bayes_opt_max_samples,
@@ -135,7 +137,9 @@ class ParameterManager:
             1 if hierarchical_allgather else 0,
             1 if cache_enabled else 0,
             1 if compression else 0,
-            1 if compression_available else 0)
+            1 if compression_available else 0,
+            int(ring_segment_bytes), int(ring_stripes),
+            1 if ring_tunable else 0)
 
     def record(self, nbytes):
         self._lib.hvd_pm_record(self._h, int(nbytes))
@@ -166,6 +170,14 @@ class ParameterManager:
     @property
     def compression_enabled(self):
         return bool(self._lib.hvd_pm_compression_enabled(self._h))
+
+    @property
+    def ring_segment_bytes(self):
+        return int(self._lib.hvd_pm_ring_segment_bytes(self._h))
+
+    @property
+    def ring_stripes(self):
+        return int(self._lib.hvd_pm_ring_stripes(self._h))
 
     @property
     def tuning(self):

@@ -36,6 +36,11 @@ class Config:
     # "fp16" | "int8") for requests that don't pass one explicitly;
     # autotune may toggle it between the configured value and "none".
     compression: str = "none"
+    # TCP-ring transfer engine (docs/tuning.md): pipeline segment size
+    # in bytes (0 = unsegmented) and dedicated bulk connections per
+    # peer.  Both join the autotune walk in tcp mode.
+    ring_segment_bytes: int = env_util.DEFAULT_RING_SEGMENT_BYTES
+    ring_stripes: int = env_util.DEFAULT_RING_STRIPES
     # Fault-tolerant runtime knobs (docs/fault_tolerance.md): bound on
     # abort propagation, heartbeat period, missed-heartbeat window
     # (0 disables liveness tracking), and the deterministic fault spec.
@@ -85,6 +90,12 @@ class Config:
                 env_util.HVD_ADASUM_HIERARCHICAL),
             compression=_validated_compression(env_util.get_str(
                 env_util.HVD_TPU_COMPRESSION, "none")),
+            ring_segment_bytes=_validated_nonneg(
+                env_util.HVD_TPU_RING_SEGMENT_BYTES,
+                env_util.DEFAULT_RING_SEGMENT_BYTES),
+            ring_stripes=max(1, env_util.get_int(
+                env_util.HVD_TPU_RING_STRIPES,
+                env_util.DEFAULT_RING_STRIPES)),
             abort_timeout_seconds=env_util.get_float(
                 env_util.HVD_TPU_ABORT_TIMEOUT,
                 env_util.DEFAULT_ABORT_TIMEOUT_SECONDS),
@@ -109,6 +120,15 @@ def effective_heartbeat_interval(config) -> float:
         interval = min(interval or 1e9,
                        config.abort_timeout_seconds / 4.0)
     return interval
+
+
+def _validated_nonneg(name, default):
+    """Negative byte counts would silently disable segmentation in a
+    surprising way; fail at init() like the other validated knobs."""
+    value = env_util.get_int(name, default)
+    if value < 0:
+        raise ValueError(f"{name} must be >= 0, got {value}")
+    return value
 
 
 def _validated_fault_spec(text):

@@ -35,6 +35,8 @@ def default_params(config):
     """The untuned knob view every controller's ``tuned_params()``
     reports when autotune is off — ONE definition so the surface cannot
     drift between controllers."""
+    from horovod_tpu.utils import env as env_util
+
     return {
         "fusion_threshold_bytes": config.fusion_threshold_bytes,
         "cycle_time_ms": config.cycle_time_ms,
@@ -42,6 +44,11 @@ def default_params(config):
         "hierarchical_allgather": config.hierarchical_allgather,
         "cache_enabled": True,
         "compression": getattr(config, "compression", "none"),
+        "ring_segment_bytes": getattr(
+            config, "ring_segment_bytes",
+            env_util.DEFAULT_RING_SEGMENT_BYTES),
+        "ring_stripes": getattr(config, "ring_stripes",
+                                env_util.DEFAULT_RING_STRIPES),
         "tuning": False,
         "best_score_bytes_per_sec": 0.0,
     }
@@ -73,7 +80,18 @@ class AutotuneManager:
         # excluded from the walk entirely.
         self._compression = str(getattr(config, "compression", "none"))
         comp_on = self._compression != "none"
+        # The ring transfer-engine knobs only steer the tcp data plane;
+        # tuning them on the in-process controllers would burn walk
+        # budget on inert parameters.
+        from horovod_tpu.utils import env as env_util
+        ring_tunable = getattr(config, "controller", "native") == "tcp"
         self._pm = ParameterManager(
+            ring_segment_bytes=int(getattr(
+                config, "ring_segment_bytes",
+                env_util.DEFAULT_RING_SEGMENT_BYTES)),
+            ring_stripes=int(getattr(config, "ring_stripes",
+                                     env_util.DEFAULT_RING_STRIPES)),
+            ring_tunable=ring_tunable,
             warmup_samples=int(
                 getattr(config, "autotune_warmup_samples", 3)),
             steady_state_samples=int(
@@ -134,6 +152,8 @@ class AutotuneManager:
             "cache_enabled": pm.cache_enabled,
             "compression": (self._compression if pm.compression_enabled
                             else "none"),
+            "ring_segment_bytes": pm.ring_segment_bytes,
+            "ring_stripes": pm.ring_stripes,
             "tuning": pm.tuning,
             "best_score_bytes_per_sec": pm.best_score,
         }

@@ -151,7 +151,9 @@ def _load_lib():
                                   ctypes.c_double, ctypes.c_char_p,
                                   ctypes.c_int64, ctypes.c_double,
                                   ctypes.c_int, ctypes.c_int, ctypes.c_int,
-                                  ctypes.c_int, ctypes.c_int]
+                                  ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int64, ctypes.c_int,
+                                  ctypes.c_int]
     lib.hvd_pm_destroy.argtypes = [ctypes.c_void_p]
     lib.hvd_pm_record.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.hvd_pm_update.restype = ctypes.c_int
@@ -162,9 +164,12 @@ def _load_lib():
     lib.hvd_pm_cycle_ms.argtypes = [ctypes.c_void_p]
     for fn in ("hvd_pm_hierarchical_allreduce",
                "hvd_pm_hierarchical_allgather", "hvd_pm_cache_enabled",
-               "hvd_pm_compression_enabled", "hvd_pm_tuning"):
+               "hvd_pm_compression_enabled", "hvd_pm_tuning",
+               "hvd_pm_ring_stripes"):
         getattr(lib, fn).restype = ctypes.c_int
         getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.hvd_pm_ring_segment_bytes.restype = ctypes.c_int64
+    lib.hvd_pm_ring_segment_bytes.argtypes = [ctypes.c_void_p]
     lib.hvd_pm_best_score.restype = ctypes.c_double
     lib.hvd_pm_best_score.argtypes = [ctypes.c_void_p]
     return lib

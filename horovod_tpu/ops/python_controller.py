@@ -175,6 +175,14 @@ class PythonController:
             # pass one explicitly; requests already in flight keep the
             # compression they were submitted with
             self._config.compression = params["compression"]
+        # ring transfer-engine knobs: inert on the in-process planes,
+        # but kept in config so tuned_params() reports one consistent
+        # surface across controllers
+        if "ring_segment_bytes" in params:
+            self._config.ring_segment_bytes = \
+                int(params["ring_segment_bytes"])
+        if "ring_stripes" in params:
+            self._config.ring_stripes = int(params["ring_stripes"])
 
     def enqueue(self, request: EagerRequest):
         with self._lock:

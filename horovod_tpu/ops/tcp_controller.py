@@ -83,7 +83,8 @@ class ResultMsg:
     def __init__(self, payload=None, shape=None, dtype=None, error=None,
                  recv_splits=None, ring_go=False, participants=None,
                  dims0=None, ring_id=None, params_seq=0, params=None,
-                 resend=False, compression="none", aborted=None):
+                 resend=False, compression="none", aborted=None,
+                 ring_segment_bytes=None):
         self.payload = payload
         self.shape = shape
         self.dtype = dtype
@@ -98,6 +99,11 @@ class ResultMsg:
         self.resend = resend    # ring infeasible: resubmit with payload
         self.compression = compression  # coordinator-resolved wire format
         self.aborted = aborted  # (origin_rank, reason) coordinated abort
+        # coordinator-resolved pipeline segment size for THIS round
+        # (None: every rank uses its identical launch-env value) — both
+        # ring endpoints must derive the same segment plan even while a
+        # tuned value propagates
+        self.ring_segment_bytes = ring_segment_bytes
 
 
 class JoinMsg:
@@ -434,6 +440,19 @@ class CoordinatorService(network.MuxService):
         self._sig_cache.store(
             name, (r.sig for r in entry.requests.values()))
 
+    def _ring_seg(self):
+        """Coordinator-resolved pipeline segment size for a ring round:
+        the latest published tuned value, or None before any
+        publication (all ranks then share the identical launch-env
+        value).  Stamped onto every ring_go so both endpoints of every
+        hop derive the same segment plan even while a tuned value is
+        still propagating rank by rank."""
+        published = self._published
+        if published is not None \
+                and "ring_segment_bytes" in published[1]:
+            return int(published[1]["ring_segment_bytes"])
+        return None
+
     def _execute(self, name, entry):
         reqs = entry.requests
         first = next(iter(reqs.values()))
@@ -493,17 +512,19 @@ class CoordinatorService(network.MuxService):
                 return {r: ResultMsg(ring_go=True,
                                      participants=participants,
                                      ring_id=self._ring_seq,
-                                     compression=comp)
+                                     compression=comp,
+                                     ring_segment_bytes=self._ring_seg())
                         for r in reqs}
             if ring and rtype == RequestType.ADASUM:
                 participants = sorted(reqs.keys())
                 p = len(participants)
                 if p == self._size and p & (p - 1) == 0:
                     self._ring_seq += 1
-                    return {r: ResultMsg(ring_go=True,
-                                         participants=participants,
-                                         ring_id=self._ring_seq)
-                            for r in reqs}
+                    return {r: ResultMsg(
+                        ring_go=True, participants=participants,
+                        ring_id=self._ring_seq,
+                        ring_segment_bytes=self._ring_seg())
+                        for r in reqs}
                 # joined ranks (zero stand-ins at world tree positions)
                 # or non-power-of-two world: only the payload path keeps
                 # the reference tree semantics — uniform resend
@@ -535,7 +556,8 @@ class CoordinatorService(network.MuxService):
                 self._ring_seq += 1
                 return {r: ResultMsg(ring_go=True,
                                      participants=participants,
-                                     dims0=dims0, ring_id=self._ring_seq)
+                                     dims0=dims0, ring_id=self._ring_seq,
+                                     ring_segment_bytes=self._ring_seg())
                         for r in reqs}
             out = np.concatenate(
                 [_decode(reqs[r]) for r in sorted(reqs)], axis=0)
@@ -562,7 +584,8 @@ class CoordinatorService(network.MuxService):
                 self._ring_seq += 1
                 return {r: ResultMsg(ring_go=True,
                                      participants=participants,
-                                     ring_id=self._ring_seq)
+                                     ring_id=self._ring_seq,
+                                     ring_segment_bytes=self._ring_seg())
                         for r in reqs}
             out = _decode(reqs[first.root_rank])
             return {r: _encode(out) for r in reqs}
@@ -724,8 +747,11 @@ class TcpController:
             http_client.put(addr, int(port), PEERS_SCOPE, str(self._rank),
                             ";".join(f"{i}={ip}:{p}"
                                      for i, ip, p in tagged).encode())
-            self._ring = RingPlane(self._rank, self._peer_service,
-                                   self._resolve_peer)
+            self._ring = RingPlane(
+                self._rank, self._peer_service, self._resolve_peer,
+                resolve_bulk=self._resolve_stripe,
+                segment_bytes=self._config.ring_segment_bytes,
+                stripes=self._config.ring_stripes)
 
         # peer liveness: a background heartbeat per worker keeps the
         # coordinator's last-seen table fresh AND carries the abort
@@ -773,6 +799,16 @@ class TcpController:
 
     def _resolve_peer(self, rank):
         return network.MuxClient(
+            self._peer_addrs(rank, env_util.get_float(
+                env_util.HVD_START_TIMEOUT, 120.0)),
+            self._key, timeout=30)
+
+    def _resolve_stripe(self, rank):
+        """One dedicated bulk-data connection to ``rank``'s mailbox —
+        the ring opens up to HVD_TPU_RING_STRIPES of these per peer,
+        so chunk segments never share a socket (or a write lock) with
+        control traffic."""
+        return network.StripeClient(
             self._peer_addrs(rank, env_util.get_float(
                 env_util.HVD_START_TIMEOUT, 120.0)),
             self._key, timeout=30)
@@ -1088,6 +1124,10 @@ class TcpController:
                    or (self._config.abort_timeout_seconds * 4
                        if self._config.abort_timeout_seconds > 0
                        else None))
+        # coordinator-resolved segment size for this round (None until
+        # a tuned value is published): both endpoints of every ring hop
+        # must slice identically, whatever this rank last applied
+        seg = getattr(resp, "ring_segment_bytes", None)
         try:
             if rtype == RequestType.ALLREDUCE:
                 out = self._ring.allreduce(
@@ -1096,21 +1136,27 @@ class TcpController:
                     world_size=self._size,
                     prescale=request.prescale_factor,
                     postscale=request.postscale_factor, timeout=timeout,
-                    compression=getattr(resp, "compression", "none"))
+                    compression=getattr(resp, "compression", "none"),
+                    segment_bytes=seg)
             elif rtype == RequestType.ADASUM:
                 out = self._ring.adasum(
-                    resp.ring_id, arr, resp.participants, timeout=timeout)
+                    resp.ring_id, arr, resp.participants, timeout=timeout,
+                    segment_bytes=seg)
             elif rtype == RequestType.BROADCAST:
                 out = self._ring.broadcast(
                     resp.ring_id,
                     arr if self._rank == request.root_rank else None,
                     resp.participants, request.root_rank,
                     shape=tuple(arr.shape), dtype=arr.dtype.name,
-                    timeout=timeout)
+                    timeout=timeout, segment_bytes=seg)
             else:  # ALLGATHER
-                blocks = self._ring.allgather(
-                    resp.ring_id, arr, resp.participants, timeout=timeout)
                 trailing = arr.shape[1:]
+                per_row = int(np.prod(trailing or (1,))) \
+                    * arr.dtype.itemsize
+                blocks = self._ring.allgather(
+                    resp.ring_id, arr, resp.participants,
+                    block_nbytes=[d * per_row for d in resp.dims0],
+                    timeout=timeout, segment_bytes=seg)
                 parts = [np.frombuffer(
                     b, dtype=arr.dtype).reshape((d,) + trailing)
                     for b, d in zip(blocks, resp.dims0)]
@@ -1190,6 +1236,20 @@ class TcpController:
             self._config.cycle_time_ms = params["cycle_time_ms"]
             if "compression" in params:
                 self._config.compression = params["compression"]
+            # ring transfer-engine knobs: every rank of a collective
+            # receives the same (seq, params) stamp with its ring_go
+            # and applies it BEFORE running the ring, so the segment
+            # plan both endpoints derive stays identical within a round
+            if "ring_segment_bytes" in params:
+                self._config.ring_segment_bytes = \
+                    int(params["ring_segment_bytes"])
+                if self._ring is not None:
+                    self._ring.segment_bytes = \
+                        int(params["ring_segment_bytes"])
+            if "ring_stripes" in params:
+                self._config.ring_stripes = int(params["ring_stripes"])
+                if self._ring is not None:
+                    self._ring.stripes = int(params["ring_stripes"])
 
     def tuned_params(self):
         """Same surface as the native controller (reference:

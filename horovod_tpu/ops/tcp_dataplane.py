@@ -5,47 +5,78 @@ Round 1's tcp mode shipped every payload through the rank-0 coordinator
 does better: Gloo runs ring allreduce between workers
 (``gloo_operations.cc:30-100``).  This module is that ring, built on the
 HMAC mux transport: every worker runs a :class:`PeerService` (a chunk
-mailbox) and keeps ONE persistent connection to each neighbor it talks
+mailbox) and keeps persistent connections to each neighbor it talks
 to.  Large collectives negotiate metadata through the coordinator as
 usual, then move bytes rank-to-rank:
 
 - **allreduce**: ring reduce-scatter + ring allgather — each rank moves
   ~2·bytes·(P−1)/P regardless of P, no hot spot (the classic
   Baidu/Horovod ring the reference popularized).
-- **broadcast**: chunked pipeline around the ring from the root — the
+- **broadcast**: segmented pipeline around the ring from the root — the
   root uploads each byte once instead of N−1 times.
 - **allgather**: ring block rotation (N−1 forwarding steps).
 
-Accumulation is float64/int64 like the coordinator star path.  The
-two planes are rank-consistent but not bitwise-identical to each other
-for floats: the ring reduces each chunk in ring-rotation order while
-the star sums in ascending rank order, and float addition is not
-associative — a tensor crossing HVD_TCP_RING_THRESHOLD can change in
-the last ulp.
+Transfer engine (round 3):
+
+- **Native wire dtypes** — chunk bytes ship in the tensor's input dtype
+  (fp32/bf16/int32/...); float64/int64 accumulation is strictly local
+  to each rank.  A fp32 allreduce moves half the bytes the
+  f64-on-the-wire seed moved (bf16: a quarter).  Rank-consistency is
+  preserved the same way the compressed path always did it: the owner
+  of each reduced chunk encodes it ONCE and the allgather leg rotates
+  the encoded blob verbatim, so every rank decodes identical bytes.
+  Integer dtypes stay exact: partial sums wrap modulo 2^width on the
+  wire, and modular addition is associative, so the final
+  cast-to-input-dtype result equals the wide-accumulator sum.
+- **Segment pipelining** — each ring step's chunk is split into
+  ``HVD_TPU_RING_SEGMENT_BYTES`` segments driven through a dedicated
+  sender thread, so the send of segment k+1 overlaps the recv +
+  accumulate of segment k (double-buffered; both the exact and the
+  compressed legs).
+- **Socket striping** — bulk segments ride a pool of
+  ``HVD_TPU_RING_STRIPES`` dedicated raw-frame connections per peer
+  (:class:`network.StripeClient`), separate from the control
+  ``MuxClient``: heartbeats, negotiation and abort fan-out never queue
+  behind a multi-MB chunk write, and high-BDP links get multi-stream
+  throughput.  Abort/purge wake and drain every stripe — blocked recvs
+  all wait on the one mailbox condition the abort signals.
+
+The two planes are rank-consistent but not bitwise-identical to each
+other for floats: the ring reduces each chunk in ring-rotation order
+at wire precision while the star sums in ascending rank order in
+float64 — a tensor crossing HVD_TCP_RING_THRESHOLD can change in the
+last ulps.
 """
 
 import collections
+import queue
 import threading
 
 import numpy as np
 
 from horovod_tpu.common import faults
 from horovod_tpu.common.handles import HvdAbortedError
-from horovod_tpu.common.ops_enum import INT8_BLOCK
+from horovod_tpu.common.ops_enum import INT8_BLOCK, is_float_dtype
 from horovod_tpu.run.service import network
+from horovod_tpu.utils import env as env_util
 
 # payloads at or above this ride the ring; below it the coordinator star
 # round-trip is latency-optimal (one RTT, no rendezvous fan-out)
 DEFAULT_RING_THRESHOLD = 1 << 20
-# broadcast pipeline chunk
+# broadcast pipeline chunk when segmenting is disabled
 BCAST_CHUNK = 1 << 22
+# pipeline segment size / bulk connections per peer (tunable:
+# HVD_TPU_RING_SEGMENT_BYTES / HVD_TPU_RING_STRIPES, docs/tuning.md)
+DEFAULT_SEGMENT_BYTES = env_util.DEFAULT_RING_SEGMENT_BYTES
+DEFAULT_STRIPES = env_util.DEFAULT_RING_STRIPES
 
 
 # ------------------------------------------------------- compressed codecs
-# enc(float64 1-D chunk) -> wire bytes; dec(blob, n) -> float64 [n].
+# enc(float64 1-D chunk) -> wire bytes; dec(blob, n) -> float32-ish [n];
+# nbytes(n) -> deterministic blob size (sender and receiver derive the
+# segment count from it independently, so no size header travels).
 # int8 blobs are [ceil(n/256) fp32 scales][ceil(n/256)*256 int8 values]
-# (~27% of the fp64-path's fp32-equivalent bytes); cast codecs are plain
-# dtype reinterpretations.
+# (~27% of fp32 bytes); cast codecs are plain dtype reinterpretations.
 def _enc_int8(chunk):
     # all math in float32 with in-place rint/clip: the encoder sits on
     # the ring's critical path and f64 temporaries double its memory
@@ -72,13 +103,18 @@ def _enc_int8(chunk):
 
 def _dec_int8(blob, n):
     nb = -(-n // INT8_BLOCK)
-    scale = np.frombuffer(blob[:nb * 4], np.float32)
+    scale = np.frombuffer(blob, np.float32, count=nb)
     q = np.frombuffer(blob, np.int8, offset=nb * 4).reshape(
         nb, INT8_BLOCK).astype(np.float32)
     # float32 out: these ARE the wire values (int8 x fp32 scale); the
     # caller's float64 accumulator upcasts on +=
     q *= scale[:, None]
     return q.reshape(-1)[:n]
+
+
+def _int8_nbytes(n):
+    nb = -(-n // INT8_BLOCK)
+    return nb * 4 + nb * INT8_BLOCK
 
 
 def _cast_codec(wire_dtype):
@@ -90,9 +126,12 @@ def _cast_codec(wire_dtype):
     def dec(blob, n):
         # float32 is exact for bf16/fp16 wire values; the caller's
         # float64 accumulator upcasts on +=
-        return np.frombuffer(blob, dtype=dt)[:n].astype(np.float32)
+        return np.frombuffer(blob, dtype=dt, count=n).astype(np.float32)
 
-    return enc, dec
+    def nbytes(n):
+        return n * dt.itemsize
+
+    return enc, dec, nbytes
 
 
 def _codecs():
@@ -102,10 +141,37 @@ def _codecs():
     import ml_dtypes
 
     return {
-        "int8": (_enc_int8, _dec_int8),
+        "int8": (_enc_int8, _dec_int8, _int8_nbytes),
         "bf16": _cast_codec(ml_dtypes.bfloat16),
         "fp16": _cast_codec(np.float16),
     }
+
+
+def _as_bytes_view(arr):
+    """Zero-copy raw-bytes view of a contiguous array — via a uint8
+    reinterpretation, because numpy refuses direct buffer export for
+    ml_dtypes extension dtypes (bfloat16)."""
+    return arr.view(np.uint8).data
+
+
+def _wire_spec(dtype, prescale, widen):
+    """(wire dtype, accumulator dtype) for the exact ring path.
+
+    Floats wire natively and accumulate in f64.  Integers wire natively
+    and accumulate in int64 — modular wrap on the wire is exact for the
+    final input-dtype result, but ONLY for a pure sum: ``widen`` (an
+    average or postscale, which read the true wide total before the
+    cast back) keeps int64 on the wire like the seed did, and a
+    prescale promotes the math to float entirely, so f64 wires (exact
+    for every integer the cast back can represent)."""
+    dt = np.dtype(dtype)
+    if is_float_dtype(dt):
+        return dt, np.float64
+    if prescale != 1.0:
+        return np.dtype(np.float64), np.float64
+    if widen:
+        return np.dtype(np.int64), np.int64
+    return dt, np.int64
 
 
 class ChunkMsg:
@@ -118,8 +184,9 @@ class ChunkMsg:
 
 
 class PeerService(network.MuxService):
-    """Per-worker chunk mailbox: peers push ``ChunkMsg`` frames; the
-    local compute thread collects them by tag."""
+    """Per-worker chunk mailbox: peers push ``ChunkMsg`` frames (pickled
+    small ones on the control connection, raw bulk frames on the
+    stripes); the local compute thread collects them by tag."""
 
     NAME = "horovod_tpu peer"
 
@@ -133,6 +200,9 @@ class PeerService(network.MuxService):
     def __init__(self, key):
         self._cv = threading.Condition()
         self._mailbox = {}   # (tag, src) -> payload
+        # ring-id index over the mailbox: purge and the late-chunk drop
+        # check are O(chunks of that ring), not O(total mailbox)
+        self._by_ring = {}   # ring_id -> set of mailbox keys
         self._purged = collections.OrderedDict()  # ring_id -> None (LRU)
         self._aborted = None  # (origin_rank, reason) once abort observed
         # set by the controller: called (origin, reason) when a PEER
@@ -147,7 +217,9 @@ class PeerService(network.MuxService):
                 if self._aborted is not None \
                         or req.tag[0] in self._purged:
                     return network.AckResponse()  # aborted round, drop
-                self._mailbox[(req.tag, req.src)] = req.payload
+                key = (req.tag, req.src)
+                self._mailbox[key] = req.payload
+                self._by_ring.setdefault(req.tag[0], set()).add(key)
                 self._cv.notify_all()
             return network.AckResponse()
         if isinstance(req, network.AbortMsg):
@@ -160,14 +232,22 @@ class PeerService(network.MuxService):
             return network.AckResponse()
         return super()._handle(req, client_address)
 
-    def recv(self, tag, src, timeout=None):
+    def recv(self, tag, src, timeout=None, error_check=None):
+        """``error_check`` (optional, called with the condition held on
+        every wakeup) raises to fail this recv on a local error — the
+        ring plane uses it so a blocked recv dies as soon as its own
+        sender thread reports a broken stripe, instead of waiting out
+        the timeout for segments the peer will never get to send."""
         import time as _time
 
         deadline = (_time.monotonic() + timeout) if timeout else None
+        key = (tag, src)
         with self._cv:
-            while (tag, src) not in self._mailbox:
+            while key not in self._mailbox:
                 if self._aborted is not None:
                     raise HvdAbortedError(*self._aborted)
+                if error_check is not None:
+                    error_check()
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - _time.monotonic()
@@ -176,45 +256,81 @@ class PeerService(network.MuxService):
                             f"no chunk {tag!r} from rank {src} within "
                             f"{timeout}s")
                 self._cv.wait(timeout=remaining)
-            return self._mailbox.pop((tag, src))
+            ring_keys = self._by_ring.get(tag[0])
+            if ring_keys is not None:
+                ring_keys.discard(key)
+                if not ring_keys:
+                    del self._by_ring[tag[0]]
+            return self._mailbox.pop(key)
 
     def purge(self, ring_id):
         """Drop chunks of an aborted collective round (its tags lead with
         the coordinator-assigned ring id, so a retry — which gets a NEW
-        id — can never consume stale data)."""
+        id — can never consume stale data).  O(chunks of this ring) via
+        the ring-id index, not a scan of every mailbox key."""
         with self._cv:
             self._purged[ring_id] = None
             self._purged.move_to_end(ring_id)
             while len(self._purged) > self._PURGED_KEEP:
                 self._purged.popitem(last=False)
-            for key in [k for k in self._mailbox if k[0][0] == ring_id]:
-                del self._mailbox[key]
+            for key in self._by_ring.pop(ring_id, ()):
+                self._mailbox.pop(key, None)
 
     def abort(self, origin_rank, reason):
         """Coordinated abort observed: fail every blocked ``recv`` with
         the typed error, drop all buffered chunks and refuse new ones —
-        no mailbox state survives the abort (sticky; the job is over)."""
+        no mailbox state survives the abort (sticky; the job is over).
+        Recvs blocked on stripe-delivered segments wake too: every recv
+        waits on this one condition regardless of which connection the
+        bytes would have arrived on."""
         with self._cv:
             if self._aborted is not None:
                 return
             self._aborted = (origin_rank, reason)
             self._mailbox.clear()
+            self._by_ring.clear()
             self._cv.notify_all()
 
 
 class RingPlane:
     """This process's endpoint of the worker ring."""
 
-    def __init__(self, rank, service, resolve_peer):
-        """``resolve_peer(rank) -> MuxClient`` (lazy, cached)."""
+    def __init__(self, rank, service, resolve_peer, resolve_bulk=None, *,
+                 segment_bytes=None, stripes=None):
+        """``resolve_peer(rank) -> MuxClient`` (control; lazy, cached).
+        ``resolve_bulk(rank) -> StripeClient`` builds one bulk-data
+        stripe (called up to ``stripes`` times per peer; None routes
+        bulk frames through the control client's bulk companion —
+        still a dedicated socket, just a single one)."""
         self.rank = rank
         self._service = service
         self._resolve = resolve_peer
+        self._resolve_bulk = resolve_bulk
         self._clients = {}
+        self._stripe_pools = {}   # rank -> [StripeClient | None]
         self._lock = threading.Lock()
+        self.segment_bytes = (env_util.get_int(
+            env_util.HVD_TPU_RING_SEGMENT_BYTES, DEFAULT_SEGMENT_BYTES)
+            if segment_bytes is None else int(segment_bytes))
+        self.stripes = (env_util.get_int(
+            env_util.HVD_TPU_RING_STRIPES, DEFAULT_STRIPES)
+            if stripes is None else int(stripes))
+        self._sendq = queue.Queue()
+        self._sender = None
+        self._send_error = None   # latest async send failure (sticky)
+        self._pending_sends = 0   # enqueued-but-unwritten segments
+        self._pending_cv = threading.Condition()
+        self._closed = False
 
+    # ------------------------------------------------------------ transport
     def _peer(self, rank):
         with self._lock:
+            if self._closed:
+                # the sender thread may still be draining queued
+                # segments when close() empties the pools — refusing
+                # here stops it from repopulating them with fresh
+                # connections nobody would ever close
+                raise ConnectionError("ring plane closed")
             client = self._clients.get(rank)
             if client is None:
                 client = self._clients[rank] = self._resolve(rank)
@@ -227,10 +343,35 @@ class RingPlane:
         with self._lock:
             return self._clients.get(rank)
 
-    def send(self, dst, tag, payload: bytes):
+    def bytes_sent(self):
+        """Wire bytes this plane has written (control posts + bulk
+        stripes, framing included) — the byte-accounting surface the
+        wire-efficiency tests measure."""
+        with self._lock:
+            total = sum(c.bytes_sent for c in self._clients.values())
+            total += sum(s.bytes_sent for pool in
+                         self._stripe_pools.values()
+                         for s in pool if s is not None)
+        return total
+
+    def _stripe(self, dst, index):
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("ring plane closed")
+            n = max(1, int(self.stripes))
+            pool = self._stripe_pools.setdefault(dst, [])
+            i = index % n
+            while len(pool) <= i:
+                pool.append(self._resolve_bulk(dst)
+                            if self._resolve_bulk is not None else None)
+            return pool[i]
+
+    def send(self, dst, tag, payload):
         # fire-and-forget: the mailbox is tag-keyed, so ordering doesn't
         # need acks, and ring steps stay bandwidth-bound (no ack RTT on
-        # the critical path)
+        # the critical path).  This is the seed-era unsegmented path —
+        # kept for the reference (seed-parity) collectives and small
+        # control-sized chunks.
         if faults.check("send"):
             return  # injected drop: the chunk vanishes on the wire
         self._peer(dst).post(ChunkMsg(tag, self.rank, payload))
@@ -241,28 +382,304 @@ class RingPlane:
                 f"no chunk {tag!r} from rank {src} (injected recv fault)")
         return self._service.recv(tag, src, timeout=timeout)
 
+    # --------------------------------------------------- segment pipeline
+    @staticmethod
+    def _segment_plan(nbytes, seg_bytes, align):
+        """(segment size, segment count) — derived identically on the
+        send and recv side from the chunk's wire size, the segment knob
+        and the wire itemsize (every segment but the last is a multiple
+        of ``align`` so per-segment decode never splits an element)."""
+        nbytes = int(nbytes)
+        if seg_bytes <= 0 or nbytes <= seg_bytes:
+            return max(nbytes, 1), 1
+        size = max(align, (int(seg_bytes) // align) * align)
+        return size, -(-nbytes // size)
+
+    def _sender_loop(self):
+        while True:
+            item = self._sendq.get()
+            if item is None:
+                return
+            dst, stripe_i, msg, payload = item
+            try:
+                stripe = self._stripe(dst, stripe_i)
+                if stripe is not None:
+                    stripe.post_bulk(msg, payload)
+                else:
+                    self._peer(dst).post_bulk(msg, payload)
+            except Exception as exc:  # noqa: BLE001 — surface on the
+                # compute thread: its next send/recv of any round fails
+                # fast instead of waiting out the recv timeout
+                self._send_error = exc
+                # a recv already blocked on the mailbox must wake NOW:
+                # its error_check re-raises this under the condition
+                with self._service._cv:
+                    self._service._cv.notify_all()
+            finally:
+                with self._pending_cv:
+                    self._pending_sends -= 1
+                    self._pending_cv.notify_all()
+
+    def _raise_if_send_failed(self):
+        if self._send_error is not None:
+            raise ConnectionError(
+                f"ring bulk send failed: {self._send_error}")
+
+    def _enqueue_segment(self, dst, stripe_i, tag, payload):
+        if self._sender is None:
+            with self._lock:
+                if self._sender is None and not self._closed:
+                    self._sender = threading.Thread(
+                        target=self._sender_loop, daemon=True,
+                        name="hvd-ring-sender")
+                    self._sender.start()
+        with self._pending_cv:
+            self._pending_sends += 1
+        self._sendq.put(
+            (dst, stripe_i, ChunkMsg(tag, self.rank, None), payload))
+
+    def _flush_sends(self, timeout=None):
+        """Block until every enqueued segment has been WRITTEN to its
+        socket.  Every collective ends with this: fire-and-forget must
+        not outlive the collective call — a rank whose process exits
+        right after a broadcast/allreduce returns would otherwise race
+        its own sender thread and strand peers waiting on segments that
+        were never written."""
+        import time as _time
+
+        deadline = (_time.monotonic() + timeout) if timeout else None
+        with self._pending_cv:
+            while self._pending_sends > 0:
+                self._raise_if_send_failed()
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"{self._pending_sends} ring segments still "
+                            f"unsent after {timeout}s")
+                self._pending_cv.wait(timeout=remaining)
+        self._raise_if_send_failed()
+
+    def send_chunk(self, dst, base_tag, payload, seg_bytes=None,
+                   align=1):
+        """Split ``payload`` into pipeline segments, round-robined over
+        the stripe pool via the dedicated sender thread — returns
+        immediately so the caller's recv+accumulate of the incoming
+        chunk overlaps the outgoing writes."""
+        if faults.check("send"):
+            return  # injected drop: the whole chunk vanishes
+        self._raise_if_send_failed()
+        seg = self.segment_bytes if seg_bytes is None else seg_bytes
+        mv = memoryview(payload).cast("B")
+        size, n_seg = self._segment_plan(mv.nbytes, seg, align)
+        for k in range(n_seg):
+            self._enqueue_segment(dst, k, base_tag + (k,),
+                                  mv[k * size:(k + 1) * size])
+
+    def recv_chunk(self, base_tag, src, nbytes, timeout=None,
+                   consume=None, seg_bytes=None, align=1):
+        """Receive the pipeline segments of one chunk.  ``nbytes`` is
+        the chunk's deterministic wire size (both sides derive the
+        segment count from it — no size header travels).  With
+        ``consume(offset, segment)`` each segment is handed over as it
+        arrives (overlapping the peer's remaining sends) and None is
+        returned; otherwise the reassembled bytes are returned."""
+        if faults.check("recv"):
+            raise TimeoutError(
+                f"no chunk {base_tag!r} from rank {src} "
+                f"(injected recv fault)")
+        seg = self.segment_bytes if seg_bytes is None else seg_bytes
+        _, n_seg = self._segment_plan(nbytes, seg, align)
+        parts = [] if consume is None else None
+        offset = 0
+        for k in range(n_seg):
+            segment = self._service.recv(
+                base_tag + (k,), src, timeout=timeout,
+                error_check=self._raise_if_send_failed)
+            if consume is None:
+                parts.append(segment)
+            else:
+                consume(offset, segment)
+            offset += len(segment)
+        if consume is None:
+            return parts[0] if len(parts) == 1 else b"".join(parts)
+        return None
+
     def close(self):
         with self._lock:
-            for client in self._clients.values():
-                client.close()
+            self._closed = True
+            clients = list(self._clients.values())
             self._clients.clear()
+            stripes = [s for pool in self._stripe_pools.values()
+                       for s in pool if s is not None]
+            self._stripe_pools.clear()
+            sender = self._sender
+            self._sender = None
+        if sender is not None:
+            self._sendq.put(None)
+            sender.join(timeout=5)
+        for client in clients:
+            client.close()
+        for stripe in stripes:
+            stripe.close()
 
     # ------------------------------------------------------------- allreduce
     def allreduce(self, ring_id, arr, participants, *, op_average,
                   world_size, prescale=1.0, postscale=1.0, timeout=None,
-                  compression="none"):
-        """Ring allreduce over ``participants`` (sorted rank ids; must
-        include ``self.rank``).  Joined ranks simply aren't in the ring —
-        their zero stand-ins are additive identities.
+                  compression="none", segment_bytes=None):
+        """Pipelined ring allreduce over ``participants`` (sorted rank
+        ids; must include ``self.rank``).  Joined ranks simply aren't in
+        the ring — their zero stand-ins are additive identities.
 
-        ``compression`` ("int8" / "bf16" / "fp16", floats only) moves
-        the bulk bytes in the compressed wire format; accumulation stays
-        float64 either way and integer dtypes always take the exact
-        path."""
+        Bulk bytes travel in the tensor's native dtype (or the
+        ``compression`` wire format: "int8" / "bf16" / "fp16", floats
+        only); accumulation stays float64/int64 LOCAL to each rank, and
+        every rank decodes the same reduced blobs, so the result is
+        identical on all ranks."""
         participants = sorted(participants)
         p = len(participants)
         idx = participants.index(self.rank)
-        from horovod_tpu.common.ops_enum import is_float_dtype
+
+        out_dtype = arr.dtype
+        float_in = is_float_dtype(arr.dtype)
+        wire_dt, acc_dtype = _wire_spec(
+            arr.dtype, prescale, widen=op_average or postscale != 1.0)
+        flat = arr.reshape(-1).astype(acc_dtype)
+        if prescale != 1.0:
+            flat = flat * prescale
+        codec = (_codecs().get(compression)
+                 if float_in and compression not in (None, "none") else None)
+        seg = (self.segment_bytes if segment_bytes is None
+               else int(segment_bytes))
+        if p == 1:
+            total = flat
+        elif codec is not None:
+            total = self._allreduce_compressed(ring_id, flat, participants,
+                                               idx, codec, timeout, seg)
+        else:
+            total = self._allreduce_exact(ring_id, flat, participants, idx,
+                                          wire_dt, acc_dtype, timeout, seg)
+        if op_average:
+            total = total / world_size
+        if postscale != 1.0:
+            total = total * postscale
+        return total.astype(out_dtype).reshape(arr.shape)
+
+    def _allreduce_exact(self, ring_id, flat, participants, idx, wire_dt,
+                         acc_dtype, timeout, seg):
+        """Native-wire-dtype pipelined ring.  Reduce-scatter leg: the
+        running partial sum of each chunk hops the ring at wire
+        precision (each hop decodes, adds its own contribution in the
+        wide local accumulator, re-encodes).  Allgather leg: the chunk's
+        owner encodes the reduced chunk ONCE and the rotation forwards
+        the blob verbatim — every rank (owner included) decodes the
+        same bytes, so the result is rank-consistent."""
+        p = len(participants)
+        right = participants[(idx + 1) % p]
+        left = participants[(idx - 1) % p]
+        chunks = np.array_split(flat, p)
+        sizes = [c.size for c in chunks]
+        item = wire_dt.itemsize
+
+        # reduce-scatter: after p-1 steps this rank owns the fully
+        # reduced chunk (idx+1) % p
+        for s in range(p - 1):
+            send_i = (idx - s) % p
+            recv_i = (idx - 1 - s) % p
+            out = chunks[send_i].astype(wire_dt)
+            self.send_chunk(right, (ring_id, "rs", s), _as_bytes_view(out),
+                            seg_bytes=seg, align=item)
+            target = chunks[recv_i]
+
+            def accumulate(offset, segment, target=target):
+                lo = offset // item
+                decoded = np.frombuffer(segment, dtype=wire_dt)
+                target[lo:lo + decoded.size] += decoded.astype(
+                    target.dtype, copy=False)
+
+            self.recv_chunk((ring_id, "rs", s), left,
+                            sizes[recv_i] * item, timeout=timeout,
+                            consume=accumulate, seg_bytes=seg, align=item)
+
+        # allgather: rotate the owner-encoded chunks p-1 times; blobs
+        # forward verbatim
+        owner = (idx + 1) % p
+        own_wire = chunks[owner].astype(wire_dt)
+        blobs = {owner: _as_bytes_view(own_wire)}
+        carry = owner
+        for s in range(p - 1):
+            self.send_chunk(right, (ring_id, "ag", s), blobs[carry],
+                            seg_bytes=seg, align=item)
+            recv_owner = (idx - s) % p
+            blobs[recv_owner] = self.recv_chunk(
+                (ring_id, "ag", s), left, sizes[recv_owner] * item,
+                timeout=timeout, seg_bytes=seg, align=item)
+            carry = recv_owner
+        self._flush_sends(timeout)
+        return np.concatenate([
+            np.frombuffer(blobs[i], dtype=wire_dt,
+                          count=sizes[i]).astype(acc_dtype)
+            for i in range(p)])
+
+    def _allreduce_compressed(self, ring_id, flat, participants, idx,
+                              codec, timeout, seg):
+        """Compressed bulk exchange (EQuARX-style block scaling mapped
+        onto the p2p transport).  Reduce-scatter leg: each rank encodes
+        its contribution to every destination chunk ONCE at the source
+        and ships it straight to the chunk's owner — same (p-1)/p bytes
+        per rank as the classic ring's reduce-scatter, but one
+        quantization per contribution instead of a requantize at every
+        hop.  The owner accumulates all contributions in float64,
+        encodes its reduced chunk once, and the allgather leg rotates
+        the compressed blobs around the ring verbatim.  Every rank
+        decodes the SAME blobs (the owner included), so the result stays
+        rank-consistent like the exact ring."""
+        enc, dec, enc_nbytes = codec
+        p = len(participants)
+        chunks = np.array_split(flat, p)
+        sizes = [c.size for c in chunks]
+        for d in range(p):
+            if d != idx:
+                self.send_chunk(participants[d], (ring_id, "qrs", d),
+                                enc(np.ascontiguousarray(chunks[d])),
+                                seg_bytes=seg)
+        acc = chunks[idx].astype(np.float64, copy=True)
+        for src_i, src in enumerate(participants):
+            if src_i == idx:
+                continue
+            blob = self.recv_chunk((ring_id, "qrs", idx), src,
+                                   enc_nbytes(sizes[idx]),
+                                   timeout=timeout, seg_bytes=seg)
+            acc += dec(blob, sizes[idx])
+        # allgather: rotate the compressed reduced chunks p-1 times
+        right = participants[(idx + 1) % p]
+        left = participants[(idx - 1) % p]
+        blobs = {idx: enc(np.ascontiguousarray(acc))}
+        carry = idx
+        for s in range(p - 1):
+            self.send_chunk(right, (ring_id, "qag", s), blobs[carry],
+                            seg_bytes=seg)
+            recv_owner = (idx - 1 - s) % p
+            blobs[recv_owner] = self.recv_chunk(
+                (ring_id, "qag", s), left, enc_nbytes(sizes[recv_owner]),
+                timeout=timeout, seg_bytes=seg)
+            carry = recv_owner
+        self._flush_sends(timeout)
+        return np.concatenate([dec(blobs[i], sizes[i]) for i in range(p)])
+
+    # ----------------------------------------------------- seed reference
+    def allreduce_seed(self, ring_id, arr, participants, *, op_average,
+                       world_size, prescale=1.0, postscale=1.0,
+                       timeout=None):
+        """The seed-era exact ring, verbatim: float64/int64 accumulator
+        bytes on the wire, strictly serial whole-chunk blocking steps on
+        the control connection.  Kept as the measured baseline for the
+        pipelined plane (bench leg ``allreduce_gbs_ring_pipelined``) and
+        as the oracle for the parity matrix — NOT used in production."""
+        participants = sorted(participants)
+        p = len(participants)
+        idx = participants.index(self.rank)
 
         out_dtype = arr.dtype
         float_in = is_float_dtype(arr.dtype)
@@ -270,19 +687,12 @@ class RingPlane:
         flat = arr.reshape(-1).astype(acc_dtype)
         if prescale != 1.0:
             flat = flat * prescale
-        codec = (_codecs().get(compression)
-                 if float_in and compression not in (None, "none") else None)
         if p == 1:
             total = flat
-        elif codec is not None:
-            total = self._allreduce_compressed(ring_id, flat, participants,
-                                               idx, codec, timeout)
         else:
             right = participants[(idx + 1) % p]
             left = participants[(idx - 1) % p]
             chunks = np.array_split(flat, p)
-            # reduce-scatter: after p-1 steps this rank owns the fully
-            # reduced chunk (idx+1) % p
             for s in range(p - 1):
                 send_i = (idx - s) % p
                 recv_i = (idx - 1 - s) % p
@@ -291,7 +701,6 @@ class RingPlane:
                 data = self.recv(((ring_id, "rs", s)), left, timeout=timeout)
                 chunks[recv_i] = chunks[recv_i] + np.frombuffer(
                     data, dtype=acc_dtype)
-            # allgather: rotate owned chunks p-1 times
             for s in range(p - 1):
                 send_i = (idx + 1 - s) % p
                 recv_i = (idx - s) % p
@@ -306,48 +715,9 @@ class RingPlane:
             total = total * postscale
         return total.astype(out_dtype).reshape(arr.shape)
 
-    def _allreduce_compressed(self, ring_id, flat, participants, idx,
-                              codec, timeout):
-        """Compressed bulk exchange (EQuARX-style block scaling mapped
-        onto the p2p transport).  Reduce-scatter leg: each rank encodes
-        its contribution to every destination chunk ONCE at the source
-        and ships it straight to the chunk's owner — same (p-1)/p bytes
-        per rank as the classic ring's reduce-scatter, but one
-        quantization per contribution instead of a requantize at every
-        hop.  The owner accumulates all contributions in float64,
-        encodes its reduced chunk once, and the allgather leg rotates
-        the compressed blobs around the ring verbatim.  Every rank
-        decodes the SAME blobs (the owner included), so the result stays
-        rank-consistent like the exact ring."""
-        enc, dec = codec
-        p = len(participants)
-        chunks = np.array_split(flat, p)
-        sizes = [c.size for c in chunks]
-        for d in range(p):
-            if d != idx:
-                self.send(participants[d], ((ring_id, "qrs", d)),
-                          enc(np.ascontiguousarray(chunks[d])))
-        acc = chunks[idx].astype(np.float64, copy=True)
-        for src_i, src in enumerate(participants):
-            if src_i == idx:
-                continue
-            blob = self.recv(((ring_id, "qrs", idx)), src, timeout=timeout)
-            acc += dec(blob, sizes[idx])
-        # allgather: rotate the compressed reduced chunks p-1 times
-        right = participants[(idx + 1) % p]
-        left = participants[(idx - 1) % p]
-        blobs = {idx: enc(np.ascontiguousarray(acc))}
-        carry = idx
-        for s in range(p - 1):
-            self.send(right, ((ring_id, "qag", s)), blobs[carry])
-            recv_owner = (idx - 1 - s) % p
-            blobs[recv_owner] = self.recv(((ring_id, "qag", s)), left,
-                                          timeout=timeout)
-            carry = recv_owner
-        return np.concatenate([dec(blobs[i], sizes[i]) for i in range(p)])
-
     # --------------------------------------------------------------- adasum
-    def adasum(self, ring_id, arr, participants, *, timeout=None):
+    def adasum(self, ring_id, arr, participants, *, timeout=None,
+               segment_bytes=None):
         """Distributed Adasum vector-halving distance-doubling
         (reference: ``Adasum<Communicator_type>::FusedAllreduce``,
         ``adasum/adasum.h:194-330``) over the p2p plane — no rank-0
@@ -365,6 +735,12 @@ class RingPlane:
         algebra as :func:`horovod_tpu.ops.adasum.adasum_vhdd`, which the
         numpy oracle validates.
 
+        Accumulation and the scalar reductions stay float64 locally;
+        the exchanged halves and gathered blocks wire the array's
+        NATIVE dtype (floats) — the gather rotates each rank's
+        once-encoded piece verbatim, so the rebuilt vector is
+        rank-consistent.
+
         ``participants`` must be ALL world ranks (the coordinator
         falls back to the payload path when ranks have joined) and a
         power of two.
@@ -380,6 +756,11 @@ class RingPlane:
         size = arr.size
         if p == 1:
             return arr
+        seg = (self.segment_bytes if segment_bytes is None
+               else int(segment_bytes))
+        wire_dt = (np.dtype(arr.dtype) if is_float_dtype(arr.dtype)
+                   else np.dtype(np.float64))
+        item = wire_dt.itemsize
         padded = -(-size // p) * p
         piece = np.zeros(padded, np.float64)
         piece[:size] = arr.reshape(-1).astype(np.float64)
@@ -392,11 +773,14 @@ class RingPlane:
             bit = (idx // dist) % 2
             send_half, mine = (high, low) if bit == 0 else (low, high)
             peer = participants[idx ^ dist]
-            self.send(peer, ((ring_id, "ad", level)),
-                      np.ascontiguousarray(send_half).tobytes())
+            self.send_chunk(peer, (ring_id, "ad", level),
+                            _as_bytes_view(send_half.astype(wire_dt)),
+                            seg_bytes=seg)
             recv = np.frombuffer(
-                self.recv(((ring_id, "ad", level)), peer, timeout=timeout),
-                dtype=np.float64)
+                self.recv_chunk((ring_id, "ad", level), peer,
+                                half * item, timeout=timeout,
+                                seg_bytes=seg),
+                dtype=wire_dt).astype(np.float64)
             # a = the lower sub-group's vector piece, b = the upper's —
             # fixed roles so every group member reduces the same scalars
             a, b = (mine, recv) if bit == 0 else (recv, mine)
@@ -428,64 +812,88 @@ class RingPlane:
             dist *= 2
             level += 1
 
-        # block gather (ring rotation), then undo the bit-reversed chunk
-        # order the halving walk leaves behind (adasum.py:150-153)
-        blocks = {idx: np.ascontiguousarray(piece).tobytes()}
+        # block gather (ring rotation) of the NATIVE-dtype pieces, then
+        # undo the bit-reversed chunk order the halving walk leaves
+        # behind (adasum.py:150-153).  Every rank decodes each piece
+        # from the same once-encoded blob — its own included.
+        own_wire = piece.astype(wire_dt)
+        blocks = {idx: _as_bytes_view(own_wire)}
+        block_nbytes = piece.size * item
         right = participants[(idx + 1) % p]
         left = participants[(idx - 1) % p]
         carry = idx
         for s in range(p - 1):
-            self.send(right, ((ring_id, "adg", s)), blocks[carry])
+            self.send_chunk(right, (ring_id, "adg", s), blocks[carry],
+                            seg_bytes=seg)
             recv_owner = (idx - 1 - s) % p
-            blocks[recv_owner] = self.recv(((ring_id, "adg", s)), left,
-                                           timeout=timeout)
+            blocks[recv_owner] = self.recv_chunk(
+                (ring_id, "adg", s), left, block_nbytes, timeout=timeout,
+                seg_bytes=seg)
             carry = recv_owner
+        self._flush_sends(timeout)
         levels = p.bit_length() - 1
         order = [int(format(i, f"0{levels}b")[::-1], 2) for i in range(p)]
         full = np.concatenate([
-            np.frombuffer(blocks[order[i]], np.float64)
+            np.frombuffer(blocks[order[i]], dtype=wire_dt).astype(
+                np.float64)
             for i in range(p)])
         return full[:size].reshape(shape).astype(out_dtype)
 
     # ------------------------------------------------------------- broadcast
     def broadcast(self, ring_id, arr_or_none, participants, root, *,
-                  shape, dtype, timeout=None):
-        """Chunked pipeline around the ring rooted at ``root``: every rank
-        receives each chunk once from its left neighbor and forwards it
-        once to its right — the root uploads the tensor exactly once."""
+                  shape, dtype, timeout=None, segment_bytes=None):
+        """Segmented pipeline around the ring rooted at ``root``: every
+        rank receives each segment once from its left neighbor and
+        forwards it once to its right AS IT ARRIVES — the root uploads
+        the tensor exactly once, in its native dtype, and hop latency
+        overlaps across segments."""
         participants = sorted(participants)
         p = len(participants)
         idx = participants.index(self.rank)
         root_idx = participants.index(root)
         right = participants[(idx + 1) % p]
         nbytes = int(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
-        n_chunks = max(1, -(-nbytes // BCAST_CHUNK))
+        seg = (self.segment_bytes if segment_bytes is None
+               else int(segment_bytes)) or BCAST_CHUNK
 
         if self.rank == root:
-            data = np.ascontiguousarray(arr_or_none).tobytes()
+            data = np.ascontiguousarray(arr_or_none)
             if p > 1:
-                for c in range(n_chunks):
-                    self.send(right, ((ring_id, "bc", c)),
-                              data[c * BCAST_CHUNK:(c + 1) * BCAST_CHUNK])
+                self.send_chunk(right, (ring_id, "bc"),
+                                _as_bytes_view(data), seg_bytes=seg)
+            data = data.tobytes()
         else:
             left = participants[(idx - 1) % p]
-            pieces = []
             last = (idx + 1) % p == root_idx  # my right neighbor is root
-            for c in range(n_chunks):
-                piece = self.recv(((ring_id, "bc", c)), left, timeout=timeout)
-                if not last:
-                    self.send(right, ((ring_id, "bc", c)), piece)
-                pieces.append(piece)
-            data = b"".join(pieces)
+            forward = not last and not faults.check("send")
+            pieces = []
+
+            def relay(offset, segment, seg_i=[0]):
+                if forward:
+                    self._enqueue_segment(right, seg_i[0],
+                                          (ring_id, "bc", seg_i[0]),
+                                          segment)
+                seg_i[0] += 1
+                pieces.append(segment)
+
+            self.recv_chunk((ring_id, "bc"), left, nbytes,
+                            timeout=timeout, consume=relay, seg_bytes=seg)
+            data = (bytes(pieces[0]) if len(pieces) == 1
+                    else b"".join(pieces))
+        if p > 1:
+            self._flush_sends(timeout)
         return np.frombuffer(data, dtype=np.dtype(dtype)).reshape(shape)
 
     # ------------------------------------------------------------- allgather
-    def allgather(self, ring_id, arr, participants, *, timeout=None):
+    def allgather(self, ring_id, arr, participants, *, block_nbytes=None,
+                  timeout=None, segment_bytes=None):
         """Ring block rotation: each step forwards the block received the
         previous step; after p-1 steps every rank holds every block.
-        Returns the blocks concatenated in rank order (variable first
-        dims supported — blocks travel as raw bytes + shape header is
-        negotiated out-of-band by the coordinator)."""
+        Returns the blocks (bytes) in participant rank order — blocks
+        travel as raw native-dtype bytes, segmented across the stripes;
+        ``block_nbytes`` gives each participant's block size (negotiated
+        out-of-band by the coordinator; None falls back to unsegmented
+        single-frame rotation for callers that don't know the sizes)."""
         participants = sorted(participants)
         p = len(participants)
         idx = participants.index(self.rank)
@@ -493,11 +901,21 @@ class RingPlane:
         if p > 1:
             right = participants[(idx + 1) % p]
             left = participants[(idx - 1) % p]
+            seg = ((self.segment_bytes if segment_bytes is None
+                    else int(segment_bytes))
+                   if block_nbytes is not None else 0)
+            sizes = (dict(zip(participants, block_nbytes))
+                     if block_nbytes is not None else None)
             carry_owner = self.rank
             for s in range(p - 1):
-                self.send(right, ((ring_id, "ag", s)), blocks[carry_owner])
+                self.send_chunk(right, (ring_id, "ag", s),
+                                blocks[carry_owner], seg_bytes=seg)
                 recv_owner = participants[(idx - 1 - s) % p]
-                blocks[recv_owner] = self.recv(((ring_id, "ag", s)), left,
-                                               timeout=timeout)
+                nbytes = (sizes[recv_owner] if sizes is not None
+                          else len(blocks[carry_owner]))
+                blocks[recv_owner] = self.recv_chunk(
+                    (ring_id, "ag", s), left, nbytes, timeout=timeout,
+                    seg_bytes=seg)
                 carry_owner = recv_owner
+            self._flush_sends(timeout)
         return [blocks[r] for r in participants]

@@ -16,6 +16,10 @@ _PARAMS = {
     "hierarchical_allgather": (env_util.HVD_HIERARCHICAL_ALLGATHER, "params.hierarchical_allgather"),
     "adasum_hierarchical": (env_util.HVD_ADASUM_HIERARCHICAL, "params.adasum_hierarchical"),
     "compression": (env_util.HVD_TPU_COMPRESSION, "params.compression"),
+    "ring_segment_bytes": (env_util.HVD_TPU_RING_SEGMENT_BYTES,
+                           "params.ring_segment_bytes"),
+    "ring_stripes": (env_util.HVD_TPU_RING_STRIPES,
+                     "params.ring_stripes"),
     "autotune": (env_util.HVD_AUTOTUNE, "autotune.enabled"),
     "autotune_log_file": (env_util.HVD_AUTOTUNE_LOG, "autotune.log_file"),
     "autotune_warmup_samples": (env_util.HVD_AUTOTUNE_WARMUP_SAMPLES, "autotune.warmup_samples"),

@@ -110,6 +110,14 @@ def make_parser():
                        help="Default on-the-wire allreduce compression "
                             "(HVD_TPU_COMPRESSION); int8 is block-scaled "
                             "quantization — see docs/compression.md.")
+    group.add_argument("--ring-segment-bytes", type=int, default=None,
+                       help="TCP-ring pipeline segment size in bytes "
+                            "(HVD_TPU_RING_SEGMENT_BYTES; 0 disables "
+                            "segment pipelining — see docs/tuning.md).")
+    group.add_argument("--ring-stripes", type=int, default=None,
+                       help="Dedicated bulk-data connections per ring "
+                            "peer (HVD_TPU_RING_STRIPES); control "
+                            "traffic always rides its own connection.")
     group.add_argument("--controller", choices=["native", "python", "tcp"],
                        default=None)
 

@@ -32,6 +32,21 @@ from horovod_tpu.utils import env as env_util
 # data plane ships whole tensors (the bench sweep goes to 256 MB).
 MAX_FRAME_BYTES = 1 << 30
 
+# Bulk (raw-bytes) frame: the high bit of the length word flags a frame
+# whose payload travels as raw bytes AFTER a small pickled header —
+#   [4B RAW_FRAME_FLAG|header_len][32B HMAC][4B payload_len]
+#   [pickled (direction, obj)][payload bytes]
+# The HMAC covers [header_len][payload_len][header][payload] — the
+# length words are bound in so an on-path attacker can't shift the
+# header/payload boundary into a silently truncated payload — and is
+# verified before unpickling; the payload is never pickled (no
+# serialize copy on the send side, a single recv_into buffer on the
+# receive side).  MAX_FRAME_BYTES < 2^30 keeps the flag bit
+# unambiguous.
+RAW_FRAME_FLAG = 0x80000000
+# the pickled header of a bulk frame is a tag + rank, never big
+MAX_RAW_HEADER_BYTES = 1 << 16
+
 
 # ------------------------------------------------------------- base messages
 class PingRequest:
@@ -110,16 +125,64 @@ def write_message(sock, key, obj, direction):
             f"frame of {len(payload)} bytes exceeds the "
             f"{MAX_FRAME_BYTES}-byte transport limit")
     digest = secret.sign(key, payload)
-    sock.sendall(struct.pack(">I", len(payload)) + digest + payload)
+    frame = struct.pack(">I", len(payload)) + digest + payload
+    sock.sendall(frame)
+    return len(frame)
+
+
+def write_bulk_message(sock, key, obj, payload, direction):
+    """Raw-bytes bulk frame: ``obj`` is a small header object (pickled;
+    its ``payload`` attribute must be None — the receiver injects the
+    raw bytes there), ``payload`` is bytes-like and goes on the wire
+    verbatim via scatter-gather, never through pickle.  Returns the
+    frame size in bytes."""
+    hdr = pickle.dumps((direction, obj))
+    payload = memoryview(payload).cast("B")
+    if len(hdr) > MAX_RAW_HEADER_BYTES:
+        raise ValueError(
+            f"bulk frame header of {len(hdr)} bytes exceeds the "
+            f"{MAX_RAW_HEADER_BYTES}-byte limit")
+    if payload.nbytes > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"bulk payload of {payload.nbytes} bytes exceeds the "
+            f"{MAX_FRAME_BYTES}-byte transport limit")
+    lengths = struct.pack(">II", len(hdr), payload.nbytes)
+    digest = secret.sign_parts(key, lengths, hdr, payload)
+    prefix = (struct.pack(">I", RAW_FRAME_FLAG | len(hdr)) + digest +
+              struct.pack(">I", payload.nbytes) + hdr)
+    _sendall_vec(sock, [prefix, payload])
+    return len(prefix) + payload.nbytes
+
+
+def _sendall_vec(sock, buffers):
+    """sendall over a list of buffers without concatenating them (one
+    sendmsg syscall per iteration; falls back to per-buffer sendall)."""
+    bufs = [memoryview(b).cast("B") for b in buffers if len(b)]
+    if not hasattr(sock, "sendmsg"):
+        for b in bufs:
+            sock.sendall(b)
+        return
+    while bufs:
+        sent = sock.sendmsg(bufs)
+        while sent:
+            if sent >= bufs[0].nbytes:
+                sent -= bufs[0].nbytes
+                bufs.pop(0)
+            else:
+                bufs[0] = bufs[0][sent:]
+                sent = 0
 
 
 def read_message(sock, key, expected_direction):
     header = _read_exact(sock, 4 + secret.DIGEST_LEN)
     (length,) = struct.unpack(">I", header[:4])
+    digest = header[4:]
+    if length & RAW_FRAME_FLAG:
+        return _read_bulk(sock, key, expected_direction,
+                          length & (RAW_FRAME_FLAG - 1), digest)
     if length > MAX_FRAME_BYTES:
         raise ConnectionError(
             f"frame length {length} exceeds limit {MAX_FRAME_BYTES}")
-    digest = header[4:]
     payload = _read_exact(sock, length)
     if not secret.check(key, payload, digest):
         raise PermissionError("message failed HMAC verification")
@@ -131,6 +194,38 @@ def read_message(sock, key, expected_direction):
     return envelope[1]
 
 
+def _read_bulk(sock, key, expected_direction, hdr_len, digest):
+    """Read the remainder of a raw bulk frame (both length caps are
+    checked before any buffering; the HMAC — covering the length words
+    plus header plus payload — is verified before the header reaches
+    the unpickler)."""
+    if hdr_len > MAX_RAW_HEADER_BYTES:
+        raise ConnectionError(
+            f"bulk header length {hdr_len} exceeds limit "
+            f"{MAX_RAW_HEADER_BYTES}")
+    (payload_len,) = struct.unpack(">I", _read_exact(sock, 4))
+    if payload_len > MAX_FRAME_BYTES:
+        raise ConnectionError(
+            f"bulk payload length {payload_len} exceeds limit "
+            f"{MAX_FRAME_BYTES}")
+    hdr = _read_exact(sock, hdr_len)
+    payload = _read_exact_into(sock, payload_len)
+    lengths = struct.pack(">II", hdr_len, payload_len)
+    if not secret.check_parts(key, digest, lengths, hdr, payload):
+        raise PermissionError("bulk message failed HMAC verification")
+    envelope = pickle.loads(hdr)
+    if not (isinstance(envelope, tuple) and len(envelope) == 2
+            and envelope[0] == expected_direction):
+        raise PermissionError(
+            "message direction mismatch (reflected frame?)")
+    obj = envelope[1]
+    # payload injection: the carrier (the mux (req_id, obj) pair's
+    # second element, or the bare object) declared a ``payload`` slot
+    carrier = obj[1] if isinstance(obj, tuple) and len(obj) == 2 else obj
+    carrier.payload = payload
+    return obj
+
+
 def _read_exact(sock, n):
     buf = bytearray()
     while len(buf) < n:
@@ -139,6 +234,20 @@ def _read_exact(sock, n):
             raise ConnectionError("peer closed connection")
         buf += chunk
     return bytes(buf)
+
+
+def _read_exact_into(sock, n):
+    """One preallocated buffer filled by recv_into — the bulk payload is
+    copied exactly once off the socket."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
+            raise ConnectionError("peer closed connection")
+        got += r
+    return buf
 
 
 # ------------------------------------------------------------------- service
@@ -328,15 +437,44 @@ class BasicClient:
         return good
 
 
+def _connect_any(addresses, timeout, retry_for):
+    """Connect sweep over the address list with exponential backoff +
+    jitter under the ``retry_for`` deadline budget; returns a connected
+    TCP_NODELAY socket (shared by the mux control connection, its bulk
+    companion, and the ring stripe pool)."""
+    deadline = time.monotonic() + retry_for
+    attempt = 0
+    last_error = None
+    while True:
+        for addr in addresses:
+            try:
+                sock = connect(addr, timeout)
+                sock.settimeout(None)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return sock
+            except OSError as exc:
+                last_error = exc
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise ConnectionError(
+                f"could not reach service at any of {addresses}: "
+                f"{last_error}")
+        time.sleep(min(backoff_delay(attempt), max(remaining, 0.0)))
+        attempt += 1
+
+
 # ------------------------------------------------- persistent mux transport
 class MuxService(BasicService):
     """Persistent-connection variant: each connection carries a stream of
     ``(req_id, request)`` frames; every request is handled on its own
     thread and the ``(req_id, response)`` frame is written back whenever
     it completes — so slow (blocking) requests don't head-of-line-block
-    the connection.  The reference keeps persistent Gloo pairs the same
-    way; round 1's one-connection-per-request client was the analog of
-    re-running rendezvous per collective."""
+    the connection.  Fire-and-forget posts (``req_id`` None) are handled
+    inline on the reader loop instead: their handlers are quick and a
+    thread spawn per bulk segment would dominate the striped data path.
+    The reference keeps persistent Gloo pairs the same way; round 1's
+    one-connection-per-request client was the analog of re-running
+    rendezvous per collective."""
 
     def __init__(self, name, key):
         self._inflight = 0
@@ -361,6 +499,21 @@ class MuxService(BasicService):
                     req_id, req = frame
                     with service._inflight_cv:
                         service._inflight += 1
+                    if req_id is None:
+                        # fire-and-forget: no response is ever written
+                        # and the handlers behind these posts (mailbox
+                        # insert, abort flag) are quick — dispatch
+                        # inline rather than paying a thread spawn per
+                        # bulk segment on the striped data path
+                        try:
+                            service._handle(req, self.client_address)
+                        except Exception:  # noqa: BLE001 — nowhere to
+                            pass           # report without a req_id
+                        finally:
+                            with service._inflight_cv:
+                                service._inflight -= 1
+                                service._inflight_cv.notify_all()
+                        continue
 
                     def run(req_id=req_id, req=req):
                         try:
@@ -369,8 +522,6 @@ class MuxService(BasicService):
                                     req, self.client_address)
                             except Exception as exc:  # noqa: BLE001
                                 resp = exc
-                            if req_id is None:
-                                return  # fire-and-forget: no response
                             service._write_response(sock, write_lock,
                                                     req_id, resp)
                         finally:
@@ -448,38 +599,27 @@ class MuxClient:
         self._next_id = _secrets.randbits(48)
         self._reader = None
         self._broken = None
+        # bulk companion: a StripeClient to the same service that
+        # carries ONLY fire-and-forget raw frames, under its own lock —
+        # a pending control request (heartbeat, negotiation, abort)
+        # never waits behind an in-progress multi-MB bulk write
+        self._bulk = None
+        self._bulk_lock = threading.Lock()
+        self._bytes_sent = 0  # control bytes (guarded by _send_lock)
 
     def _connect_locked(self):
         """Establish the socket + reader (caller holds _state_lock).
         Sweeps the address list with exponential backoff + jitter under
         the ``retry_for`` deadline budget: a refused/reset connection
         during rendezvous or negotiation is retried, not fatal."""
-        deadline = time.monotonic() + self._retry_for
-        attempt = 0
-        last_error = None
-        while True:
-            for addr in self._addresses:
-                try:
-                    sock = connect(addr, self._timeout)
-                    sock.settimeout(None)
-                    sock.setsockopt(socket.IPPROTO_TCP,
-                                    socket.TCP_NODELAY, 1)
-                    self._sock = sock
-                    self._broken = None
-                    self._reader = threading.Thread(
-                        target=self._read_loop, args=(sock,), daemon=True,
-                        name="mux-client-reader")
-                    self._reader.start()
-                    return
-                except OSError as exc:
-                    last_error = exc
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise ConnectionError(
-                    f"could not reach service at any of "
-                    f"{self._addresses}: {last_error}")
-            time.sleep(min(backoff_delay(attempt), max(remaining, 0.0)))
-            attempt += 1
+        sock = _connect_any(self._addresses, self._timeout,
+                            self._retry_for)
+        self._sock = sock
+        self._broken = None
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(sock,), daemon=True,
+            name="mux-client-reader")
+        self._reader.start()
 
     def _ensure_connected_locked(self):
         """Returns the live socket (caller holds _state_lock).  The
@@ -529,7 +669,8 @@ class MuxClient:
             self._pending[req_id] = (event, slot)
         try:
             with self._send_lock:
-                write_message(sock, self._key, (req_id, req), "q")
+                self._bytes_sent += write_message(
+                    sock, self._key, (req_id, req), "q")
         except Exception:  # OSError, PicklingError, oversize ValueError…
             with self._state_lock:
                 self._pending.pop(req_id, None)
@@ -550,10 +691,92 @@ class MuxClient:
         with self._state_lock:
             sock = self._ensure_connected_locked()
         with self._send_lock:
-            write_message(sock, self._key, (None, req), "q")
+            self._bytes_sent += write_message(sock, self._key,
+                                              (None, req), "q")
+
+    @property
+    def bytes_sent(self):
+        """Wire bytes written (control + bulk companion, framing
+        included) — each counter is mutated under its own lock; this
+        read-only sum is the byte-accounting surface the
+        wire-efficiency tests measure."""
+        bulk = self._bulk
+        return self._bytes_sent + (bulk.bytes_sent if bulk else 0)
+
+    def post_bulk(self, obj, payload):
+        """Fire-and-forget raw bulk frame on the dedicated bulk
+        companion connection (a lazily-built :class:`StripeClient` to
+        the same service): ``obj`` is the small header carrier (its
+        ``payload`` attribute must be None), ``payload`` the raw bytes.
+        Control ``send``s keep round-tripping on the main socket while
+        this write is in flight."""
+        with self._bulk_lock:
+            if self._bulk is None:
+                self._bulk = StripeClient(
+                    self._addresses, self._key, timeout=self._timeout,
+                    retry_for=self._retry_for)
+            bulk = self._bulk
+        bulk.post_bulk(obj, payload)
 
     def close(self):
         with self._state_lock:
+            sock, self._sock = self._sock, None
+        with self._bulk_lock:
+            bulk = self._bulk
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if bulk is not None:
+            bulk.close()
+
+
+class StripeClient:
+    """One dedicated bulk-data connection to a :class:`MuxService`:
+    fire-and-forget raw frames only (req_id None, so the service never
+    writes back — no reader thread).  The ring data plane keeps a pool
+    of these per peer (``HVD_TPU_RING_STRIPES``), separate from the
+    control :class:`MuxClient`, so heartbeats and negotiation never
+    queue behind multi-MB chunk writes and high-BDP links get
+    multi-stream throughput.  Thread-safe."""
+
+    def __init__(self, addresses, key, timeout=10, retry_for=None):
+        if isinstance(addresses, dict):
+            flat = [a for addrs in addresses.values() for a in addrs]
+        else:
+            flat = list(addresses)
+        if not flat:
+            raise ValueError("no addresses to connect to")
+        self._addresses = flat
+        self._key = key
+        self._timeout = timeout
+        self._retry_for = (default_connect_retry() if retry_for is None
+                           else retry_for)
+        self._lock = threading.Lock()
+        self._sock = None
+        self.bytes_sent = 0
+
+    def post_bulk(self, obj, payload):
+        """Write one raw bulk frame (``obj`` the small header carrier
+        with a None ``payload`` attribute, ``payload`` the raw bytes)."""
+        with self._lock:
+            if self._sock is None:
+                self._sock = _connect_any(self._addresses, self._timeout,
+                                          self._retry_for)
+            try:
+                self.bytes_sent += write_bulk_message(
+                    self._sock, self._key, (None, obj), payload, "q")
+            except OSError:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                raise
+
+    def close(self):
+        with self._lock:
             sock, self._sock = self._sock, None
         if sock is not None:
             try:

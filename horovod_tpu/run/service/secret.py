@@ -20,3 +20,17 @@ def sign(key: bytes, payload: bytes) -> bytes:
 
 def check(key: bytes, payload: bytes, digest: bytes) -> bool:
     return hmac.compare_digest(sign(key, payload), digest)
+
+
+def sign_parts(key: bytes, *parts) -> bytes:
+    """HMAC over the concatenation of ``parts`` without materializing
+    it — the bulk frame path signs [header][payload] where the payload
+    is a multi-MB memoryview a join would copy."""
+    mac = hmac.new(key, digestmod=hashlib.sha256)
+    for part in parts:
+        mac.update(part)
+    return mac.digest()
+
+
+def check_parts(key: bytes, digest: bytes, *parts) -> bool:
+    return hmac.compare_digest(sign_parts(key, *parts), digest)

@@ -37,6 +37,13 @@ HVD_NUM_STREAMS = "HVD_NUM_STREAMS"
 # default on-the-wire allreduce compression: none | bf16 | fp16 | int8
 # (block-scaled int8, EQuARX arXiv:2506.17615)
 HVD_TPU_COMPRESSION = "HVD_TPU_COMPRESSION"
+# TCP-ring pipeline segment size in bytes (0 = unsegmented): each ring
+# step's chunk is split into segments so the send of segment k+1
+# overlaps the recv+accumulate of segment k (docs/tuning.md)
+HVD_TPU_RING_SEGMENT_BYTES = "HVD_TPU_RING_SEGMENT_BYTES"
+# dedicated bulk-data connections per ring peer, separate from the
+# control connection (heartbeats never queue behind chunk writes)
+HVD_TPU_RING_STRIPES = "HVD_TPU_RING_STRIPES"
 
 # --- fault-tolerant collective runtime (docs/fault_tolerance.md) -------------
 # bound on "abort initiated anywhere -> every rank raises HvdAbortedError"
@@ -70,6 +77,8 @@ HVD_START_TIMEOUT = "HVD_START_TIMEOUT"  # gang-start deadline, s (default 120)
 
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
 DEFAULT_CYCLE_TIME_MS = 1.0
+DEFAULT_RING_SEGMENT_BYTES = 1 << 20
+DEFAULT_RING_STRIPES = 2
 DEFAULT_CACHE_CAPACITY = 1024
 DEFAULT_STALL_WARNING_SECONDS = 60
 DEFAULT_ABORT_TIMEOUT_SECONDS = 30.0

@@ -48,6 +48,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running benchmarks excluded from tier-1 "
+        "(-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def hvd():
     """Session-wide initialized horovod_tpu (device-rank mode, 8 ranks)."""

@@ -487,3 +487,162 @@ def test_hvd_chaos_prints_reproducible_spec():
 
     assert spec_for(7) == spec_for(7)       # same seed -> same spec
     assert spec_for(7) != spec_for(8)       # different seed -> different
+
+
+# ----------------------------------------- pipelined stripe data plane ------
+def _stripe_planes(p=2, segment_bytes=1024, stripes=2):
+    """Loopback ring rig — one definition in ``bench._ring_harness``."""
+    import bench
+
+    return bench._ring_harness(p, segment_bytes, stripes)
+
+
+def test_abort_wakes_blocked_stripe_recv_mid_pipeline():
+    """A recv blocked on the MISSING segments of a partially-delivered
+    chunk (some stripes delivered, one wedged) must wake with the typed
+    error when the abort lands — stripe sockets are covered by the same
+    mailbox condition the abort signals."""
+    services, planes = _stripe_planes(p=2, segment_bytes=1024, stripes=2)
+    try:
+        # rank 0 delivers only the FIRST segment of a 3-segment chunk
+        # (simulating a wedged stripe): enqueue segment 0 directly
+        planes[0]._enqueue_segment(1, 0, (42, "rs", 0, 0), b"x" * 1024)
+        planes[0]._flush_sends(5)
+        caught = []
+
+        def blocked():
+            try:
+                planes[1].recv_chunk((42, "rs", 0), 0, 3 * 1024,
+                                     timeout=30)
+            except BaseException as exc:  # noqa: BLE001
+                caught.append(exc)
+
+        t = threading.Thread(target=blocked, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive(), "recv should be blocked on segment 1"
+        start = time.monotonic()
+        services[1].abort(0, "injected stripe abort")
+        t.join(timeout=5)
+        assert not t.is_alive(), "abort did not wake the stripe recv"
+        assert time.monotonic() - start < 2.0
+        assert isinstance(caught[0], HvdAbortedError)
+        # the already-delivered segment did not leak
+        assert services[1]._mailbox == {}
+        assert services[1]._by_ring == {}
+    finally:
+        for plane in planes:
+            plane.close()
+        for svc in services:
+            svc.shutdown()
+
+
+def test_purge_drops_stale_segments_mid_pipeline_and_is_ring_indexed():
+    """Purging an aborted round drops exactly that ring's buffered
+    segments (O(chunks of the ring) via the ring-id index), refuses its
+    late-arriving stripe segments, and leaves other rounds' chunks
+    untouched."""
+    services, planes = _stripe_planes(p=2, segment_bytes=1024, stripes=2)
+    try:
+        svc = services[1]
+        # segments of two interleaved rounds, delivered over stripes
+        for seg in range(3):
+            planes[0]._enqueue_segment(1, seg, (7, "rs", 0, seg),
+                                       b"a" * 100)
+        planes[0]._enqueue_segment(1, 0, (8, "ag", 0, 0), b"b" * 100)
+        planes[0]._flush_sends(5)
+        deadline = time.monotonic() + 5
+        while len(svc._mailbox) < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(svc._mailbox) == 4
+        assert set(svc._by_ring) == {7, 8}
+
+        svc.purge(7)
+        assert len(svc._mailbox) == 1, svc._mailbox
+        assert set(svc._by_ring) == {8}
+        # a straggler segment of the purged round is refused...
+        planes[0]._enqueue_segment(1, 1, (7, "rs", 0, 3), b"late")
+        planes[0]._flush_sends(5)
+        time.sleep(0.2)
+        assert len(svc._mailbox) == 1
+        # ...while the live round's chunk is still collectable
+        got = planes[1].recv_chunk((8, "ag", 0), 0, 100, timeout=5)
+        assert bytes(got) == b"b" * 100
+        assert svc._by_ring == {}
+    finally:
+        for plane in planes:
+            plane.close()
+        for svc in services:
+            svc.shutdown()
+
+
+def test_sender_thread_failure_fails_the_round_fast():
+    """A bulk send that fails (dead stripe peer) surfaces on the
+    compute thread as a ConnectionError instead of a silent stall."""
+    from horovod_tpu.ops.tcp_dataplane import PeerService, RingPlane
+    from horovod_tpu.run.service import network, secret
+
+    key = secret.make_secret_key()
+    svc = PeerService(key)
+    try:
+        def resolver(rank):
+            return network.MuxClient([("127.0.0.1", svc.port)], key,
+                                     timeout=10)
+
+        def resolve_bulk(rank):
+            # dead endpoint, no retry budget: post_bulk fails fast
+            return network.StripeClient([("127.0.0.1", 1)], key,
+                                        timeout=1, retry_for=0)
+
+        plane = RingPlane(0, svc, resolver, resolve_bulk,
+                          segment_bytes=64, stripes=1)
+        plane.send_chunk(1, (9, "rs", 0), b"x" * 256)
+        with pytest.raises((ConnectionError, TimeoutError)):
+            plane._flush_sends(10)
+        plane.close()
+    finally:
+        svc.shutdown()
+
+
+def test_send_failure_wakes_blocked_recv():
+    """A recv already blocked on the mailbox must wake with the send
+    failure as soon as the sender thread records it — not after the
+    full recv timeout (the peer can never send the segments this rank's
+    broken sends were the prerequisite for)."""
+    from horovod_tpu.ops.tcp_dataplane import PeerService, RingPlane
+    from horovod_tpu.run.service import network, secret
+
+    key = secret.make_secret_key()
+    svc = PeerService(key)
+    try:
+        def resolver(rank):
+            return network.MuxClient([("127.0.0.1", svc.port)], key,
+                                     timeout=10)
+
+        def resolve_bulk(rank):
+            return network.StripeClient([("127.0.0.1", 1)], key,
+                                        timeout=1, retry_for=0)
+
+        plane = RingPlane(0, svc, resolver, resolve_bulk,
+                          segment_bytes=64, stripes=1)
+        caught = []
+
+        def blocked():
+            try:
+                plane.recv_chunk((9, "rs", 0), 1, 64, timeout=30)
+            except BaseException as exc:  # noqa: BLE001
+                caught.append(exc)
+
+        t = threading.Thread(target=blocked, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        assert t.is_alive(), "recv should be blocked"
+        start = time.monotonic()
+        plane.send_chunk(1, (9, "x", 0), b"y" * 64)  # sender will fail
+        t.join(timeout=10)
+        assert not t.is_alive(), "send failure did not wake the recv"
+        assert time.monotonic() - start < 5.0
+        assert isinstance(caught[0], ConnectionError), caught
+        plane.close()
+    finally:
+        svc.shutdown()

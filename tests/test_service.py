@@ -208,3 +208,122 @@ def test_mux_client_random_id_start():
            for _ in range(4)}
     assert len(ids) == 4  # collisions astronomically unlikely
     assert all(i > 0 for i in ids)
+
+
+# -------------------------------------------------- bulk (raw) frames -------
+def test_bulk_frame_roundtrip_and_hmac():
+    """Raw bulk frames: the payload travels outside pickle, the HMAC
+    covers header+payload and is verified before unpickling, and a
+    tampered payload is rejected."""
+    import socket as socket_mod
+
+    from horovod_tpu.ops.tcp_dataplane import ChunkMsg
+    from horovod_tpu.run.service import network
+
+    key = secret.make_secret_key()
+    a, b = socket_mod.socketpair()
+    try:
+        # small enough to fit the socketpair buffer (the writer returns
+        # before the reader starts draining)
+        payload = bytes(range(256)) * 64  # 16 KB
+        network.write_bulk_message(
+            a, key, (None, ChunkMsg((1, "rs", 0, 0), 3, None)),
+            payload, "q")
+        req_id, msg = network.read_message(b, key, "q")
+        assert req_id is None
+        assert isinstance(msg, ChunkMsg)
+        assert msg.tag == (1, "rs", 0, 0) and msg.src == 3
+        assert bytes(msg.payload) == payload
+
+        # flipped payload byte -> HMAC failure before any unpickling
+        frame = bytearray()
+
+        class Capture:
+            def sendall(self, data):
+                frame.extend(data)
+
+            def sendmsg(self, bufs):
+                n = 0
+                for buf in bufs:
+                    frame.extend(buf)
+                    n += len(buf)
+                return n
+
+        network.write_bulk_message(
+            Capture(), key, (None, ChunkMsg((1, "rs", 0, 1), 3, None)),
+            payload, "q")
+        frame[-1] ^= 0xFF
+        a.sendall(bytes(frame))
+        with pytest.raises(PermissionError):
+            network.read_message(b, key, "q")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_control_send_round_trips_while_bulk_post_in_flight():
+    """Satellite regression guard for the liveness layer: a heartbeat
+    must round-trip within its deadline while a large bulk chunk write
+    is in flight — bulk posts ride a dedicated companion connection
+    under their own lock, so MuxClient.send never queues behind them."""
+    import time
+
+    from horovod_tpu.ops.tcp_dataplane import ChunkMsg
+    from horovod_tpu.run.service import network
+
+    key = secret.make_secret_key()
+
+    class SlowBulkService(network.MuxService):
+        def _handle(self, req, client_address):
+            if isinstance(req, ChunkMsg):
+                time.sleep(0.2)
+                return network.AckResponse()
+            return super()._handle(req, client_address)
+
+    svc = SlowBulkService("slow bulk", key)
+    client = network.MuxClient([("127.0.0.1", svc.port)], key, timeout=10)
+    try:
+        # open + throttle the bulk companion: every write trickles out
+        # in small slices, so one 8 MB post holds the bulk path busy
+        client.post_bulk(ChunkMsg((1, "x", 0, 0), 0, None), b"warm")
+        real_sock = client._bulk._sock
+
+        class Throttled:
+            def sendmsg(self, bufs):
+                time.sleep(0.05)
+                total = sum(len(b) for b in bufs)
+                n = 0
+                for buf in bufs:
+                    view = memoryview(buf).cast("B")
+                    step = max(1, min(1 << 16, view.nbytes))
+                    real_sock.sendall(view[:step])
+                    n += step
+                    if n < total:
+                        return n
+                return n
+
+            def __getattr__(self, name):
+                return getattr(real_sock, name)
+
+        client._bulk._sock = Throttled()
+        done = []
+
+        def bulk_writer():
+            client.post_bulk(ChunkMsg((1, "x", 0, 1), 0, None),
+                             b"\0" * (8 << 20))
+            done.append(True)
+
+        writer = threading.Thread(target=bulk_writer, daemon=True)
+        writer.start()
+        time.sleep(0.1)
+        assert writer.is_alive(), "bulk write finished too fast to test"
+        start = time.monotonic()
+        resp = client.send(network.PingRequest(), timeout=2.0)
+        elapsed = time.monotonic() - start
+        assert elapsed < 2.0, f"control round-trip blocked {elapsed:.1f}s"
+        assert isinstance(resp, network.PingResponse)
+        writer.join(timeout=30)
+        assert done, "bulk write never completed"
+    finally:
+        client.close()
+        svc.shutdown()

@@ -3,11 +3,21 @@
 ``horovodrun --gloo``, and ``test_stall.py`` driven purely by env vars).
 
 The numpy data plane keeps 64-bit types exact here (the device-rank
-matrix in ``test_dtype_matrix.py`` covers the XLA-native types)."""
+matrix in ``test_dtype_matrix.py`` covers the XLA-native types).
+
+The in-process half is the ISSUE 3 parity matrix: the pipelined
+multi-stream ring (native wire dtypes, segment overlap, socket
+striping) against the seed-era serial f64-wire ring, across dtypes x
+sizes x compression x stripes, plus the wire-byte accounting the
+acceptance criterion names."""
 
 import os
 import subprocess
 import sys
+import threading
+
+import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HVDRUN = os.path.join(REPO, "bin", "hvdrun")
@@ -125,9 +135,12 @@ assert n == 4
 
 # fusion-heavy traffic while rank 3 goes silent (neither submitting nor
 # joining — a join would legitimately complete the collective with zero
-# stand-ins): the stalled name must fail via stall shutdown WITHOUT
-# poisoning the healthy collectives or the later join barrier
-# (reference: StallInspector shutdown + Join interplay).
+# stand-ins): healthy collectives complete first, then the stalled name
+# trips the stall inspector, which PROMOTES the stall into a coordinated
+# abort (sticky — the job is over): every rank, the silent culprit
+# included, must fail its next operation with the typed error naming the
+# stalled tensor, not hang (reference: StallInspector shutdown promoted
+# into the PR-2 abort protocol).
 import time
 handles = {}
 for i in range(6):
@@ -140,16 +153,22 @@ for i, h in handles.items():
 if r != 3:
     try:
         hvd.allreduce(jnp.ones((4,)), op=hvd.Sum, name="stalled")
-        raise SystemExit("expected stall shutdown error")
+        raise SystemExit("expected stall shutdown abort")
     except HvdError as exc:
         assert "stalled" in str(exc), str(exc)
 else:
     time.sleep(8)  # silent through the 4s stall-shutdown window
 
-last = hvd.join()
-assert last in range(4)
-print(f"rank {r} STALL_OK", flush=True)
-hvd.shutdown()
+try:
+    hvd.join()
+    raise SystemExit("expected the abort to poison the join barrier")
+except HvdError as exc:
+    assert "stalled" in str(exc), str(exc)
+print(f"rank {r} STALL_ABORT_OK", flush=True)
+try:
+    hvd.shutdown()
+except Exception:
+    pass  # rank 0's exit may take the coordinator with it first
 """
 
 
@@ -160,7 +179,7 @@ def test_tcp_stall_shutdown_with_fusion_and_join_4proc():
     }, timeout=420)
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
-    assert result.stdout.count("STALL_OK") == 4
+    assert result.stdout.count("STALL_ABORT_OK") == 4
     assert "Stalled tensor" in (result.stdout + result.stderr)
 
 
@@ -322,3 +341,242 @@ def test_tcp_error_sweep_and_torch_binding_4proc():
     assert result.returncode == 0, \
         f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-3000:]}"
     assert result.stdout.count("TCP_ERRORS_OK") == 4
+
+
+# ===================================================================
+# ISSUE 3 parity matrix: pipelined multi-stream ring vs the seed ring
+# (in-process, real loopback TCP — the exact transport of tcp mode).
+# ===================================================================
+class _PipelinedHarness:
+    """One PeerService mailbox + RingPlane per rank with bulk stripes
+    (the transport rig is ``bench._ring_harness`` — one definition for
+    the bench sweep, this matrix, and the fault tests)."""
+
+    def __init__(self, p, segment_bytes, stripes):
+        import bench
+
+        self.p = p
+        self.services, self.planes = bench._ring_harness(
+            p, segment_bytes, stripes)
+        self._ring_id = 0
+
+    def run_all(self, fn):
+        outs = [None] * self.p
+        errs = []
+
+        def run(r):
+            try:
+                outs[r] = fn(r)
+            except Exception as exc:  # noqa: BLE001 — surface in test
+                errs.append(exc)
+
+        threads = [threading.Thread(target=run, args=(r,))
+                   for r in range(self.p)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errs, errs
+        return outs
+
+    def allreduce(self, data, seed=False, op_average=False, **kw):
+        self._ring_id += 1
+        rid = self._ring_id
+        ranks = list(range(self.p))
+        if seed:
+            return self.run_all(lambda r: self.planes[r].allreduce_seed(
+                rid, data[r], ranks, world_size=self.p, timeout=60,
+                op_average=op_average, **kw))
+        return self.run_all(lambda r: self.planes[r].allreduce(
+            rid, data[r], ranks, world_size=self.p, timeout=60,
+            op_average=op_average, **kw))
+
+    def close(self):
+        for plane in self.planes:
+            plane.close()
+        for svc in self.services:
+            svc.shutdown()
+
+
+# sub-segment, multi-segment, and odd-remainder sizes against an 8 KB
+# segment (chunks of ~size/3 elements -> 1, ~10 and ~30 segments)
+_PARITY_SIZES = [500, 20001, 70001]
+
+
+def _assert_rank_consistent(outs):
+    for out in outs[1:]:
+        assert np.array_equal(np.asarray(out), np.asarray(outs[0])), \
+            "ring result differs across ranks"
+
+
+@pytest.mark.parametrize("stripes", [1, 2, 4])
+def test_pipelined_ring_parity_matrix(stripes):
+    """dtypes (fp32/bf16/fp16/int32) x sizes (sub-segment,
+    multi-segment, odd remainder) x compression (none/int8/bf16):
+    the pipelined ring must match the seed ring (exact legs) or the
+    float64 oracle within the codec bound (compressed legs), and be
+    bit-identical across ranks in every cell."""
+    import ml_dtypes
+
+    harness = _PipelinedHarness(3, segment_bytes=8192, stripes=stripes)
+    try:
+        for size in _PARITY_SIZES:
+            fdata = [np.random.RandomState(17 * size + r).randn(size)
+                     for r in range(harness.p)]
+            exact = np.sum(np.stack(fdata), 0)
+
+            # ---- exact legs: parity against the seed ring ------------
+            for dtype, rtol, atol in [
+                    (np.float32, 1e-4, 1e-4),
+                    (ml_dtypes.bfloat16, 1e-1, 0.25),
+                    (np.float16, 2e-2, 0.1)]:
+                data = [d.astype(dtype) for d in fdata]
+                outs = harness.allreduce(data)
+                ref = harness.allreduce(data, seed=True)
+                _assert_rank_consistent(outs)
+                assert outs[0].dtype == np.dtype(dtype)
+                np.testing.assert_allclose(
+                    np.asarray(outs[0], np.float64),
+                    np.asarray(ref[0], np.float64),
+                    rtol=rtol, atol=atol,
+                    err_msg=f"{np.dtype(dtype).name} size={size}")
+
+            # int32: modular wire arithmetic must stay EXACT vs seed
+            idata = [(np.arange(size) * (r + 1) - size // 2).astype(
+                np.int32) for r in range(harness.p)]
+            outs = harness.allreduce(idata)
+            ref = harness.allreduce(idata, seed=True)
+            _assert_rank_consistent(outs)
+            assert np.array_equal(outs[0], ref[0]), f"int32 size={size}"
+
+            # ---- compressed legs (fp32 input) ------------------------
+            data = [d.astype(np.float32) for d in fdata]
+            for comp, atol in [("int8", 0.5), ("bf16", None)]:
+                outs = harness.allreduce(data, compression=comp)
+                _assert_rank_consistent(outs)
+                if atol is not None:
+                    assert np.abs(
+                        np.asarray(outs[0], np.float64) - exact
+                    ).max() < atol, f"{comp} size={size}"
+                else:
+                    np.testing.assert_allclose(
+                        np.asarray(outs[0], np.float64), exact,
+                        rtol=3e-2, atol=0.1,
+                        err_msg=f"{comp} size={size}")
+    finally:
+        harness.close()
+
+
+def test_pipelined_ring_int_average_survives_intermediate_overflow():
+    """Regression: an int32 AVERAGE whose intermediate sum exceeds
+    int32's range must read the true wide total before dividing — the
+    modular native wire is only exact for a pure sum, so averaged or
+    postscaled integer rings widen to int64 on the wire like the seed."""
+    harness = _PipelinedHarness(3, segment_bytes=4096, stripes=2)
+    try:
+        data = [np.full(5000, 2 ** 30, np.int32)
+                for _ in range(harness.p)]
+        outs = harness.allreduce(data, op_average=True)
+        ref = harness.allreduce(data, seed=True, op_average=True)
+        _assert_rank_consistent(outs)
+        assert np.array_equal(outs[0], ref[0])
+        # the true average of 3 x 2^30 is 2^30 — NOT the wrapped value
+        assert outs[0][0] == 2 ** 30, outs[0][0]
+
+        # postscale on the sum path widens too
+        outs = harness.allreduce(data, postscale=0.25)
+        ref = harness.allreduce(data, seed=True, postscale=0.25)
+        _assert_rank_consistent(outs)
+        assert np.array_equal(outs[0], ref[0])
+    finally:
+        harness.close()
+
+
+def test_pipelined_ring_wire_bytes_half_of_seed():
+    """Acceptance: the exact-path fp32 ring ships <= 0.51x the seed
+    ring's wire bytes per rank, measured at the framing layer (every
+    control post and bulk stripe frame counts, headers included)."""
+    harness = _PipelinedHarness(4, segment_bytes=1 << 18, stripes=2)
+    try:
+        data = [np.random.RandomState(r).randn(1 << 18).astype(np.float32)
+                for r in range(harness.p)]  # 1 MB per rank
+        harness.allreduce(data)
+        pipelined = [plane.bytes_sent() for plane in harness.planes]
+        harness.allreduce(data, seed=True)
+        seed = [plane.bytes_sent() - b
+                for plane, b in zip(harness.planes, pipelined)]
+        for pp, ss in zip(pipelined, seed):
+            assert pp <= 0.51 * ss, (pipelined, seed)
+    finally:
+        harness.close()
+
+
+def test_pipelined_ring_broadcast_allgather_native_dtype_bytes():
+    """Satellite: broadcast and allgather ship the array's own dtype —
+    wire bytes for an N-element fp32 tensor stay ~4N per hop, nowhere
+    near the 8N an f64-wire plane would move."""
+    harness = _PipelinedHarness(3, segment_bytes=8192, stripes=2)
+    try:
+        n = 50000
+        arr = np.random.RandomState(3).randn(n).astype(np.float32)
+        base = [plane.bytes_sent() for plane in harness.planes]
+        outs = harness.run_all(lambda r: harness.planes[r].broadcast(
+            7001, arr if r == 0 else None, [0, 1, 2], 0,
+            shape=arr.shape, dtype="float32", timeout=60))
+        for out in outs:
+            assert np.array_equal(out, arr)
+        sent = [plane.bytes_sent() - b
+                for plane, b in zip(harness.planes, base)]
+        # root + one forwarder each upload the tensor once (~4N bytes
+        # + framing); the last rank sends nothing
+        for moved in sent[:2]:
+            assert moved < 1.15 * arr.nbytes, sent
+
+        blocks = [np.full((r + 2, 5), r, np.float32)
+                  for r in range(harness.p)]
+        nb = [b.nbytes for b in blocks]
+        base = [plane.bytes_sent() for plane in harness.planes]
+        outs = harness.run_all(lambda r: harness.planes[r].allgather(
+            7002, blocks[r], [0, 1, 2], block_nbytes=nb, timeout=60))
+        for out in outs:
+            for i, blob in enumerate(out):
+                assert np.array_equal(
+                    np.frombuffer(blob, np.float32),
+                    blocks[i].reshape(-1))
+        sent = [plane.bytes_sent() - b
+                for plane, b in zip(harness.planes, base)]
+        total_payload = sum(nb)
+        for moved in sent:
+            # each rank forwards every block except the one that ends
+            # its rotation: < total payload + framing
+            assert moved < total_payload + 2048, (sent, total_payload)
+    finally:
+        harness.close()
+
+
+def test_pipelined_ring_adasum_native_wire_matches_oracle():
+    """Satellite: adasum wires the native dtype (fp32 halves on the
+    exchange + gather legs) yet still matches the numpy VHDD oracle,
+    rank-consistently."""
+    from horovod_tpu.ops.adasum import adasum_reference
+
+    harness = _PipelinedHarness(4, segment_bytes=4096, stripes=2)
+    try:
+        data = [np.random.RandomState(40 + r).randn(3333).astype(
+            np.float32) for r in range(harness.p)]
+        base = [plane.bytes_sent() for plane in harness.planes]
+        outs = harness.run_all(lambda r: harness.planes[r].adasum(
+            7003, data[r], list(range(harness.p)), timeout=60))
+        _assert_rank_consistent(outs)
+        oracle = adasum_reference(data)
+        np.testing.assert_allclose(
+            np.asarray(outs[0], np.float64),
+            np.asarray(oracle, np.float64), rtol=5e-3, atol=5e-3)
+        sent = [plane.bytes_sent() - b
+                for plane, b in zip(harness.planes, base)]
+        # halves + gather in fp32: ~2x the vector's 4N bytes per rank
+        # plus scalar rounds — an f64-wire plane would move ~2x more
+        for moved in sent:
+            assert moved < 3.0 * data[0].nbytes, sent
+    finally:
+        harness.close()
