@@ -39,13 +39,13 @@ def _reserve_port() -> "tuple[socket.socket, int]":
 def _my_address() -> str:
     """The address other processes use to reach this process's
     coordinator (process 0 only)."""
-    iface = os.environ.get(env_util.HVD_IFACE)
+    iface = env_util.get_str(env_util.HVD_IFACE)
     if iface:
         from horovod_tpu.run.service import network
         ip = network.local_interfaces().get(iface)
         if ip:
             return ip
-    rendezvous = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR, "")
+    rendezvous = env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR, "")
     if rendezvous in ("127.0.0.1", "localhost"):
         return "127.0.0.1"
     try:
@@ -74,11 +74,11 @@ def initialize_jax_distributed(process_id: int, num_processes: int) -> None:
     except (AttributeError, ValueError):  # pragma: no cover — older jax
         pass
 
-    coordinator = os.environ.get(env_util.HVD_COORDINATOR_ADDR)
+    coordinator = env_util.get_str(env_util.HVD_COORDINATOR_ADDR)
     reserved = None
     if not coordinator:
-        addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
-        port = os.environ.get(env_util.HVD_RENDEZVOUS_PORT)
+        addr = env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR)
+        port = env_util.get_str(env_util.HVD_RENDEZVOUS_PORT)
         if addr is None:
             raise RuntimeError(
                 "global-mesh mode needs HVD_COORDINATOR_ADDR or the "

@@ -134,11 +134,11 @@ def _auto_configure():
     nor advance step counters the workers' determinism depends on."""
     from horovod_tpu.utils import env as env_util
 
-    rank = os.environ.get(env_util.HVD_RANK)
+    rank = env_util.get_str(env_util.HVD_RANK)
     if rank is None:
         configure(None)
     else:
-        configure(os.environ.get(env_util.HVD_TPU_FAULT_SPEC),
+        configure(env_util.get_str(env_util.HVD_TPU_FAULT_SPEC),
                   rank=env_util.get_int(env_util.HVD_RANK, 0))
 
 

@@ -38,4 +38,7 @@ def broadcast_object(obj, root_rank=0, name=None):
         payload = np.zeros((size,), dtype=np.uint8)
     out = np.asarray(eager.synchronize(eager.broadcast_async(
         payload, root_rank, name=f"{name}.data")))
+    # wire-safe: the bytes traveled through the collective plane, whose
+    # frames are HMAC-verified before ANY deserialization — an
+    # unauthenticated peer cannot place data here
     return pickle.loads(out.tobytes())

@@ -51,7 +51,7 @@ def _mpi_placed() -> "Topology | None":
     ``hvdrun --launcher mpirun/jsrun``): a script launched under plain
     mpirun/srun WITHOUT hvdrun keeps the default device-rank mode
     instead of being hijacked into process mode it can't complete."""
-    if os.environ.get(env_util.HVD_RENDEZVOUS_ADDR) is None:
+    if env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR) is None:
         return None
     rank = os.environ.get("OMPI_COMM_WORLD_RANK",
                           os.environ.get("PMI_RANK"))
@@ -88,7 +88,7 @@ def _from_host_slots(rank, size) -> "Topology | None":
     --map-by slot`` place ranks in.  Correct even when hosts carry
     unequal slot counts, where the MPI-local-vars derivation above
     would give ranks on the short host a different cross_size."""
-    spec = os.environ.get(env_util.HVD_HOST_SLOTS)
+    spec = env_util.get_str(env_util.HVD_HOST_SLOTS)
     if not spec:
         return None
     counts = []
@@ -113,7 +113,7 @@ def _from_host_slots(rank, size) -> "Topology | None":
 def from_env() -> "Topology | None":
     """Build topology from the hvdrun env contract, if present; fall
     back to MPI-runtime placement variables (mpirun/jsrun delegation)."""
-    if os.environ.get(env_util.HVD_RANK) is None:
+    if env_util.get_str(env_util.HVD_RANK) is None:
         return _mpi_placed()
     rank = env_util.get_int(env_util.HVD_RANK, 0)
     size = env_util.get_int(env_util.HVD_SIZE, 1)

@@ -166,12 +166,15 @@ class MetaCoordinatorService(network.MuxService):
         self._stall_warning = stall_warning_sec
         self._stall_shutdown = stall_shutdown_sec
         self._cv = threading.Condition()
-        self._table = {}                 # name -> _GlobalName (ordered)
-        self._joined = set()             # global ranks
-        self._join_order = []            # coordinator-serialized arrivals
-        self._log_entries = []
-        self._acked = {}                 # pid -> highest seq acknowledged
-        self._seq = 0
+        # name -> _GlobalName (ordered); guarded by self._cv
+        self._table = {}
+        self._joined = set()             # global ranks; guarded by self._cv
+        # coordinator-serialized arrivals; guarded by self._cv
+        self._join_order = []
+        self._log_entries = []           # guarded by self._cv
+        # pid -> highest seq acknowledged; guarded by self._cv
+        self._acked = {}
+        self._seq = 0                    # guarded by self._cv
         self._join_epoch = 0  # completed join_done rounds
         self._liveness = liveness_timeout_sec
         # seeded for EVERY pid at construction: a process that dies
@@ -179,8 +182,9 @@ class MetaCoordinatorService(network.MuxService):
         # (safe: the jax.distributed barrier precedes controller start,
         # so all processes exist by now and report within a heartbeat)
         self._last_seen = {p: time.monotonic()
-                           for p in range(num_processes)}
-        self._aborted = None             # (origin_rank, reason), sticky
+                           for p in range(num_processes)}  # guarded by self._cv
+        # (origin_rank, reason), sticky; guarded by self._cv
+        self._aborted = None
         self._log = get_logger()
         super().__init__(self.NAME, key)
 
@@ -203,7 +207,7 @@ class MetaCoordinatorService(network.MuxService):
         return super()._handle(req, client_address)
 
     # -------------------------------------------------- abort + liveness
-    def _initiate_abort(self, origin_rank, reason):
+    def _initiate_abort(self, origin_rank, reason):  # holds: self._cv
         """Emit one globally-ordered abort entry (caller holds the lock):
         every process applies it at the same point of the response
         stream and fails all of its ranks with the same typed error."""
@@ -216,7 +220,7 @@ class MetaCoordinatorService(network.MuxService):
         self._emit(LogEntry(self._next_seq(), "abort", error=reason,
                             origin=origin_rank))
 
-    def _check_liveness(self):
+    def _check_liveness(self):  # holds: self._cv
         """A process silent past the liveness window is presumed dead —
         convert the silence into an abort naming its first global rank
         (caller holds the lock).  Fully-joined processes are exempt:
@@ -235,7 +239,7 @@ class MetaCoordinatorService(network.MuxService):
                 f"process {dead[0]} (ranks from {base}) sent no heartbeat "
                 f"for more than {self._liveness:g}s (presumed dead)")
 
-    def _required_pids(self):
+    def _required_pids(self):  # holds: self._cv
         """Processes that still host at least one non-joined rank."""
         out = set()
         base = 0
@@ -293,7 +297,7 @@ class MetaCoordinatorService(network.MuxService):
                 self._cv.wait(timeout=remaining)
 
     # ------------------------------------------------------- response build
-    def _advance(self):
+    def _advance(self):  # holds: self._cv
         """Emit log entries for names every required process reported.
         Caller holds the lock."""
         required = self._required_pids()
@@ -372,11 +376,11 @@ class MetaCoordinatorService(network.MuxService):
                     joined=sorted(self._joined)))
         self._maybe_emit_join_done()
 
-    def _join_done_ready(self):
+    def _join_done_ready(self):  # holds: self._cv
         return (self._joined and len(self._joined) == self._world
                 and not self._table)
 
-    def _maybe_emit_join_done(self):
+    def _maybe_emit_join_done(self):  # holds: self._cv
         if self._join_done_ready():
             # the last rank to join in coordinator-arrival order
             # (reference: join() returns the last joining rank so it can
@@ -388,15 +392,15 @@ class MetaCoordinatorService(network.MuxService):
             self._join_order.clear()
             self._join_epoch += 1
 
-    def _next_seq(self):
+    def _next_seq(self):  # holds: self._cv
         self._seq += 1
         return self._seq
 
-    def _emit(self, entry):
+    def _emit(self, entry):  # holds: self._cv
         self._log_entries.append(entry)
         self._cv.notify_all()
 
-    def _trim_log(self):
+    def _trim_log(self):  # holds: self._cv
         """Drop entries every process has acknowledged (via CycleMsg
         last_seq) — never an entry some process hasn't fetched yet."""
         if len(self._log_entries) < 1024 or len(self._acked) < self._nproc:
@@ -405,7 +409,7 @@ class MetaCoordinatorService(network.MuxService):
         self._log_entries = [e for e in self._log_entries if e.seq > floor]
 
     # ------------------------------------------------------------ validation
-    def _validate(self, name, entry):
+    def _validate(self, name, entry):  # holds: self._cv
         """Cross-process agreement (reference: ConstructResponse,
         controller.cc:378).  Returns (error, meta)."""
         reqs = list(entry.reqs.values())
@@ -499,7 +503,7 @@ class MetaCoordinatorService(network.MuxService):
         return (None, meta)
 
     # ----------------------------------------------------------------- stall
-    def _check_stalls(self):
+    def _check_stalls(self):  # holds: self._cv
         """Caller holds the lock (reference: StallInspector on rank 0)."""
         now = time.monotonic()
         for name, entry in list(self._table.items()):
@@ -566,7 +570,7 @@ class GlobalMeshController(PythonController):
 
     # -------------------------------------------------------------- lifecycle
     def start(self):
-        key_b64 = os.environ.get(env_util.HVD_SECRET_KEY)
+        key_b64 = env_util.get_str(env_util.HVD_SECRET_KEY)
         if key_b64:
             self._key = base64.b64decode(key_b64)
         else:
@@ -574,7 +578,7 @@ class GlobalMeshController(PythonController):
             # A key derived from the (public) rendezvous address would
             # let anyone who can reach the port forge HMACs and drive
             # pickle deserialization — refuse instead of degrading.
-            addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
+            addr = env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR)
             if addr not in (None, "localhost", "127.0.0.1", "::1"):
                 raise RuntimeError(
                     "global-mesh mode on a non-loopback rendezvous "
@@ -583,11 +587,11 @@ class GlobalMeshController(PythonController):
                     "from public values")
             import hashlib
             seed = ((addr or "local") +
-                    os.environ.get(env_util.HVD_RENDEZVOUS_PORT, "0"))
+                    env_util.get_str(env_util.HVD_RENDEZVOUS_PORT, "0"))
             self._key = hashlib.sha256(seed.encode()).digest()
 
-        addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
-        port = os.environ.get(env_util.HVD_RENDEZVOUS_PORT)
+        addr = env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR)
+        port = env_util.get_str(env_util.HVD_RENDEZVOUS_PORT)
         from horovod_tpu.run import http_client
         if self._pid == 0:
             from horovod_tpu.ops.autotune import AutotuneManager
@@ -675,7 +679,7 @@ class GlobalMeshController(PythonController):
 
     @staticmethod
     def _filter_ifaces(tagged):
-        iface = os.environ.get(env_util.HVD_IFACE)
+        iface = env_util.get_str(env_util.HVD_IFACE)
         pinned = [(ip, p) for i, ip, p in tagged if i == iface]
         return pinned or [(ip, p) for _, ip, p in tagged]
 

@@ -186,15 +186,21 @@ class CoordinatorService(network.MuxService):
         self._stall_shutdown = stall_shutdown_sec
         self._liveness = liveness_timeout_sec
         self._cv = threading.Condition()
-        self._forming = {}          # name -> _Entry
-        self._joined = set()
-        self._join_waiters = []     # (rank, Event, [last_rank])
-        self._last_seen = {}        # rank -> monotonic ts of last message
-        self._abort = None          # (origin_rank, reason), sticky
+        self._forming = {}          # name -> _Entry; guarded by self._cv
+        self._joined = set()        # guarded by self._cv
+        # (rank, Event, [last_rank]); guarded by self._cv
+        self._join_waiters = []
+        # rank -> monotonic ts of last message; guarded by self._cv
+        self._last_seen = {}
+        # (origin_rank, reason), sticky: written once under self._cv;
+        # guarded by self._cv (the lock-free reads below are annotated —
+        # a stale None is at worst one poll late, never wrong)
+        self._abort = None
         self._sig_cache = SignatureCache(cache_capacity)
-        self._ring_seq = 0               # unique id per ring round
+        self._ring_seq = 0     # unique id per ring round; guarded by self._cv
         self._autotune = autotune        # rank-0-owned manager | None
-        self._published = None           # (seq, tuned knob dict)
+        # (seq, tuned knob dict); guarded by self._publish_lock
+        self._published = None
         self._publish_lock = threading.Lock()
         self._log = get_logger()
         super().__init__(self.NAME, key)
@@ -211,7 +217,9 @@ class CoordinatorService(network.MuxService):
             return self._handle_join(req)
         if isinstance(req, network.HeartbeatMsg):
             self._check_liveness()
-            return network.HeartbeatReply(abort=self._abort)
+            # sticky set-once flag: a stale None here is one heartbeat
+            # late, never wrong
+            return network.HeartbeatReply(abort=self._abort)  # hvd-lint: ignore[lock-discipline]
         if isinstance(req, network.AbortMsg):
             self._initiate_abort(req.origin_rank, req.reason)
             return network.AckResponse()
@@ -228,7 +236,9 @@ class CoordinatorService(network.MuxService):
 
     # -------------------------------------------------- abort + liveness
     def _abort_result(self):
-        origin, reason = self._abort
+        # sticky flag, set-once before the waiter events fire: callers
+        # only reach here after observing it non-None
+        origin, reason = self._abort  # hvd-lint: ignore[lock-discipline]
         return ResultMsg(
             error=f"collective runtime aborted (origin rank {origin}): "
                   f"{reason}",
@@ -260,7 +270,8 @@ class CoordinatorService(network.MuxService):
     def _check_liveness(self):
         """Convert a silently-dead peer (no message within the liveness
         window) into a coordinated abort instead of an indefinite wait."""
-        if self._liveness <= 0 or self._abort is not None:
+        # sticky-flag fast path; _initiate_abort re-checks under the lock
+        if self._liveness <= 0 or self._abort is not None:  # hvd-lint: ignore[lock-discipline]
             return
         now = time.monotonic()
         with self._cv:
@@ -273,7 +284,7 @@ class CoordinatorService(network.MuxService):
                 f"rank {dead[0]} sent no heartbeat for more than "
                 f"{self._liveness:g}s (presumed dead)")
 
-    def _ready(self, entry):
+    def _ready(self, entry):  # holds: self._cv
         """Ready once every live (non-joined) rank has contributed — a
         raw count would let a since-joined rank's own request stand in
         for a live rank's missing one (silent wrong result)."""
@@ -302,7 +313,8 @@ class CoordinatorService(network.MuxService):
         deadline = (time.monotonic() + self._stall_shutdown
                     if self._stall_shutdown > 0 else None)
         while not entry.done.wait(timeout=1.0):
-            if self._abort is not None:
+            # sticky-flag poll; the typed result is taken under the lock
+            if self._abort is not None:  # hvd-lint: ignore[lock-discipline]
                 # abort raced entry creation: take the typed result (and
                 # drop the orphaned entry so it can't pin the join
                 # barrier)
@@ -340,7 +352,8 @@ class CoordinatorService(network.MuxService):
                     f"threshold of {self._stall_shutdown}s (waiting on "
                     f"ranks {missing})")
                 break
-        if self._abort is not None and req.rank not in entry.results:
+        # sticky-flag read: once done fired, results are immutable
+        if self._abort is not None and req.rank not in entry.results:  # hvd-lint: ignore[lock-discipline]
             return self._abort_result()
         return entry.results.get(req.rank,
                                  ResultMsg(error="internal: no result"))
@@ -358,12 +371,16 @@ class CoordinatorService(network.MuxService):
                 if entry.requests and self._ready(entry):
                     self._complete(name, entry)
             self._check_join_barrier()
+        # wakeable: _initiate_abort and _check_join_barrier both set
+        # every registered join-waiter event (tested by test_stall's
+        # join-barrier abort coverage)
         event.wait()
-        if slot[0] is None and self._abort is not None:
-            return JoinDoneMsg(None, abort=self._abort)
+        # sticky flag: the abort path set slot[0]=None before event.set
+        if slot[0] is None and self._abort is not None:  # hvd-lint: ignore[lock-discipline]
+            return JoinDoneMsg(None, abort=self._abort)  # hvd-lint: ignore[lock-discipline]
         return JoinDoneMsg(slot[0])
 
-    def _check_join_barrier(self):
+    def _check_join_barrier(self):  # holds: self._cv
         # all ranks joined and nothing pending -> release joins (reference:
         # controller joined handling: the join barrier completes only when
         # the tensor table is empty)
@@ -377,7 +394,7 @@ class CoordinatorService(network.MuxService):
             self._joined.clear()
 
     # ------------------------------------------------------------- execution
-    def _complete(self, name, entry):
+    def _complete(self, name, entry):  # holds: self._cv
         """Validate cross-rank agreement and compute every rank's result
         (reference: ConstructResponse validation + the backend op)."""
         del self._forming[name]
@@ -414,7 +431,9 @@ class CoordinatorService(network.MuxService):
                             or upd[0] > self._published[0]):
                         self._published = upd
                         self._sig_cache.enabled = upd[1]["cache_enabled"]
-        stamped = self._published
+        # latest-wins advisory read: a racing publish just means the
+        # stamp rides the next entry
+        stamped = self._published  # hvd-lint: ignore[lock-discipline]
         if stamped is not None:
             # stamp HERE (one point per entry), not at each rank's
             # return: every rank of the same collective must see the
@@ -447,13 +466,14 @@ class CoordinatorService(network.MuxService):
         value).  Stamped onto every ring_go so both endpoints of every
         hop derive the same segment plan even while a tuned value is
         still propagating rank by rank."""
-        published = self._published
+        # latest-wins advisory read (see _complete)
+        published = self._published  # hvd-lint: ignore[lock-discipline]
         if published is not None \
                 and "ring_segment_bytes" in published[1]:
             return int(published[1]["ring_segment_bytes"])
         return None
 
-    def _execute(self, name, entry):
+    def _execute(self, name, entry):  # holds: self._cv
         reqs = entry.requests
         first = next(iter(reqs.values()))
         rtype = RequestType(first.req_type)
@@ -664,37 +684,41 @@ class TcpController:
         self._size = topology.size
         self._coordinator = None
         self._client_addrs = None
-        self._mux = None
+        self._mux = None            # guarded by self._mux_lock
         self._mux_lock = threading.Lock()
         self._key = None
         self._peer_service = None
         self._ring = None
         self._ring_threshold = env_util.get_int(
-            "HVD_TCP_RING_THRESHOLD", DEFAULT_RING_THRESHOLD)
+            env_util.HVD_TCP_RING_THRESHOLD, DEFAULT_RING_THRESHOLD)
         self._autotune = None       # rank 0 only
-        self._tuned = None          # last applied (seq, params)
+        # last applied (seq, params); guarded by self._tuned_lock
+        self._tuned = None
         self._tuned_lock = threading.Lock()
-        self._abort_state = None    # (origin_rank, reason), sticky
+        # (origin_rank, reason), sticky; guarded by self._abort_lock
+        self._abort_state = None
         self._abort_lock = threading.Lock()
-        self._inflight = {}         # id(handle) -> handle (abort fan-out)
+        # id(handle) -> handle (abort fan-out); guarded by self._abort_lock
+        self._inflight = {}
         self._hb_stop = threading.Event()
         self._hb_thread = None
         self._log = get_logger()
 
     # -------------------------------------------------------------- lifecycle
     def start(self):
-        key_b64 = os.environ.get(env_util.HVD_SECRET_KEY)
+        key_b64 = env_util.get_str(env_util.HVD_SECRET_KEY)
         if key_b64:
             self._key = base64.b64decode(key_b64)
         else:
             # standalone/testing: derive a per-job key from the rendezvous
             # location so all ranks agree
-            seed = (os.environ.get(env_util.HVD_RENDEZVOUS_ADDR, "local") +
-                    os.environ.get(env_util.HVD_RENDEZVOUS_PORT, "0"))
+            seed = (env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR,
+                                     "local") +
+                    env_util.get_str(env_util.HVD_RENDEZVOUS_PORT, "0"))
             self._key = hashlib.sha256(seed.encode()).digest()
 
-        addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
-        port = os.environ.get(env_util.HVD_RENDEZVOUS_PORT)
+        addr = env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR)
+        port = env_util.get_str(env_util.HVD_RENDEZVOUS_PORT)
         if self._rank == 0:
             from horovod_tpu.ops.autotune import AutotuneManager
             self._autotune = AutotuneManager.create(self._config,
@@ -784,8 +808,8 @@ class TcpController:
     def _peer_addrs(self, rank, resolve_timeout, retry_for=None):
         from horovod_tpu.run import http_client
 
-        addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
-        port = os.environ.get(env_util.HVD_RENDEZVOUS_PORT)
+        addr = env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR)
+        port = env_util.get_str(env_util.HVD_RENDEZVOUS_PORT)
         kwargs = {} if retry_for is None else {"retry_for": retry_for}
         blob = http_client.get(addr, int(port), PEERS_SCOPE, str(rank),
                                timeout=resolve_timeout,
@@ -818,7 +842,7 @@ class TcpController:
         """Pin to the launcher-discovered interface when HVD_IFACE is set
         and the coordinator advertises it; otherwise keep every address
         (reference: NIC discovery exporting the common interface)."""
-        iface = os.environ.get(env_util.HVD_IFACE)
+        iface = env_util.get_str(env_util.HVD_IFACE)
         pinned = [(ip, p) for i, ip, p in tagged if i == iface]
         return pinned or [(ip, p) for _, ip, p in tagged]
 
@@ -1050,8 +1074,10 @@ class TcpController:
                 self._local_abort(
                     0, f"coordinator unreachable during negotiation of "
                        f"'{request.name}': {exc}")
+                # sticky: _local_abort just set it (or an earlier abort
+                # did); set-once means this read cannot tear
                 request.handle.set_error(
-                    HvdAbortedError(*self._abort_state))
+                    HvdAbortedError(*self._abort_state))  # hvd-lint: ignore[lock-discipline]
                 return
             self._timeline.end(request.name)
             self._maybe_apply_params(resp)
@@ -1256,8 +1282,9 @@ class TcpController:
         ParameterManager values after SynchronizeParameters)."""
         if self._autotune is not None:    # rank 0: live tuner view
             return self._autotune.params()
-        if self._tuned is not None:
-            return dict(self._tuned[1])
+        with self._tuned_lock:
+            if self._tuned is not None:
+                return dict(self._tuned[1])
         from horovod_tpu.ops.autotune import default_params
         return default_params(self._config)
 
@@ -1266,16 +1293,20 @@ class TcpController:
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5)
             self._hb_thread = None
-        if self._size > 1 and self._mux is not None \
-                and self._abort_state is None:
+        with self._abort_lock:
+            aborted = self._abort_state is not None
+        with self._mux_lock:
+            mux = self._mux
+        if self._size > 1 and mux is not None and not aborted:
             try:  # deregister from liveness (best-effort)
-                self._mux.send(ShutdownMsg(self._rank), timeout=5.0)
+                mux.send(ShutdownMsg(self._rank), timeout=5.0)
             except Exception:  # noqa: BLE001 — coordinator may be gone
                 pass
         self._merge_timelines()
-        if self._mux is not None:
-            self._mux.close()
-            self._mux = None
+        with self._mux_lock:
+            mux, self._mux = self._mux, None
+        if mux is not None:
+            mux.close()
         if self._ring is not None:
             self._ring.close()
             self._ring = None

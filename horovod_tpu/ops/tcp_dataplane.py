@@ -199,12 +199,14 @@ class PeerService(network.MuxService):
 
     def __init__(self, key):
         self._cv = threading.Condition()
-        self._mailbox = {}   # (tag, src) -> payload
+        self._mailbox = {}   # (tag, src) -> payload; guarded by self._cv
         # ring-id index over the mailbox: purge and the late-chunk drop
         # check are O(chunks of that ring), not O(total mailbox)
-        self._by_ring = {}   # ring_id -> set of mailbox keys
-        self._purged = collections.OrderedDict()  # ring_id -> None (LRU)
-        self._aborted = None  # (origin_rank, reason) once abort observed
+        self._by_ring = {}   # ring_id -> mailbox keys; guarded by self._cv
+        # ring_id -> None (LRU); guarded by self._cv
+        self._purged = collections.OrderedDict()
+        # (origin_rank, reason) once observed; guarded by self._cv
+        self._aborted = None
         # set by the controller: called (origin, reason) when a PEER
         # pushes an abort here, so in-flight negotiation handles fail
         # too, not just blocked ring recvs
@@ -306,8 +308,9 @@ class RingPlane:
         self._service = service
         self._resolve = resolve_peer
         self._resolve_bulk = resolve_bulk
-        self._clients = {}
-        self._stripe_pools = {}   # rank -> [StripeClient | None]
+        self._clients = {}        # rank -> MuxClient; guarded by self._lock
+        # rank -> [StripeClient | None]; guarded by self._lock
+        self._stripe_pools = {}
         self._lock = threading.Lock()
         self.segment_bytes = (env_util.get_int(
             env_util.HVD_TPU_RING_SEGMENT_BYTES, DEFAULT_SEGMENT_BYTES)
@@ -316,11 +319,14 @@ class RingPlane:
             env_util.HVD_TPU_RING_STRIPES, DEFAULT_STRIPES)
             if stripes is None else int(stripes))
         self._sendq = queue.Queue()
-        self._sender = None
-        self._send_error = None   # latest async send failure (sticky)
-        self._pending_sends = 0   # enqueued-but-unwritten segments
+        self._sender = None       # sender thread; guarded by self._lock
+        # latest async send failure (sticky, written by the sender
+        # thread, read by the compute thread); guarded by self._pending_cv
+        self._send_error = None
+        # enqueued-but-unwritten segments; guarded by self._pending_cv
+        self._pending_sends = 0
         self._pending_cv = threading.Condition()
-        self._closed = False
+        self._closed = False      # guarded by self._lock
 
     # ------------------------------------------------------------ transport
     def _peer(self, rank):
@@ -397,6 +403,9 @@ class RingPlane:
 
     def _sender_loop(self):
         while True:
+            # wakeable: close() enqueues the None sentinel; the abort
+            # path never needs to wake this thread (it only ever blocks
+            # when there is nothing left to write)
             item = self._sendq.get()
             if item is None:
                 return
@@ -410,9 +419,11 @@ class RingPlane:
             except Exception as exc:  # noqa: BLE001 — surface on the
                 # compute thread: its next send/recv of any round fails
                 # fast instead of waiting out the recv timeout
-                self._send_error = exc
+                with self._pending_cv:
+                    self._send_error = exc
                 # a recv already blocked on the mailbox must wake NOW:
                 # its error_check re-raises this under the condition
+                # (never nested with _pending_cv — no ordering edge)
                 with self._service._cv:
                     self._service._cv.notify_all()
             finally:
@@ -421,20 +432,29 @@ class RingPlane:
                     self._pending_cv.notify_all()
 
     def _raise_if_send_failed(self):
+        with self._pending_cv:
+            self._raise_if_send_failed_locked()
+
+    def _raise_if_send_failed_locked(self):  # holds: self._pending_cv
         if self._send_error is not None:
             raise ConnectionError(
                 f"ring bulk send failed: {self._send_error}")
 
     def _enqueue_segment(self, dst, stripe_i, tag, payload):
-        if self._sender is None:
-            with self._lock:
-                if self._sender is None and not self._closed:
-                    self._sender = threading.Thread(
-                        target=self._sender_loop, daemon=True,
-                        name="hvd-ring-sender")
-                    self._sender.start()
-        with self._pending_cv:
-            self._pending_sends += 1
+        # spawn-check and pending-count both under _lock: close() sets
+        # _closed under the same lock, so a segment can never be
+        # counted after close() decided nobody will ever drain it —
+        # that would strand a timeout-less _flush_sends forever
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("ring plane closed")
+            if self._sender is None:
+                self._sender = threading.Thread(
+                    target=self._sender_loop, daemon=True,
+                    name="hvd-ring-sender")
+                self._sender.start()
+            with self._pending_cv:
+                self._pending_sends += 1
         self._sendq.put(
             (dst, stripe_i, ChunkMsg(tag, self.rank, None), payload))
 
@@ -450,7 +470,7 @@ class RingPlane:
         deadline = (_time.monotonic() + timeout) if timeout else None
         with self._pending_cv:
             while self._pending_sends > 0:
-                self._raise_if_send_failed()
+                self._raise_if_send_failed_locked()
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - _time.monotonic()
@@ -458,8 +478,13 @@ class RingPlane:
                         raise TimeoutError(
                             f"{self._pending_sends} ring segments still "
                             f"unsent after {timeout}s")
+                # wakeable: every enqueued segment decrements
+                # _pending_sends under this condition, a sender failure
+                # notifies it, and close() fails any segments the
+                # sender exited without writing — timeout-less callers
+                # always wake
                 self._pending_cv.wait(timeout=remaining)
-        self._raise_if_send_failed()
+            self._raise_if_send_failed_locked()
 
     def send_chunk(self, dst, base_tag, payload, seg_bytes=None,
                    align=1):
@@ -519,6 +544,17 @@ class RingPlane:
         if sender is not None:
             self._sendq.put(None)
             sender.join(timeout=5)
+        # a racing _enqueue_segment may have counted a segment the
+        # (now-exiting) sender never wrote: fail it loudly so a blocked
+        # _flush_sends raises instead of waiting forever
+        with self._pending_cv:
+            if self._pending_sends > 0:
+                if self._send_error is None:
+                    self._send_error = ConnectionError(
+                        f"ring plane closed with {self._pending_sends} "
+                        f"segment(s) unsent")
+                self._pending_sends = 0
+                self._pending_cv.notify_all()
         for client in clients:
             client.close()
         for stripe in stripes:

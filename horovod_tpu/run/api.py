@@ -6,7 +6,6 @@ env contract, and collect per-rank return values.
 """
 
 import base64
-import os
 import pickle
 import sys
 
@@ -46,7 +45,7 @@ def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
         # are HMAC-signed with the job secret so a network peer cannot
         # inject pickles into the workers or the driver
         supplied = (extra_env or {}).get(env_util.HVD_SECRET_KEY) \
-            or os.environ.get(env_util.HVD_SECRET_KEY)
+            or env_util.get_str(env_util.HVD_SECRET_KEY)
         key = base64.b64decode(supplied) if supplied \
             else secret_mod.make_secret_key()
 
@@ -66,7 +65,7 @@ def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
 
         # remote workers must reach the driver's KV store; honor the
         # same override + discovery the CLI path uses
-        addr = os.environ.get("HVD_RENDEZVOUS_HOST_ADDR")
+        addr = env_util.get_str(env_util.HVD_RENDEZVOUS_HOST_ADDR)
         if addr is None:
             from horovod_tpu.run.runner import _routable_addr
 

@@ -14,12 +14,16 @@ _PARAMS = {
     "cache_capacity": (env_util.HVD_CACHE_CAPACITY, "params.cache_capacity"),
     "hierarchical_allreduce": (env_util.HVD_HIERARCHICAL_ALLREDUCE, "params.hierarchical_allreduce"),
     "hierarchical_allgather": (env_util.HVD_HIERARCHICAL_ALLGATHER, "params.hierarchical_allgather"),
+    "hier_local_size": (env_util.HVD_HIER_LOCAL_SIZE,
+                        "params.hier_local_size"),
     "adasum_hierarchical": (env_util.HVD_ADASUM_HIERARCHICAL, "params.adasum_hierarchical"),
     "compression": (env_util.HVD_TPU_COMPRESSION, "params.compression"),
     "ring_segment_bytes": (env_util.HVD_TPU_RING_SEGMENT_BYTES,
                            "params.ring_segment_bytes"),
     "ring_stripes": (env_util.HVD_TPU_RING_STRIPES,
                      "params.ring_stripes"),
+    "tcp_ring_threshold": (env_util.HVD_TCP_RING_THRESHOLD,
+                           "params.tcp_ring_threshold"),
     "autotune": (env_util.HVD_AUTOTUNE, "autotune.enabled"),
     "autotune_log_file": (env_util.HVD_AUTOTUNE_LOG, "autotune.log_file"),
     "autotune_warmup_samples": (env_util.HVD_AUTOTUNE_WARMUP_SAMPLES, "autotune.warmup_samples"),
@@ -42,6 +46,8 @@ _PARAMS = {
                            "fault_tolerance.heartbeat_interval"),
     "liveness_timeout": (env_util.HVD_TPU_LIVENESS_TIMEOUT,
                          "fault_tolerance.liveness_timeout"),
+    "connect_retry_seconds": (env_util.HVD_TPU_CONNECT_RETRY_SECONDS,
+                              "fault_tolerance.connect_retry_seconds"),
     "fault_spec": (env_util.HVD_TPU_FAULT_SPEC, "fault_tolerance.spec"),
 }
 

@@ -5,13 +5,14 @@ deployments where every container reports the same hostname can force
 distinct identities."""
 
 import hashlib
-import os
 import socket
+
+from horovod_tpu.utils import env as env_util
 
 
 def host_hash(salt=None) -> str:
     hostname = socket.gethostname()
-    salt = salt if salt is not None else os.environ.get(
-        "HVD_HOSTNAME_HASH_SALT", "")
+    if salt is None:
+        salt = env_util.get_str(env_util.HVD_HOSTNAME_HASH_SALT, "")
     digest = hashlib.md5(f"{hostname}-{salt}".encode()).hexdigest()
     return f"{hostname.split('.')[0]}-{digest[:8]}"

@@ -98,6 +98,11 @@ def make_parser():
                        default=None)
     group.add_argument("--hierarchical-allgather", action="store_true",
                        default=None)
+    group.add_argument("--hier-local-size", type=int, default=None,
+                       help="Ranks per fast (ICI) group for "
+                            "hierarchical collectives "
+                            "(HVD_HIER_LOCAL_SIZE; default: the "
+                            "topology's local size).")
     group.add_argument("--adasum-hierarchical", action="store_true",
                        default=None,
                        help="Opt into the reference's NCCL+MPI-style "
@@ -118,6 +123,11 @@ def make_parser():
                        help="Dedicated bulk-data connections per ring "
                             "peer (HVD_TPU_RING_STRIPES); control "
                             "traffic always rides its own connection.")
+    group.add_argument("--tcp-ring-threshold", type=int, default=None,
+                       help="Payload bytes at/above which tcp-mode "
+                            "collectives ride the p2p ring instead of "
+                            "the coordinator star "
+                            "(HVD_TCP_RING_THRESHOLD, default 1 MB).")
     group.add_argument("--controller", choices=["native", "python", "tcp"],
                        default=None)
 
@@ -153,6 +163,12 @@ def make_parser():
                             "silent rank is declared dead and the round "
                             "is aborted (HVD_TPU_LIVENESS_TIMEOUT; 0 "
                             "disables).")
+    fault.add_argument("--connect-retry-seconds", type=float,
+                       default=None,
+                       help="Deadline budget in seconds for "
+                            "connection-establishment retries with "
+                            "backoff + jitter "
+                            "(HVD_TPU_CONNECT_RETRY_SECONDS).")
     fault.add_argument("--fault-spec", default=None,
                        help="Deterministic fault injection spec "
                             "(HVD_TPU_FAULT_SPEC), e.g. "
@@ -325,7 +341,7 @@ def run_commandline(argv=None) -> int:
 
     rendezvous = RendezvousServer()
     port = rendezvous.start()
-    addr = os.environ.get("HVD_RENDEZVOUS_HOST_ADDR")
+    addr = env_util.get_str(env_util.HVD_RENDEZVOUS_HOST_ADDR)
     if addr is None:
         from horovod_tpu.run.driver_discovery import maybe_discover
         discovered = maybe_discover(slots, ssh_port=args.ssh_port)
@@ -356,7 +372,7 @@ def _delegate_launch(args, slots, extra_env):
     ``common/topology._mpi_placed``), run ONE placement command."""
     rendezvous = RendezvousServer()
     port = rendezvous.start()
-    addr = os.environ.get("HVD_RENDEZVOUS_HOST_ADDR") \
+    addr = env_util.get_str(env_util.HVD_RENDEZVOUS_HOST_ADDR) \
         or _routable_addr(slots)
     env = dict(os.environ)
     env.update(extra_env)

@@ -50,9 +50,11 @@ class DriverService(network.BasicService):
 
     def __init__(self, num_proc, key):
         self._num_proc = num_proc
-        self._registered = {}          # index -> {iface: [(ip, port)]}
-        self._host_hashes = {}         # index -> host_hash
-        self._task_to_task = {}        # index -> {iface: [(ip, port)]}
+        # index -> {iface: [(ip, port)]}; guarded by self._cv
+        self._registered = {}
+        self._host_hashes = {}         # index -> hash; guarded by self._cv
+        # index -> {iface: [(ip, port)]}; guarded by self._cv
+        self._task_to_task = {}
         self._cv = threading.Condition()
         super().__init__(self.NAME, key)
 

@@ -156,14 +156,16 @@ def write_bulk_message(sock, key, obj, payload, direction):
 
 def _sendall_vec(sock, buffers):
     """sendall over a list of buffers without concatenating them (one
-    sendmsg syscall per iteration; falls back to per-buffer sendall)."""
+    sendmsg syscall per iteration; falls back to per-buffer sendall).
+    Only ever called with complete pre-signed frames built by
+    :func:`write_bulk_message`."""
     bufs = [memoryview(b).cast("B") for b in buffers if len(b)]
     if not hasattr(sock, "sendmsg"):
         for b in bufs:
-            sock.sendall(b)
+            sock.sendall(b)  # wire-safe: frame signed by the caller
         return
     while bufs:
-        sent = sock.sendmsg(bufs)
+        sent = sock.sendmsg(bufs)  # wire-safe: frame signed by caller
         while sent:
             if sent >= bufs[0].nbytes:
                 sent -= bufs[0].nbytes
@@ -229,6 +231,9 @@ def _read_bulk(sock, key, expected_direction, hdr_len, digest):
 def _read_exact(sock, n):
     buf = bytearray()
     while len(buf) < n:
+        # wakeable: closing the socket (peer abort/purge teardown, or
+        # the owner's close()) breaks the blocked recv with an OSError;
+        # callers set read timeouts where the protocol demands one
         chunk = sock.recv(n - len(buf))
         if not chunk:
             raise ConnectionError("peer closed connection")
@@ -243,6 +248,8 @@ def _read_exact_into(sock, n):
     view = memoryview(buf)
     got = 0
     while got < n:
+        # wakeable: socket close breaks the blocked recv (see
+        # _read_exact)
         r = sock.recv_into(view[got:], n - got)
         if not r:
             raise ConnectionError("peer closed connection")
@@ -477,7 +484,7 @@ class MuxService(BasicService):
     rendezvous per collective."""
 
     def __init__(self, name, key):
-        self._inflight = 0
+        self._inflight = 0   # guarded by self._inflight_cv
         self._inflight_cv = threading.Condition()
         super().__init__(name, key)
 
@@ -590,24 +597,25 @@ class MuxClient:
         self._timeout = timeout
         self._retry_for = (default_connect_retry() if retry_for is None
                            else retry_for)
-        self._sock = None
+        self._sock = None     # guarded by self._state_lock
         self._send_lock = threading.Lock()
         self._state_lock = threading.Lock()
-        self._pending = {}    # req_id -> [event, response]
+        # req_id -> [event, response]; guarded by self._state_lock
+        self._pending = {}
         # random start: a (req_id, resp) frame recorded from an earlier
         # connection/run cannot collide with a live request id
-        self._next_id = _secrets.randbits(48)
-        self._reader = None
-        self._broken = None
+        self._next_id = _secrets.randbits(48)  # guarded by self._state_lock
+        self._reader = None   # guarded by self._state_lock
+        self._broken = None   # guarded by self._state_lock
         # bulk companion: a StripeClient to the same service that
         # carries ONLY fire-and-forget raw frames, under its own lock —
         # a pending control request (heartbeat, negotiation, abort)
         # never waits behind an in-progress multi-MB bulk write
-        self._bulk = None
+        self._bulk = None     # guarded by self._bulk_lock
+        self._bytes_sent = 0  # control bytes; guarded by self._send_lock
         self._bulk_lock = threading.Lock()
-        self._bytes_sent = 0  # control bytes (guarded by _send_lock)
 
-    def _connect_locked(self):
+    def _connect_locked(self):  # holds: self._state_lock
         """Establish the socket + reader (caller holds _state_lock).
         Sweeps the address list with exponential backoff + jitter under
         the ``retry_for`` deadline budget: a refused/reset connection
@@ -621,7 +629,7 @@ class MuxClient:
             name="mux-client-reader")
         self._reader.start()
 
-    def _ensure_connected_locked(self):
+    def _ensure_connected_locked(self):  # holds: self._state_lock
         """Returns the live socket (caller holds _state_lock).  The
         returned reference — not a re-read of self._sock — must be used
         for the write, so a concurrent reconnect can never route this
@@ -697,11 +705,16 @@ class MuxClient:
     @property
     def bytes_sent(self):
         """Wire bytes written (control + bulk companion, framing
-        included) — each counter is mutated under its own lock; this
-        read-only sum is the byte-accounting surface the
-        wire-efficiency tests measure."""
-        bulk = self._bulk
-        return self._bytes_sent + (bulk.bytes_sent if bulk else 0)
+        included) — the own counter and the bulk reference are read
+        under their guarding locks; the companion's monotonic counter
+        is read staleness-tolerantly (it may lag an in-flight
+        post_bulk by one frame, which the quiesced-transfer
+        byte-accounting tests never observe)."""
+        with self._send_lock:
+            total = self._bytes_sent
+        with self._bulk_lock:
+            bulk = self._bulk
+        return total + (bulk.bytes_sent if bulk else 0)
 
     def post_bulk(self, obj, payload):
         """Fire-and-forget raw bulk frame on the dedicated bulk
@@ -754,7 +767,9 @@ class StripeClient:
         self._retry_for = (default_connect_retry() if retry_for is None
                            else retry_for)
         self._lock = threading.Lock()
-        self._sock = None
+        self._sock = None    # guarded by self._lock
+        # cumulative frame bytes written by post_bulk; external
+        # monotonic reads tolerate staleness; guarded by self._lock
         self.bytes_sent = 0
 
     def post_bulk(self, obj, payload):

@@ -11,25 +11,26 @@ would be ps-visible (the secret authenticates a service that can run
 commands)."""
 
 import base64
-import os
 import sys
 import time
 
 from horovod_tpu.run.service.driver_service import DriverClient
 from horovod_tpu.run.service.task_service import TaskService
+from horovod_tpu.utils import env as env_util
 
 
 def main():
-    index = int(os.environ["HVD_TASK_INDEX"])
+    index = int(env_util.get_required(env_util.HVD_TASK_INDEX))
     key = base64.b64decode(sys.stdin.readline().strip())
     if not key:
         sys.stderr.write("task server: no secret on stdin\n")
         return 1
     driver_addrs = []
-    for part in os.environ["HVD_DRIVER_ADDRS"].split(";"):
+    for part in env_util.get_required(env_util.HVD_DRIVER_ADDRS) \
+            .split(";"):
         ip, port = part.rsplit(":", 1)
         driver_addrs.append((ip, int(port)))
-    timeout = float(os.environ.get("HVD_TASK_TIMEOUT", "120"))
+    timeout = env_util.get_float(env_util.HVD_TASK_TIMEOUT, 120.0)
 
     task = TaskService(index, key)
     try:

@@ -47,10 +47,11 @@ def _driver_watchdog(addr, port):
 
 
 def main():
-    addr = os.environ[env_util.HVD_RENDEZVOUS_ADDR]
-    port = int(os.environ[env_util.HVD_RENDEZVOUS_PORT])
-    rank = int(os.environ[env_util.HVD_RANK])
-    key = base64.b64decode(os.environ[env_util.HVD_SECRET_KEY])
+    addr = env_util.get_required(env_util.HVD_RENDEZVOUS_ADDR)
+    port = int(env_util.get_required(env_util.HVD_RENDEZVOUS_PORT))
+    rank = int(env_util.get_required(env_util.HVD_RANK))
+    key = base64.b64decode(
+        env_util.get_required(env_util.HVD_SECRET_KEY))
 
     threading.Thread(target=_driver_watchdog, args=(addr, port),
                      daemon=True, name="hvd-driver-watchdog").start()

@@ -1,0 +1,3 @@
+"""Developer tooling that ships with the framework (not imported by the
+runtime): ``tools.lint`` is the project-invariant static-analysis suite
+behind ``bin/hvd-lint`` (docs/linting.md)."""

@@ -1,0 +1,15 @@
+"""hvd-lint: AST-based enforcement of the project's concurrency,
+configuration and wire-safety invariants (docs/linting.md).
+
+Public surface: :func:`run_lint` (used by tests/test_lint.py and
+``bin/hvd-lint``), :class:`Finding`, and the checker registry.
+"""
+
+from horovod_tpu.tools.lint.findings import Finding  # noqa: F401
+
+
+def run_lint(paths, config=None, checkers=None):
+    # lazy: importing the package must not drag argparse/checker deps
+    # into runtime imports of horovod_tpu.tools
+    from horovod_tpu.tools.lint.cli import run_lint as _run
+    return _run(paths, config=config, checkers=checkers)

@@ -1,0 +1,32 @@
+"""Checker registry.  Every checker module exposes
+
+    NAME: str                      # the id used in ignore[...] comments
+    check(project, config) -> [Finding]
+
+``config`` keys (all optional — a missing/None scope means "all loaded
+modules", which is what the fixture tests use; the project policy in
+``cli.py`` narrows each checker to the modules whose invariants it
+encodes):
+
+- ``lock_modules``: relpath suffixes checked for lock discipline
+- ``wakeability_modules``: relpath suffixes on the collective path
+- ``wire_pickle_allowlist``: modules allowed to unpickle network input
+- ``docs_dir``: where the tri-surface checker greps for knob mentions
+- ``skip_tri_surface``: disable the project-level tri-surface rule
+"""
+
+from horovod_tpu.tools.lint.checkers import (
+    config_surface,
+    lock_discipline,
+    lock_order,
+    wakeability,
+    wire_safety,
+)
+
+ALL_CHECKERS = {
+    lock_discipline.NAME: lock_discipline,
+    lock_order.NAME: lock_order,
+    wakeability.NAME: wakeability,
+    config_surface.NAME: config_surface,
+    wire_safety.NAME: wire_safety,
+}

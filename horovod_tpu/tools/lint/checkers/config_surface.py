@@ -1,0 +1,273 @@
+"""Checker 4 — config tri-surface.
+
+The configuration model (docs/running.md, docs/tuning.md): every knob
+exists on three surfaces — ``HVD_*`` env var, ``hvdrun`` CLI flag, and
+YAML config-file key — and the env surface is reached ONLY through a
+``utils/env.py`` constant plus typed getter, so a malformed value warns
+once instead of silently vanishing and the knob inventory stays
+greppable in one file.
+
+Rules:
+
+- **raw-env-read**: ``os.environ.get(...)`` / ``os.environ[...]`` /
+  ``os.getenv(...)`` of an ``HVD_*`` key anywhere outside
+  ``utils/env.py`` — route through ``env_util.get_str/int/float/bool``
+  (or ``get_required`` for hard launcher-contract reads).  Writes
+  (``os.environ[X] = ...``) are launcher plumbing and stay raw.
+- **literal-key**: an env getter called with a string literal instead
+  of the declared ``env_util`` constant (or with an ``HVD_*`` literal
+  that has no constant at all — declare it).
+- **tri-surface** (project-level, evaluated when ``utils/env.py`` is in
+  the scan): every knob constant — anything not listed in env.py's
+  ``LAUNCHER_CONTRACT`` — must appear in ``run/config_parser.py``'s
+  ``_PARAMS``/``_NEGATIONS`` mapping, its mapped arg must exist as an
+  ``hvdrun`` ``--flag`` in ``run/runner.py``, and the variable must be
+  mentioned somewhere under ``docs/``.
+"""
+
+import ast
+import os
+
+from horovod_tpu.tools.lint import model
+from horovod_tpu.tools.lint.findings import Finding
+
+NAME = "config-surface"
+
+_ENV_READ_FUNCS = {"os.environ.get", "environ.get", "os.getenv",
+                   "getenv"}
+_ENV_SUBSCRIPTS = {"os.environ", "environ"}
+_GETTER_BASES = {"env_util", "env"}
+
+
+def _env_py(project):
+    return project.find_module("utils/env.py")
+
+
+def _constants(env_module):
+    """{py_name: env_var_value} for top-level HVD_* string constants."""
+    out = {}
+    for node in env_module.tree.body:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and node.value.value.startswith("HVD"):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    out[target.id] = node.value.value
+    return out
+
+
+def _contract(env_module):
+    """Py names listed in env.py's LAUNCHER_CONTRACT declaration."""
+    for node in env_module.tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "LAUNCHER_CONTRACT"
+                for t in node.targets):
+            return {n.id for n in ast.walk(node.value)
+                    if isinstance(n, ast.Name)
+                    and n.id.startswith("HVD")}
+    return set()
+
+
+def check(project, config):
+    findings = []
+    env_module = _env_py(project)
+    constants = _constants(env_module) if env_module else {}
+    values = set(constants.values())
+
+    for module in project.modules.values():
+        if module is env_module:
+            continue
+        findings.extend(_check_reads(module, constants, values))
+
+    if env_module is not None and not config.get("skip_tri_surface"):
+        findings.extend(_check_tri_surface(
+            project, config, env_module, constants,
+            _contract(env_module)))
+    return findings
+
+
+def _is_env_getter(module, callee):
+    """True when ``callee`` denotes a utils/env.py typed getter —
+    through a module alias (``env_util.get_int``) or a bare from-import
+    (``from horovod_tpu.utils.env import get_int``), resolved via the
+    module's import map so neither spelling escapes the literal-key
+    rule."""
+    if "." in callee:
+        base, meth = callee.rsplit(".", 1)
+        if not meth.startswith("get_"):
+            return False
+        if base.rsplit(".", 1)[-1] in _GETTER_BASES:
+            return True
+        dotted = module.imports.get(base, "")
+        return dotted.endswith("utils.env")
+    if not callee.startswith("get_"):
+        return False
+    return module.imports.get(callee, "").endswith(
+        f"utils.env.{callee}")
+
+
+def _key_env_name(node, constants):
+    """The HVD_* env-var name an expression denotes, or None: a string
+    literal, or an (aliased) env_util constant attribute/name."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value.startswith("HVD") else None
+    text = model.expr_text(node)
+    if text is None:
+        return None
+    tail = text.rsplit(".", 1)[-1]
+    return constants.get(tail)
+
+
+def _contexts(module):
+    """(start, end, ctx) spans for every function, innermost last —
+    finding keys must name the enclosing function, or one baselined
+    read would suppress every later read of the same var in the file."""
+    spans = []
+    for ctx, _cls, funcdef in model.iter_functions(module):
+        spans.append((funcdef.lineno,
+                      funcdef.end_lineno or funcdef.lineno, ctx))
+    spans.sort(key=lambda s: (s[0], -s[1]))
+    return spans
+
+
+def _context_at(spans, lineno):
+    best = "<module>"
+    for start, end, ctx in spans:
+        if start <= lineno <= end:
+            best = ctx  # spans are outermost-first at equal starts
+    return best
+
+
+def _check_reads(module, constants, values):
+    findings = []
+    spans = _contexts(module)
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            callee = model.expr_text(node.func) or ""
+            if callee in _ENV_READ_FUNCS and node.args:
+                name = _key_env_name(node.args[0], constants)
+                if name and not module.has_ignore(node.lineno, NAME):
+                    findings.append(Finding(
+                        NAME, module.relpath, node.lineno,
+                        _context_at(spans, node.lineno), name,
+                        f"raw os.environ read of {name} — use the "
+                        f"utils/env.py constant + typed getter"))
+            elif (_is_env_getter(module, callee)
+                  and node.args
+                  and isinstance(node.args[0], ast.Constant)
+                  and isinstance(node.args[0].value, str)
+                  and node.args[0].value.startswith("HVD")
+                  and not module.has_ignore(node.lineno, NAME)):
+                literal = node.args[0].value
+                declared = literal in values
+                findings.append(Finding(
+                    NAME, module.relpath, node.lineno,
+                    _context_at(spans, node.lineno), literal,
+                    f"env getter called with the string literal "
+                    f"{literal!r} — "
+                    + ("use the utils/env.py constant"
+                       if declared else
+                       "declare a constant for it in utils/env.py")))
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            base = model.expr_text(node.value)
+            if base in _ENV_SUBSCRIPTS:
+                name = _key_env_name(node.slice, constants)
+                if name and not module.has_ignore(node.lineno, NAME):
+                    findings.append(Finding(
+                        NAME, module.relpath, node.lineno,
+                        _context_at(spans, node.lineno), name,
+                        f"raw os.environ[{name}] read — use "
+                        f"env_util.get_required/get_str"))
+    return findings
+
+
+def _parse_params(config_module):
+    """{env_py_name: arg_name} from _PARAMS plus the set of env py
+    names covered by _NEGATIONS."""
+    params, negations = {}, set()
+    for node in config_module.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        target_names = {t.id for t in node.targets
+                        if isinstance(t, ast.Name)}
+        if "_PARAMS" in target_names \
+                and isinstance(node.value, ast.Dict):
+            for key, value in zip(node.value.keys, node.value.values):
+                if not (isinstance(key, ast.Constant)
+                        and isinstance(value, ast.Tuple)
+                        and value.elts):
+                    continue
+                env_text = model.expr_text(value.elts[0]) or ""
+                params[env_text.rsplit(".", 1)[-1]] = key.value
+        elif "_NEGATIONS" in target_names \
+                and isinstance(node.value, ast.Dict):
+            for value in node.value.values:
+                env_text = model.expr_text(value) or ""
+                negations.add(env_text.rsplit(".", 1)[-1])
+    return params, negations
+
+
+def _docs_mentions(docs_dir):
+    corpus = []
+    if docs_dir and os.path.isdir(docs_dir):
+        for name in sorted(os.listdir(docs_dir)):
+            if name.endswith(".md"):
+                try:
+                    with open(os.path.join(docs_dir, name),
+                              encoding="utf-8") as f:
+                        corpus.append(f.read())
+                except OSError:
+                    continue
+    return "\n".join(corpus)
+
+
+def _check_tri_surface(project, config, env_module, constants,
+                       contract):
+    findings = []
+    config_module = project.find_module("run/config_parser.py")
+    runner_module = project.find_module("run/runner.py")
+    if config_module is None or runner_module is None:
+        return findings  # partial scan: the project rule needs both
+    params, negations = _parse_params(config_module)
+    docs = _docs_mentions(config.get("docs_dir"))
+
+    for py_name, env_name in sorted(constants.items()):
+        if py_name in contract:
+            continue
+        if module_ignores(env_module, py_name):
+            continue
+        if py_name not in params and py_name not in negations:
+            findings.append(Finding(
+                NAME, config_module.relpath, 1, "tri-surface",
+                f"{env_name}:params",
+                f"knob {env_name} has no _PARAMS/_NEGATIONS mapping in "
+                f"run/config_parser.py (YAML + flag surface missing)"))
+            continue
+        arg = params.get(py_name)
+        if arg is not None:
+            flag = "--" + arg.replace("_", "-")
+            if flag not in runner_module.source:
+                findings.append(Finding(
+                    NAME, runner_module.relpath, 1, "tri-surface",
+                    f"{env_name}:flag",
+                    f"knob {env_name} maps to arg {arg!r} but hvdrun "
+                    f"defines no {flag} flag"))
+        if docs and env_name not in docs:
+            findings.append(Finding(
+                NAME, env_module.relpath, 1, "tri-surface",
+                f"{env_name}:docs",
+                f"knob {env_name} is mentioned nowhere under docs/"))
+    return findings
+
+
+def module_ignores(env_module, py_name):
+    """An ignore comment on the constant's declaration line exempts it
+    from the tri-surface rule (used for internal/experimental knobs)."""
+    for node in env_module.tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == py_name
+                for t in node.targets):
+            return env_module.has_ignore(node.lineno, NAME)
+    return False

@@ -1,0 +1,113 @@
+"""Checker 1 — lock discipline.
+
+The concurrency convention (docs/linting.md): a class that runs code on
+more than one thread declares which lock owns each shared mutable
+attribute with a ``# guarded by self._lock`` comment on the attribute's
+``__init__`` assignment.  This checker then enforces the declaration:
+every read/write of a guarded attribute anywhere in the class must
+happen lexically inside ``with self._lock:`` (or in a method annotated
+``# holds: self._lock``, the convention for ``*_locked`` helpers whose
+caller owns the lock).
+
+It also enforces adoption: inside the scoped modules, a class that both
+spawns a thread (itself or via a resolvable base class) and creates
+Lock/RLock/Condition attributes in ``__init__`` must declare at least
+one guarded attribute — the state it synchronizes cannot be entirely
+private to one thread, or it would not need the lock.
+
+Accesses through a ``handler = self``-style alias (the nested request
+handler closures in run/service/network.py) are resolved through the
+alias and checked the same way.
+"""
+
+import ast
+
+from horovod_tpu.tools.lint import model
+from horovod_tpu.tools.lint.findings import Finding
+
+NAME = "lock-discipline"
+
+
+def _self_aliases(cls):
+    """Names assigned from bare ``self`` anywhere in the class — the
+    closure-capture idiom (``service = self``) used by handler
+    factories."""
+    aliases = {"self"}
+    for method in cls.methods.values():
+        for node in ast.walk(method):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        aliases.add(target.id)
+    return aliases
+
+
+def check(project, config):
+    findings = []
+    scope = config.get("lock_modules")
+    for module in project.modules.values():
+        if not model.in_scope(module, scope):
+            continue
+        for cls in module.classes.values():
+            findings.extend(_check_class(project, module, cls))
+    return findings
+
+
+def _check_class(project, module, cls):
+    findings = []
+    guarded = project.class_guarded(cls)
+    lock_attrs = project.class_lock_attrs(cls)
+    own_locks = {a for a, kind in cls.lock_attrs.items()
+                 if kind in ("lock", "rlock", "condition")}
+    # adoption rule: the locks THIS class creates must guard something
+    # it declares — inherited declarations cover only inherited locks
+    own_declared = any(owner in own_locks
+                       for owner in cls.guarded.values())
+    if (own_locks and not own_declared
+            and project.class_spawns_thread(cls)
+            and not module.has_ignore(cls.node.lineno, NAME)):
+        findings.append(Finding(
+            NAME, module.relpath, cls.node.lineno, cls.name,
+            "undeclared-guards",
+            f"class {cls.name} spawns threads and creates lock(s) "
+            f"{sorted(own_locks)} but declares no '# guarded by "
+            f"self._lock' attributes for them (docs/linting.md)"))
+    if not guarded:
+        return findings
+
+    aliases = _self_aliases(cls)
+    for ctx_name, _cls, funcdef in model.iter_functions(module):
+        # only functions lexically inside this class
+        if _cls is not cls or funcdef.name == "__init__":
+            continue
+        held_annot = cls.holds.get(funcdef.name, set()) \
+            | module.scan_holds(funcdef)
+
+        def visit(node, stack, acquiring=None, _ctx=ctx_name,
+                  _held=held_annot):
+            if acquiring is not None or not isinstance(
+                    node, ast.Attribute):
+                return
+            if not (isinstance(node.value, ast.Name)
+                    and node.value.id in aliases):
+                return
+            attr = node.attr
+            owner = guarded.get(attr)
+            if owner is None:
+                return
+            if any(ref.attr == owner for ref in stack):
+                return
+            if owner in _held:
+                return
+            if module.has_ignore(node.lineno, NAME):
+                return
+            findings.append(Finding(
+                NAME, module.relpath, node.lineno, _ctx, attr,
+                f"'{attr}' is guarded by self.{owner} but accessed "
+                f"without it (annotate '# holds: self.{owner}' if the "
+                f"caller owns the lock)"))
+
+        model.walk_with_locks(funcdef, visit, known_attrs=lock_attrs)
+    return findings

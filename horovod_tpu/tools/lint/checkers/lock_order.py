@@ -1,0 +1,250 @@
+"""Checker 2 — lock ordering.
+
+Builds the cross-module lock-acquisition graph: an edge A -> B means
+some function acquires B (``with self._b:``) while lexically holding A,
+or calls — while holding A — a resolvable method/function that acquires
+B anywhere in its body (one level of call expansion; enough for the
+``_locked``-helper idiom without a full interprocedural analysis).
+
+Findings:
+
+- **cycle**: a strongly-connected component in the graph — two threads
+  taking the locks in opposite orders can deadlock;
+- **reacquire**: an edge A -> A on a non-reentrant primitive (a plain
+  ``Lock``/``Condition`` taken again while held deadlocks immediately);
+- **foreign-wait**: ``cv.wait()`` while holding a lock other than the
+  condition's own — the wait releases only the condition's lock, so the
+  foreign lock stays held for the whole sleep and anything that needs
+  it to produce the wakeup deadlocks.  ``Event.wait`` under any held
+  lock is flagged the same way.
+
+Lock identity: ``self._x`` (or a ``service = self`` alias) resolves to
+``(Class, attr)``; module-level locks to ``(module, name)``; locals
+(e.g. a per-connection ``write_lock``) to ``(function, name)``.
+"""
+
+import ast
+
+from horovod_tpu.tools.lint import model
+from horovod_tpu.tools.lint.findings import Finding
+from horovod_tpu.tools.lint.checkers.lock_discipline import _self_aliases
+
+NAME = "lock-order"
+
+
+class _FuncInfo:
+    __slots__ = ("module", "cls", "name", "acquired", "edges", "calls",
+                 "lock_kinds")
+
+    def __init__(self, module, cls, name):
+        self.module = module
+        self.cls = cls
+        self.name = name
+        self.acquired = set()     # every lock id taken anywhere inside
+        self.edges = []           # (held_id, taken_id, lineno)
+        self.calls = []           # (callee_text, held_ids, lineno)
+        self.lock_kinds = {}      # lock id -> kind (when resolvable)
+
+
+def check(project, config):
+    findings = []
+    funcs = {}
+
+    for module in project.modules.values():
+        for ctx, cls, funcdef in model.iter_functions(module):
+            info = _scan_function(project, module, cls, ctx, funcdef,
+                                  findings)
+            funcs[(module.dotted, cls.name if cls else None,
+                   funcdef.name)] = info
+
+    edges = {}   # (a, b) -> (relpath, lineno)
+    kinds = {}
+    for info in funcs.values():
+        kinds.update(info.lock_kinds)
+        for held, taken, lineno in info.edges:
+            edges.setdefault((held, taken),
+                             (info.module.relpath, lineno))
+        for callee, held_ids, lineno in info.calls:
+            target = _resolve_call(project, funcs, info, callee)
+            if target is None:
+                continue
+            for held in held_ids:
+                for taken in target.acquired:
+                    edges.setdefault((held, taken),
+                                     (info.module.relpath, lineno))
+
+    for (a, b), (relpath, lineno) in sorted(edges.items()):
+        # RLock is reentrant by definition; so is threading.Condition,
+        # whose default inner lock is an RLock (nested acquisition runs
+        # fine — only wait() semantics differ, covered by foreign-wait)
+        if a == b and kinds.get(a) not in ("rlock", "condition"):
+            findings.append(Finding(
+                NAME, relpath, lineno, _pretty(a),
+                f"reacquire:{_pretty(a)}",
+                f"non-reentrant lock {_pretty(a)} taken again while "
+                f"already held (deadlock)"))
+
+    for cycle in _cycles({(a, b) for a, b in edges if a != b}):
+        names = [_pretty(n) for n in cycle]
+        members = set(cycle)
+        evidence = sorted(e for e in edges
+                          if e[0] in members and e[1] in members)
+        relpath, lineno = edges[evidence[0]]
+        findings.append(Finding(
+            NAME, relpath, lineno, "lock-graph",
+            "cycle:" + "->".join(names),
+            f"lock-order cycle {' -> '.join(names + [names[0]])}: "
+            f"threads taking these locks in different orders can "
+            f"deadlock"))
+    return findings
+
+
+def _scan_function(project, module, cls, ctx, funcdef, findings):
+    info = _FuncInfo(module, cls, funcdef.name)
+    known = (project.class_lock_attrs(cls) if cls
+             else dict(module.module_locks))
+    aliases = _self_aliases(cls) if cls else {"self"}
+
+    def lock_id(text):
+        head, _, rest = text.partition(".")
+        attr = text.rsplit(".", 1)[-1]
+        if cls and head in aliases and rest:
+            # resolve to the class that DECLARES the lock, module-
+            # qualified: a lock inherited from a base must be one node
+            # whether it's taken in base or subclass methods, and two
+            # unrelated same-named classes in different modules must
+            # never merge (that would fabricate cycles)
+            owner = cls
+            if attr not in cls.lock_attrs:
+                for ancestor in project.ancestors(cls):
+                    if attr in ancestor.lock_attrs:
+                        owner = ancestor
+                        break
+            return ("cls", owner.module.dotted, owner.name, attr)
+        if not rest and text in module.module_locks:
+            return ("mod", module.dotted, text)
+        return ("loc", module.dotted, ctx, attr)
+
+    def visit(node, stack, acquiring=None):
+        if acquiring is not None:
+            taken = lock_id(acquiring.text)
+            info.acquired.add(taken)
+            kind = known.get(acquiring.attr)
+            if kind:
+                info.lock_kinds[taken] = kind
+            for held in stack:
+                info.edges.append((lock_id(held.text), taken,
+                                   node.lineno))
+            return
+        if not isinstance(node, ast.Call):
+            return
+        callee = model.expr_text(node.func)
+        if callee is None:
+            return
+        if stack:
+            info.calls.append(
+                (callee, [lock_id(h.text) for h in stack],
+                 node.lineno))
+        if callee.endswith(".wait") and stack \
+                and not module.has_ignore(node.lineno, NAME):
+            base = callee[:-len(".wait")]
+            base_attr = base.rsplit(".", 1)[-1]
+            kind = known.get(base_attr)
+            if kind is None and (base_attr.endswith("_cv")
+                                 or base_attr == "cv"):
+                kind = "condition"
+            if kind == "condition":
+                foreign = [h for h in stack if h.attr != base_attr]
+                if foreign:
+                    findings.append(Finding(
+                        NAME, module.relpath, node.lineno, ctx,
+                        f"foreign-wait:{base_attr}",
+                        f"{base}.wait() while holding "
+                        f"{[h.text for h in foreign]} — the wait only "
+                        f"releases the condition's own lock"))
+            elif kind == "event":
+                findings.append(Finding(
+                    NAME, module.relpath, node.lineno, ctx,
+                    f"foreign-wait:{base_attr}",
+                    f"{base}.wait() (an Event) while holding "
+                    f"{[h.text for h in stack]} — the held lock stays "
+                    f"taken for the whole wait"))
+
+    model.walk_with_locks(funcdef, visit, known_attrs=known)
+    return info
+
+
+def _resolve_call(project, funcs, info, callee):
+    parts = callee.split(".")
+    if len(parts) == 2 and parts[0] == "self" and info.cls:
+        key = (info.module.dotted, info.cls.name, parts[1])
+        if key in funcs:
+            return funcs[key]
+        for ancestor in project.ancestors(info.cls):
+            key = (ancestor.module.dotted, ancestor.name, parts[1])
+            if key in funcs:
+                return funcs[key]
+        return None
+    if len(parts) == 1:
+        return funcs.get((info.module.dotted, None, parts[0]))
+    return None
+
+
+def _cycles(edge_set):
+    """Strongly-connected components with >= 2 nodes, as ordered node
+    lists (iterative Tarjan — the graph is tiny but recursion depth is
+    not worth the risk)."""
+    graph = {}
+    for a, b in edge_set:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    index, low, on_stack = {}, {}, set()
+    stack, out, counter = [], [], [0]
+
+    for root in sorted(graph):
+        if root in index:
+            continue
+        work = [(root, iter(graph[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(graph[nxt])))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    member = stack.pop()
+                    on_stack.discard(member)
+                    scc.append(member)
+                    if member == node:
+                        break
+                if len(scc) > 1:
+                    out.append(sorted(scc))
+    return out
+
+
+def _pretty(lock_id):
+    if lock_id[0] == "cls":
+        return f"{lock_id[2]}.{lock_id[3]}"
+    if lock_id[0] == "mod":
+        return f"{lock_id[1]}:{lock_id[2]}"
+    return f"{lock_id[2]}:{lock_id[3]}"

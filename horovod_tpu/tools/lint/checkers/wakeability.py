@@ -1,0 +1,137 @@
+"""Checker 3 — abort wakeability.
+
+The fault-tolerance contract (docs/fault_tolerance.md): once any rank
+initiates a coordinated abort, every rank must raise the typed error
+within the abort deadline — so no blocking primitive on the collective
+path may sleep forever on an event only a (possibly dead) peer can
+produce.  Every ``Condition.wait`` / ``Event.wait`` / ``queue.get`` /
+``socket.recv`` in the scoped modules must either
+
+- carry a timeout argument (a ``timeout=None`` variable still passes —
+  the static check reads the signature, the runtime contract is the
+  caller's), or
+- be registered with the abort-wakeup set via a ``# wakeable: <how>``
+  annotation naming the mechanism that interrupts it (the abort
+  broadcast notifying the mailbox condition, a close() sentinel, socket
+  teardown breaking the recv...).
+
+Socket ``recv``/``recv_into`` can never express a timeout at the call
+site, so those always need the annotation.
+"""
+
+import ast
+
+from horovod_tpu.tools.lint import model
+from horovod_tpu.tools.lint.findings import Finding
+
+NAME = "abort-wakeability"
+
+_SOCKET_NAMES = {"sock", "s", "conn", "connection"}
+
+
+def _local_kinds(funcdef):
+    """var -> kind for locals assigned from sync-primitive or socket
+    constructors, plus socket-named parameters."""
+    kinds = {}
+    for arg in getattr(funcdef.args, "args", []):
+        if arg.arg in _SOCKET_NAMES or "sock" in arg.arg:
+            kinds[arg.arg] = "socket"
+    for node in ast.walk(funcdef):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        callee = model.expr_text(node.value.func) or ""
+        tail = callee.rsplit(".", 1)[-1]
+        kind = None
+        if tail in ("Condition",):
+            kind = "condition"
+        elif tail == "Event":
+            kind = "event"
+        elif tail in ("Queue", "LifoQueue", "PriorityQueue",
+                      "SimpleQueue"):
+            kind = "queue"
+        elif ("socket" in callee or "connect" in tail
+              or tail == "accept"):
+            kind = "socket"
+        if kind:
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    kinds[target.id] = kind
+    return kinds
+
+
+def _has_timeout(call, meth):
+    """Whether the call is bounded.  Signatures differ: for
+    ``Condition.wait(timeout)`` / ``Event.wait(timeout)`` the first
+    positional IS the timeout, but ``Queue.get(block, timeout)`` takes
+    ``block`` first — ``get(True)`` blocks forever and must not pass."""
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    if meth == "get":
+        if len(call.args) >= 2:
+            return True  # explicit (block, timeout) positionals
+        # get(False) / block=False is non-blocking, hence bounded
+        for value in list(call.args[:1]) + [
+                kw.value for kw in call.keywords if kw.arg == "block"]:
+            if isinstance(value, ast.Constant) and value.value is False:
+                return True
+        return False
+    return bool(call.args)
+
+
+def check(project, config):
+    findings = []
+    scope = config.get("wakeability_modules")
+    for module in project.modules.values():
+        if not model.in_scope(module, scope):
+            continue
+        for ctx, cls, funcdef in model.iter_functions(module):
+            attrs = project.class_lock_attrs(cls) if cls else {}
+            locals_ = _local_kinds(funcdef)
+
+            def kind_of(base):
+                tail = base.rsplit(".", 1)[-1]
+                if base in locals_:
+                    return locals_[base]
+                if tail in attrs:
+                    return attrs[tail]
+                if tail.endswith("_cv") or tail == "cv":
+                    return "condition"
+                if "sock" in tail:
+                    return "socket"
+                return None
+
+            def visit(node, stack, acquiring=None, _ctx=ctx):
+                if acquiring is not None or not isinstance(
+                        node, ast.Call):
+                    return
+                callee = model.expr_text(node.func)
+                if callee is None or "." not in callee:
+                    return
+                base, meth = callee.rsplit(".", 1)
+                kind = kind_of(base)
+                blocking = (
+                    (meth == "wait" and kind in ("condition", "event"))
+                    or (meth == "get" and kind == "queue")
+                    or (meth in ("recv", "recv_into")
+                        and kind == "socket"))
+                if not blocking:
+                    return
+                # recv can't take a timeout at the call site; the
+                # others pass with one
+                if meth not in ("recv", "recv_into") \
+                        and _has_timeout(node, meth):
+                    return
+                if module.is_wakeable_annotated(node.lineno) \
+                        or module.has_ignore(node.lineno, NAME):
+                    return
+                findings.append(Finding(
+                    NAME, module.relpath, node.lineno, _ctx, callee,
+                    f"blocking {callee}() on the collective "
+                    f"path with no timeout and no '# wakeable:' "
+                    f"registration — a coordinated abort cannot wake "
+                    f"it (docs/fault_tolerance.md)"))
+
+            model.walk_with_locks(funcdef, visit,
+                                  known_attrs=attrs)
+    return findings

@@ -1,0 +1,104 @@
+"""Checker 5 — wire safety.
+
+The transport's security model (run/service/network.py docstring): an
+unauthenticated peer must never reach the unpickler, and every frame
+that leaves a socket is HMAC-signed.  Statically:
+
+- **pickle-loads**: ``pickle.loads``/``load`` (and the cloudpickle /
+  ``_pickler`` aliases) is allowed only (a) inside the allowlisted
+  verified-transport modules, (b) in a function that also calls
+  ``secret.check``/``check_parts`` (the verify-then-deserialize idiom
+  of run/api.py and run/task_runner.py), or (c) under a
+  ``# wire-safe: <why>`` annotation for payloads that arrived through
+  an already-authenticated channel.
+- **raw-send**: direct ``sock.sendall``/``sendmsg`` outside the
+  transport module — frames must funnel through
+  ``network.write_message``/``write_bulk_message`` so they are signed.
+- **unsigned-send**: inside the transport module, a frame-emitting
+  function that never calls ``secret.sign``/``sign_parts`` (annotate
+  helpers that only forward pre-signed bytes).
+"""
+
+import ast
+
+from horovod_tpu.tools.lint import model
+from horovod_tpu.tools.lint.findings import Finding
+
+NAME = "wire-safety"
+
+_PICKLE_BASES = {"pickle", "cloudpickle", "_pickler"}
+
+
+def _function_calls(funcdef):
+    """Call nodes lexically in this function (nested defs excluded —
+    they are scanned as their own functions)."""
+    out = []
+    stack = list(funcdef.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def check(project, config):
+    findings = []
+    allowlist = config.get("wire_pickle_allowlist") or []
+    for module in project.modules.values():
+        allowlisted = any(module.relpath.endswith(s) for s in allowlist)
+        for ctx, _cls, funcdef in model.iter_functions(module):
+            calls = _function_calls(funcdef)
+            names = [(model.expr_text(c.func) or "", c) for c in calls]
+            has_check = any(
+                t.rsplit(".", 1)[-1] in ("check", "check_parts")
+                and ("secret" in t or "." not in t)
+                for t, _ in names)
+            has_sign = any(
+                t.rsplit(".", 1)[-1] in ("sign", "sign_parts")
+                and ("secret" in t or "." not in t)
+                for t, _ in names)
+            for text, call in names:
+                parts = text.rsplit(".", 1)
+                if len(parts) != 2:
+                    continue
+                base, meth = parts
+                if meth in ("loads", "load") \
+                        and base.rsplit(".", 1)[-1] in _PICKLE_BASES:
+                    if allowlisted or has_check:
+                        continue
+                    if module.is_wire_safe_annotated(call.lineno) \
+                            or module.has_ignore(call.lineno, NAME):
+                        continue
+                    findings.append(Finding(
+                        NAME, module.relpath, call.lineno, ctx,
+                        "pickle-loads",
+                        f"{text}() outside the HMAC-verified transport "
+                        f"with no secret.check in the same function — "
+                        f"an unauthenticated peer must never reach the "
+                        f"unpickler"))
+                elif meth in ("sendall", "sendmsg"):
+                    if module.is_wire_safe_annotated(call.lineno) \
+                            or module.has_ignore(call.lineno, NAME):
+                        continue
+                    if not allowlisted:
+                        findings.append(Finding(
+                            NAME, module.relpath, call.lineno, ctx,
+                            "raw-send",
+                            f"direct {text}() outside the signed "
+                            f"transport — emit frames through "
+                            f"network.write_message/write_bulk_message "
+                            f"so they are HMAC-signed"))
+                    elif not has_sign:
+                        findings.append(Finding(
+                            NAME, module.relpath, call.lineno, ctx,
+                            "unsigned-send",
+                            f"frame-emitting {text}() in a function "
+                            f"that never signs — every emitted frame "
+                            f"must carry an HMAC (annotate "
+                            f"'# wire-safe:' if it forwards pre-signed "
+                            f"bytes)"))
+    return findings

@@ -1,0 +1,155 @@
+"""``bin/hvd-lint`` — the project-invariant static-analysis gate.
+
+Usage::
+
+    bin/hvd-lint horovod_tpu/                 # the tier-1 gate run
+    bin/hvd-lint --format json horovod_tpu/   # machine-readable
+    bin/hvd-lint --checkers config-surface horovod_tpu/common/
+    bin/hvd-lint --write-baseline horovod_tpu/   # refresh suppressions
+
+Exit codes: 0 = clean (baselined findings included), 1 = active
+findings, 2 = usage error.  The baseline lives at
+``.hvd-lint-baseline.json`` in the repo root; the tier-1 gate
+(tests/test_lint.py) keeps it small and justified.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from horovod_tpu.tools.lint import findings as findings_mod
+from horovod_tpu.tools.lint import model
+from horovod_tpu.tools.lint.checkers import ALL_CHECKERS
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, ".hvd-lint-baseline.json")
+
+# The project policy: which modules each concurrency checker holds to
+# its invariant.  config-surface and wire-safety are global; the lock
+# and wakeability checkers scope to the concurrent runtime — the ring
+# data plane, the transport, and the controllers (docs/linting.md).
+PROJECT_CONFIG = {
+    "lock_modules": [
+        "ops/tcp_dataplane.py",
+        "ops/tcp_controller.py",
+        "ops/global_controller.py",
+        "run/service/network.py",
+        "run/service/driver_service.py",
+    ],
+    "wakeability_modules": [
+        "ops/tcp_dataplane.py",
+        "ops/tcp_controller.py",
+        "ops/global_controller.py",
+        "ops/python_controller.py",
+        "run/service/network.py",
+    ],
+    "wire_pickle_allowlist": [
+        "run/service/network.py",
+    ],
+    "docs_dir": os.path.join(REPO_ROOT, "docs"),
+}
+
+
+def run_lint(paths, config=None, checkers=None, _return_project=False):
+    """Programmatic entry: returns the list of findings (pre-baseline).
+    ``config=None`` applies the project policy; tests pass their own."""
+    project = model.load_project(paths)
+    cfg = PROJECT_CONFIG if config is None else config
+    out = []
+    for name, checker in ALL_CHECKERS.items():
+        if checkers is not None and name not in checkers:
+            continue
+        out.extend(checker.check(project, cfg))
+    out.sort(key=lambda f: (f.path, f.line, f.checker, f.detail))
+    if _return_project:
+        return out, project
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="hvd-lint",
+        description="Project-invariant static analysis for horovod_tpu "
+                    "(docs/linting.md).")
+    parser.add_argument("paths", nargs="*",
+                        default=[os.path.join(REPO_ROOT, "horovod_tpu")],
+                        help="Files or directories to scan "
+                             "(default: the horovod_tpu package).")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="Baseline JSON of suppressed finding keys "
+                             "(default: .hvd-lint-baseline.json in the "
+                             "repo root).")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="Report every finding, suppressing "
+                             "nothing.")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="Rewrite the baseline from the current "
+                             "findings (existing justifications are "
+                             "kept; new entries get a TODO the gate "
+                             "test rejects until justified).")
+    parser.add_argument("--checkers", default=None,
+                        help="Comma-separated checker subset "
+                             f"(available: {', '.join(ALL_CHECKERS)}).")
+    parser.add_argument("--format", choices=["text", "json"],
+                        default="text")
+    args = parser.parse_args(argv)
+
+    selected = None
+    if args.checkers:
+        selected = [c.strip() for c in args.checkers.split(",")]
+        unknown = [c for c in selected if c not in ALL_CHECKERS]
+        if unknown:
+            parser.error(f"unknown checker(s): {', '.join(unknown)}")
+
+    all_findings, project = run_lint(args.paths, checkers=selected,
+                                     _return_project=True)
+
+    baseline = {} if args.no_baseline else \
+        findings_mod.load_baseline(args.baseline)
+    if args.write_baseline:
+        # previous entries this run could not have re-observed — an
+        # unselected checker, or a path outside the scan — carry over
+        # verbatim: a scoped --write-baseline must never delete other
+        # scopes' justifications
+        scanned = set(project.modules)
+
+        def out_of_scope(key):
+            checker, _, rest = key.partition(":")
+            relpath = rest.partition(":")[0]
+            if selected is not None and checker not in selected:
+                return True
+            return relpath not in scanned
+
+        previous = findings_mod.load_baseline(args.baseline)
+        findings_mod.write_baseline(args.baseline, all_findings,
+                                    previous=previous,
+                                    out_of_scope=out_of_scope)
+        written = len(findings_mod.load_baseline(args.baseline))
+        print(f"wrote {written} suppression(s) to {args.baseline}")
+        return 0
+    active, suppressed, stale = findings_mod.split_baselined(
+        all_findings, baseline)
+
+    if args.format == "json":
+        json.dump({
+            "findings": [f.as_dict() for f in active],
+            "suppressed": [f.as_dict() for f in suppressed],
+            "stale_baseline_keys": stale,
+        }, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for finding in active:
+            print(finding.render())
+        summary = (f"hvd-lint: {len(active)} finding(s), "
+                   f"{len(suppressed)} baselined")
+        if stale:
+            summary += (f", {len(stale)} stale baseline key(s) — "
+                        f"run --write-baseline to prune")
+        print(summary)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

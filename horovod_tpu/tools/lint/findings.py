@@ -1,0 +1,107 @@
+"""Finding objects and the baseline-suppression workflow.
+
+A finding's ``key`` deliberately omits the line number: baselines must
+survive unrelated edits to the same file, so identity is
+``checker:relpath:context:detail`` where ``context`` is the enclosing
+``Class.method`` (or ``<module>``) and ``detail`` names the flagged
+thing (an attribute, an env var, a lock cycle...).  The line number is
+carried separately for display only.
+
+The baseline file is JSON::
+
+    {"suppressions": [
+        {"key": "config-surface:horovod_tpu/x.py:<module>:HVD_FOO",
+         "justification": "one line on why this is deliberately deferred"}
+    ]}
+
+``bin/hvd-lint --write-baseline`` regenerates it from the current
+findings (justifications of surviving keys are preserved); the tier-1
+gate (tests/test_lint.py) asserts the checked-in baseline stays small
+and justified.
+"""
+
+import json
+
+
+class Finding:
+    __slots__ = ("checker", "path", "line", "context", "detail", "message")
+
+    def __init__(self, checker, path, line, context, detail, message):
+        self.checker = checker
+        self.path = path          # repo-relative, forward slashes
+        self.line = line
+        self.context = context    # "Class.method" | "func" | "<module>"
+        self.detail = detail
+        self.message = message
+
+    @property
+    def key(self):
+        return f"{self.checker}:{self.path}:{self.context}:{self.detail}"
+
+    def as_dict(self):
+        return {"checker": self.checker, "path": self.path,
+                "line": self.line, "context": self.context,
+                "detail": self.detail, "message": self.message,
+                "key": self.key}
+
+    def render(self):
+        return (f"{self.path}:{self.line}: [{self.checker}] "
+                f"{self.message}  ({self.context})")
+
+    def __repr__(self):
+        return f"Finding({self.key!r})"
+
+
+def load_baseline(path):
+    """{key: justification} from the baseline JSON (missing file = {})."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {}
+    out = {}
+    for entry in data.get("suppressions", []):
+        out[entry["key"]] = entry.get("justification", "")
+    return out
+
+
+def write_baseline(path, findings, previous=None, out_of_scope=None):
+    """Write the current findings as the new baseline, keeping the old
+    justifications for keys that survive (new keys get a TODO marker the
+    gate test refuses, so every suppression is consciously justified).
+
+    ``out_of_scope(key) -> bool``: previous entries the current run
+    could not have re-observed (a ``--checkers`` subset or a sub-path
+    scan) are carried over verbatim instead of being silently deleted
+    with their justifications."""
+    previous = previous or {}
+    keys = {f.key for f in findings}
+    if out_of_scope is not None:
+        keys.update(k for k in previous
+                    if k not in keys and out_of_scope(k))
+    entries = []
+    for key in sorted(keys):
+        entries.append({
+            "key": key,
+            "justification": previous.get(
+                key, "TODO: justify this suppression"),
+        })
+    with open(path, "w") as f:
+        json.dump({"suppressions": entries}, f, indent=2)
+        f.write("\n")
+
+
+def split_baselined(findings, baseline):
+    """(active, suppressed) partition; also returns stale baseline keys
+    that no longer match any finding (kept in the exit-0 path — a stale
+    key is cleanup, not a failure — but surfaced in the report)."""
+    active, suppressed = [], []
+    matched = set()
+    for finding in findings:
+        if finding.key in baseline:
+            suppressed.append(finding)
+            matched.add(finding.key)
+        else:
+            active.append(finding)
+    stale = sorted(set(baseline) - matched)
+    return active, suppressed, stale

@@ -1,0 +1,449 @@
+"""Shared analysis core for the hvd-lint checkers.
+
+One pass builds, for every ``.py`` file under the scanned paths:
+
+- the AST plus a ``{lineno: comment}`` map (tokenize-based, so the
+  annotation conventions — ``guarded by self._lock``, ``holds:``,
+  ``wakeable:``, ``wire-safe:``, ``hvd-lint: ignore[...]`` — are read
+  from real comments, never from string literals);
+- an import-alias map (``from horovod_tpu.run.service import network``
+  makes ``network.MuxService`` resolvable to the loaded class model);
+- a class model per class: attributes assigned in ``__init__`` with the
+  synchronization primitive that created them (Lock / RLock / Condition
+  / Event / queue.Queue), the ``# guarded by self._X`` declarations,
+  whether the class (or any resolvable ancestor) spawns a
+  ``threading.Thread``, and per-method ``# holds: self._X``
+  caller-holds-the-lock annotations.
+
+Checkers consume this through :class:`Project` plus the CFG-lite
+:func:`walk_with_locks` walker, which visits every node of a function
+carrying the stack of ``with``-acquired locks lexically active there.
+A ``with`` context expression counts as a lock acquisition when it is a
+plain name/attribute chain (never a call) whose final component is a
+known synchronization attribute of the enclosing class or matches the
+naming convention (contains ``lock`` or ``cv``) — ``with sock:`` and
+``with open(...)`` never pollute the lock graph.
+"""
+
+import ast
+import io
+import os
+import re
+import tokenize
+
+_THREADING_LOCK_KINDS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Event": "event",
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+}
+
+_GUARDED_RE = re.compile(r"guarded by self\.(\w+)")
+_HOLDS_RE = re.compile(r"holds:\s*self\.(\w+)")
+_IGNORE_RE = re.compile(r"hvd-lint:\s*ignore\[([\w,\- ]+)\]")
+_WAKEABLE_RE = re.compile(r"wakeable:")
+_WIRE_SAFE_RE = re.compile(r"wire-safe:")
+
+
+def expr_text(node):
+    """Render a Name/Attribute chain ('self._cv', 'network.MuxService');
+    None for anything else (calls, subscripts...)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = expr_text(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+def _is_sync_ctor(node):
+    """'lock'/'rlock'/'condition'/'event'/'queue' when ``node`` is a
+    call to a synchronization-primitive constructor, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = None
+    if isinstance(node.func, ast.Attribute):
+        name = node.func.attr
+    elif isinstance(node.func, ast.Name):
+        name = node.func.id
+    if name in _THREADING_LOCK_KINDS:
+        return _THREADING_LOCK_KINDS[name]
+    if name in ("Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"):
+        return "queue"
+    return None
+
+
+def _spawns_thread(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = (node.func.attr if isinstance(node.func, ast.Attribute)
+                    else node.func.id if isinstance(node.func, ast.Name)
+                    else None)
+            if name == "Thread":
+                return True
+    return False
+
+
+class ClassModel:
+    def __init__(self, module, node):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.bases = [expr_text(b) for b in node.bases]
+        self.methods = {}          # name -> FunctionDef
+        self.lock_attrs = {}       # attr -> kind
+        self.guarded = {}          # attr -> owning lock attr
+        self.holds = {}            # method name -> set of lock attrs
+        self.spawns_thread = False
+
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[child.name] = child
+                holds = module.scan_holds(child)
+                if holds:
+                    self.holds[child.name] = holds
+        init = self.methods.get("__init__")
+        if init is not None:
+            self._scan_init(init)
+        self.spawns_thread = any(
+            _spawns_thread(m) for m in self.methods.values())
+
+    def _scan_init(self, init):
+        for node in ast.walk(init):
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    kind = _is_sync_ctor(node.value)
+                    if kind is not None:
+                        self.lock_attrs[target.attr] = kind
+                    # the annotation may sit on any line of the (possibly
+                    # multi-line) assignment, or on the contiguous block
+                    # of PURE comment lines directly above it (an inline
+                    # comment of the previous assignment must not leak
+                    # onto this one) — same semantics as annotated()
+                    parts = [self.module.comment(ln) for ln in
+                             range(node.lineno,
+                                   (node.end_lineno or node.lineno) + 1)]
+                    above = node.lineno - 1
+                    while 1 <= above <= len(self.module.lines) \
+                            and self.module.lines[above - 1].lstrip() \
+                            .startswith("#"):
+                        parts.append(self.module.comment(above))
+                        above -= 1
+                    match = _GUARDED_RE.search(" ".join(parts))
+                    if match and match.group(1) != target.attr:
+                        self.guarded[target.attr] = match.group(1)
+
+
+class SourceModule:
+    def __init__(self, path, relpath, source):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.comments = self._scan_comments(source)
+        self.dotted = relpath[:-3].replace("/", ".").replace("\\", ".")
+        self.imports = self._scan_imports()
+        self.classes = {n.name: ClassModel(self, n)
+                        for n in self.tree.body
+                        if isinstance(n, ast.ClassDef)}
+        # module-level lock assignments (e.g. _config_lock = Lock())
+        self.module_locks = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = _is_sync_ctor(node.value)
+                if kind is not None:
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            self.module_locks[target.id] = kind
+
+    @staticmethod
+    def _scan_comments(source):
+        comments = {}
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type == tokenize.COMMENT:
+                    comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass
+        return comments
+
+    def _scan_imports(self):
+        out = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    out[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    out[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+        return out
+
+    def comment(self, lineno):
+        return self.comments.get(lineno, "")
+
+    def annotated(self, lineno, regex):
+        """True when the line — or the contiguous block of pure comment
+        lines directly above it — carries a matching comment:
+        annotations routinely head a multi-line explanation of HOW the
+        invariant is satisfied."""
+        if regex.search(self.comment(lineno)):
+            return True
+        line = lineno - 1
+        while 1 <= line <= len(self.lines) \
+                and self.lines[line - 1].lstrip().startswith("#"):
+            if regex.search(self.comment(line)):
+                return True
+            line -= 1
+        return False
+
+    def has_ignore(self, lineno, checker):
+        # the line itself, or the line above ONLY when it is a pure
+        # comment line — an inline ignore on the previous code line
+        # must not leak onto the statement below it
+        lines = [lineno]
+        above = lineno - 1
+        if 1 <= above <= len(self.lines) \
+                and self.lines[above - 1].lstrip().startswith("#"):
+            lines.append(above)
+        for line in lines:
+            match = _IGNORE_RE.search(self.comment(line))
+            if match:
+                names = [c.strip() for c in match.group(1).split(",")]
+                if checker in names or "all" in names:
+                    return True
+        return False
+
+    def is_wakeable_annotated(self, lineno):
+        return self.annotated(lineno, _WAKEABLE_RE)
+
+    def is_wire_safe_annotated(self, lineno):
+        return self.annotated(lineno, _WIRE_SAFE_RE)
+
+    def scan_holds(self, funcdef):
+        """# holds: self._x annotations between the def line and the
+        first body statement (inclusive of the def line itself)."""
+        first = funcdef.body[0].lineno if funcdef.body else funcdef.lineno
+        held = set()
+        for line in range(funcdef.lineno, first + 1):
+            for match in _HOLDS_RE.finditer(self.comment(line)):
+                held.add(match.group(1))
+        return held
+
+
+class Project:
+    """All loaded modules plus cross-module class resolution."""
+
+    def __init__(self, modules):
+        self.modules = modules                    # relpath -> SourceModule
+        self._by_dotted = {m.dotted: m for m in modules.values()}
+
+    def find_module(self, suffix):
+        """The loaded module whose relpath ends with ``suffix``."""
+        for relpath, module in self.modules.items():
+            if relpath.endswith(suffix):
+                return module
+        return None
+
+    def resolve_class(self, module, base_text):
+        """ClassModel for a base-class expression seen in ``module``
+        ('MuxService' or 'network.MuxService'); None if unresolvable."""
+        if base_text is None:
+            return None
+        parts = base_text.split(".")
+        if len(parts) == 1:
+            found = module.classes.get(parts[0])
+            if found is not None:
+                return found
+            dotted = module.imports.get(parts[0])
+            if dotted and "." in dotted:
+                owner, cls = dotted.rsplit(".", 1)
+                target = self._by_dotted.get(owner)
+                if target:
+                    return target.classes.get(cls)
+            return None
+        alias, cls = parts[0], parts[-1]
+        dotted = module.imports.get(alias)
+        target = self._by_dotted.get(dotted) if dotted else None
+        if target is None:
+            # fall back on suffix match ('network' -> .../network.py)
+            for mod in self.modules.values():
+                if mod.dotted.endswith(f".{alias}") or mod.dotted == alias:
+                    target = mod
+                    break
+        return target.classes.get(cls) if target else None
+
+    def ancestors(self, cls):
+        """Resolvable ancestor ClassModels (closest first, cycles cut)."""
+        out, queue, seen = [], list(cls.bases), {cls.name}
+        while queue:
+            base = self.resolve_class(cls.module, queue.pop(0))
+            if base is None or base.name in seen:
+                continue
+            seen.add(base.name)
+            out.append(base)
+            queue.extend(base.bases)
+        return out
+
+    def class_spawns_thread(self, cls):
+        return cls.spawns_thread or any(
+            a.spawns_thread for a in self.ancestors(cls))
+
+    def class_lock_attrs(self, cls):
+        merged = {}
+        for ancestor in reversed(self.ancestors(cls)):
+            merged.update(ancestor.lock_attrs)
+        merged.update(cls.lock_attrs)
+        return merged
+
+    def class_guarded(self, cls):
+        merged = {}
+        for ancestor in reversed(self.ancestors(cls)):
+            merged.update(ancestor.guarded)
+        merged.update(cls.guarded)
+        return merged
+
+
+class LockRef:
+    __slots__ = ("text", "attr", "on_self")
+
+    def __init__(self, text):
+        self.text = text
+        self.attr = text.rsplit(".", 1)[-1]
+        self.on_self = text.startswith("self.")
+
+    def __repr__(self):
+        return f"LockRef({self.text})"
+
+
+def looks_like_lock(text, known_attrs):
+    """The with-expression heuristic (module docstring): a known sync
+    attribute of the class, or a name matching the lock/cv convention."""
+    attr = text.rsplit(".", 1)[-1]
+    if attr in known_attrs:
+        return known_attrs[attr] not in ("event", "queue")
+    low = attr.lower()
+    return "lock" in low or low.endswith("_cv") or low == "cv"
+
+
+def walk_with_locks(funcdef, callback, known_attrs=None):
+    """Visit every node of ``funcdef`` (skipping nested function/class
+    definitions, which run on other call stacks) calling
+    ``callback(node, lock_stack)`` where ``lock_stack`` is the tuple of
+    :class:`LockRef` for lexically-enclosing ``with`` lock acquisitions.
+    """
+    known_attrs = known_attrs or {}
+
+    def visit(node, stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return  # nested defs run on their own call stack
+        if isinstance(node, ast.With):
+            added = list(stack)
+            for item in node.items:
+                text = expr_text(item.context_expr)
+                if text and looks_like_lock(text, known_attrs):
+                    ref = LockRef(text)
+                    callback(item.context_expr, tuple(added),
+                             acquiring=ref)
+                    added.append(ref)
+                else:
+                    # a non-lock context manager (file, socket,
+                    # connect(...)) is ordinary code: visit it so
+                    # checkers see calls/accesses inside it
+                    visit(item.context_expr, tuple(added))
+            for child in node.body:
+                visit(child, tuple(added))
+            return
+        callback(node, stack, acquiring=None)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            visit(child, stack)
+
+    for stmt in funcdef.body:
+        visit(stmt, ())
+
+
+def in_scope(module, suffixes):
+    """Module-scope filter: ``suffixes`` is a list of relpath suffixes
+    (None = every module, which is what the fixture tests use)."""
+    if suffixes is None:
+        return True
+    return any(module.relpath.endswith(s) for s in suffixes)
+
+
+def iter_functions(module):
+    """(context_name, ClassModel | None, FunctionDef) for every function
+    in the module: methods with their class, plus module-level functions
+    (including the reference's nested handler factories — nested defs
+    are yielded with a dotted context so findings stay addressable)."""
+    def walk_body(body, prefix, cls):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = f"{prefix}{node.name}"
+                yield name, cls, node
+                yield from walk_body(node.body, f"{name}.", cls)
+            elif isinstance(node, ast.ClassDef):
+                inner_cls = module.classes.get(node.name, cls)
+                yield from walk_body(node.body, f"{prefix}{node.name}.",
+                                     inner_cls)
+
+    yield from walk_body(module.tree.body, "", None)
+
+
+def load_project(paths, exclude=()):
+    """Parse every .py under ``paths`` (files or directories) into a
+    :class:`Project`.  ``relpath`` is relative to the deepest common
+    root so finding keys are stable however the CLI is invoked."""
+    files = []
+    for path in paths:
+        path = os.path.abspath(path)
+        if os.path.isfile(path):
+            files.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and d not in exclude]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    files.append(os.path.join(dirpath, name))
+    modules = {}
+    root = _repo_root(files)
+    for path in files:
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            modules[relpath] = SourceModule(path, relpath, source)
+        except (OSError, SyntaxError, ValueError):
+            continue  # unreadable/unparsable files are not lint input
+    return Project(modules)
+
+
+def _repo_root(files):
+    """The repo root: the nearest ancestor of the first scanned file
+    that contains the horovod_tpu package (falls back to the common
+    prefix) — keys in the checked-in baseline are relative to it."""
+    if not files:
+        return os.getcwd()
+    probe = os.path.dirname(files[0])
+    while True:
+        if os.path.isdir(os.path.join(probe, "horovod_tpu")) \
+                or os.path.isdir(os.path.join(probe, ".git")):
+            return probe
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            return os.path.dirname(files[0])
+        probe = parent

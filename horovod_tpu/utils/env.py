@@ -5,9 +5,17 @@ Mirrors the reference's knob list (``horovod/common/common.h:61-88`` and
 TPU-appropriate defaults.  The launcher additionally exposes every knob as an
 ``hvdrun`` CLI flag and a YAML config-file key, keeping the reference's
 tri-surface config model.
+
+``bin/hvd-lint`` (docs/linting.md) machine-checks the model: every env
+read in the framework must go through a constant declared here plus a
+typed getter below, and every knob constant NOT listed in
+``LAUNCHER_CONTRACT`` must have an ``hvdrun`` flag, a YAML key in
+``run/config_parser.py`` and a mention under ``docs/``.
 """
 
+import logging
 import os
+import threading
 
 # --- knob names (reference: horovod/common/common.h:61-88) -------------------
 HVD_FUSION_THRESHOLD = "HVD_FUSION_THRESHOLD"          # bytes, default 64 MB
@@ -31,9 +39,12 @@ HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
 HVD_LOG_LEVEL = "HVD_LOG_LEVEL"
 HVD_LOG_HIDE_TIME = "HVD_LOG_HIDE_TIME"
 HVD_CONTROLLER = "HVD_CONTROLLER"                      # native | python | tcp
-HVD_CPU_OPERATIONS = "HVD_CPU_OPERATIONS"              # xla | ring | python
-HVD_ADASUM_CHUNK_SIZE = "HVD_ADASUM_CHUNK_SIZE"
-HVD_NUM_STREAMS = "HVD_NUM_STREAMS"
+# reference-parity placeholders (common.h knob list): declared so the
+# names stay reserved, but nothing reads them yet — exempted from the
+# tri-surface rule until they grow a reader
+HVD_CPU_OPERATIONS = "HVD_CPU_OPERATIONS"  # hvd-lint: ignore[config-surface]
+HVD_ADASUM_CHUNK_SIZE = "HVD_ADASUM_CHUNK_SIZE"  # hvd-lint: ignore[config-surface]
+HVD_NUM_STREAMS = "HVD_NUM_STREAMS"  # hvd-lint: ignore[config-surface]
 # default on-the-wire allreduce compression: none | bf16 | fp16 | int8
 # (block-scaled int8, EQuARX arXiv:2506.17615)
 HVD_TPU_COMPRESSION = "HVD_TPU_COMPRESSION"
@@ -44,6 +55,9 @@ HVD_TPU_RING_SEGMENT_BYTES = "HVD_TPU_RING_SEGMENT_BYTES"
 # dedicated bulk-data connections per ring peer, separate from the
 # control connection (heartbeats never queue behind chunk writes)
 HVD_TPU_RING_STRIPES = "HVD_TPU_RING_STRIPES"
+# payload size at/above which tcp-mode collectives ride the p2p ring
+# instead of the coordinator star (docs/tuning.md)
+HVD_TCP_RING_THRESHOLD = "HVD_TCP_RING_THRESHOLD"
 
 # --- fault-tolerant collective runtime (docs/fault_tolerance.md) -------------
 # bound on "abort initiated anywhere -> every rank raises HvdAbortedError"
@@ -74,6 +88,29 @@ HVD_GLOBAL_MESH = "HVD_GLOBAL_MESH"            # pod mode: one global jax mesh
 HVD_HOST_SLOTS = "HVD_HOST_SLOTS"      # "h1:n1,h2:n2" rank-block layout
 HVD_COORDINATOR_ADDR = "HVD_COORDINATOR_ADDR"  # jax.distributed coordinator
 HVD_START_TIMEOUT = "HVD_START_TIMEOUT"  # gang-start deadline, s (default 120)
+# explicit rendezvous-reachability override for the launcher host
+HVD_RENDEZVOUS_HOST_ADDR = "HVD_RENDEZVOUS_HOST_ADDR"
+# task-server bootstrap (run/service/task_main.py; secret rides stdin)
+HVD_TASK_INDEX = "HVD_TASK_INDEX"
+HVD_DRIVER_ADDRS = "HVD_DRIVER_ADDRS"          # "ip:port;ip:port"
+HVD_TASK_TIMEOUT = "HVD_TASK_TIMEOUT"          # seconds, default 120
+# optional host-identity salt: containerized deployments where every
+# container reports the same hostname force distinct host hashes —
+# set in the deployment environment, deliberately not an hvdrun flag
+HVD_HOSTNAME_HASH_SALT = "HVD_HOSTNAME_HASH_SALT"  # hvd-lint: ignore[config-surface]
+
+# The launcher -> worker contract above is exempt from the tri-surface
+# rule: these variables are how hvdrun TALKS to workers, not user
+# knobs, so they deliberately have no CLI flag or YAML key.
+# (hvd-lint's config-surface checker reads this declaration.)
+LAUNCHER_CONTRACT = frozenset({
+    HVD_RANK, HVD_SIZE, HVD_LOCAL_RANK, HVD_LOCAL_SIZE,
+    HVD_CROSS_RANK, HVD_CROSS_SIZE, HVD_SECRET_KEY,
+    HVD_RENDEZVOUS_ADDR, HVD_RENDEZVOUS_PORT, HVD_CONTROLLER_ADDR,
+    HVD_GLOBAL_MESH, HVD_HOST_SLOTS, HVD_COORDINATOR_ADDR,
+    HVD_RENDEZVOUS_HOST_ADDR, HVD_TASK_INDEX, HVD_DRIVER_ADDRS,
+    HVD_TASK_TIMEOUT,
+})
 
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
 DEFAULT_CYCLE_TIME_MS = 1.0
@@ -87,6 +124,39 @@ DEFAULT_LIVENESS_TIMEOUT_SECONDS = 15.0
 DEFAULT_CONNECT_RETRY_SECONDS = 30.0
 
 
+# A malformed knob value must not silently vanish into the default
+# (HVD_TPU_RING_STRIPES="two" looking exactly like an unset knob cost
+# real debugging time) — warn ONCE per variable, naming the bad value
+# and the default actually used.  Stdlib logging on the framework's
+# logger name: utils/logging.py configures that logger (and imports
+# this module, so this module must not import it back); unconfigured
+# processes still see the warning through logging's last-resort
+# stderr handler.
+_warned = set()
+_warned_lock = threading.Lock()
+
+_TRUE_WORDS = ("1", "true", "yes", "on")
+_FALSE_WORDS = ("0", "false", "no", "off")
+
+
+def _warn_malformed(name, value, default):
+    with _warned_lock:
+        if name in _warned:
+            return
+        # mark BEFORE logging: a handler that itself reads this knob
+        # re-enters quietly instead of recursing
+        _warned.add(name)
+    logging.getLogger("horovod_tpu").warning(
+        "ignoring malformed %s=%r: using default %r", name, value,
+        default)
+
+
+def _reset_warnings():
+    """Test hook: forget which knobs have already warned."""
+    with _warned_lock:
+        _warned.clear()
+
+
 def get_int(name, default=0):
     value = os.environ.get(name)
     if value is None or value == "":
@@ -94,6 +164,7 @@ def get_int(name, default=0):
     try:
         return int(value)
     except ValueError:
+        _warn_malformed(name, value, default)
         return default
 
 
@@ -104,6 +175,7 @@ def get_float(name, default=0.0):
     try:
         return float(value)
     except ValueError:
+        _warn_malformed(name, value, default)
         return default
 
 
@@ -111,9 +183,27 @@ def get_bool(name, default=False):
     value = os.environ.get(name)
     if value is None or value == "":
         return default
-    return value.strip().lower() in ("1", "true", "yes", "on")
+    word = value.strip().lower()
+    if word in _TRUE_WORDS:
+        return True
+    if word not in _FALSE_WORDS:
+        _warn_malformed(name, value, default)
+        return default
+    return False
 
 
 def get_str(name, default=None):
     value = os.environ.get(name)
     return default if value in (None, "") else value
+
+
+def get_required(name):
+    """A launcher-contract variable that MUST be present (task/worker
+    entry points): missing means the process was started outside its
+    launcher — fail with the contract named instead of a KeyError."""
+    value = os.environ.get(name)
+    if value in (None, ""):
+        raise RuntimeError(
+            f"required environment variable {name} is not set — this "
+            f"process expects the hvdrun launcher env contract")
+    return value

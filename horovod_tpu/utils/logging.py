@@ -6,8 +6,9 @@ timestamps suppressible with ``HVD_LOG_HIDE_TIME``.
 """
 
 import logging
-import os
 import sys
+
+from horovod_tpu.utils import env as env_util
 
 _LEVELS = {
     "trace": 5,
@@ -28,10 +29,11 @@ def get_logger():
     if _logger is not None:
         return _logger
     logger = logging.getLogger("horovod_tpu")
-    level_name = os.environ.get("HVD_LOG_LEVEL", "warning").strip().lower()
+    level_name = env_util.get_str(
+        env_util.HVD_LOG_LEVEL, "warning").strip().lower()
     logger.setLevel(_LEVELS.get(level_name, logging.WARNING))
     handler = logging.StreamHandler(sys.stderr)
-    if os.environ.get("HVD_LOG_HIDE_TIME", "").lower() in ("1", "true"):
+    if env_util.get_bool(env_util.HVD_LOG_HIDE_TIME):
         fmt = "[%(levelname)s] %(message)s"
     else:
         fmt = "%(asctime)s [%(levelname)s] %(message)s"

@@ -145,16 +145,14 @@ def publish_and_merge(rank, size, base_path, timeline, scope="timeline"):
     per-process trace; rank 0 merges them into ``base_path`` (reference:
     rank 0 writes one timeline for all ranks, ``timeline.cc``).  Used by
     both the tcp and global-mesh controllers at shutdown."""
-    import os
-
     from horovod_tpu.run import http_client
     from horovod_tpu.utils import env as env_util
     from horovod_tpu.utils.logging import get_logger
 
-    addr = os.environ.get(env_util.HVD_RENDEZVOUS_ADDR)
+    addr = env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR)
     if not base_path or addr is None:
         return
-    port = int(os.environ.get(env_util.HVD_RENDEZVOUS_PORT, "0"))
+    port = env_util.get_int(env_util.HVD_RENDEZVOUS_PORT, 0)
 
     timeline.close()
     my_path = f"{base_path}.rank{rank}"

@@ -59,6 +59,9 @@ setup(
         "horovod_tpu.run.service",
         "horovod_tpu.spark",
         "horovod_tpu.tensorflow",
+        "horovod_tpu.tools",
+        "horovod_tpu.tools.lint",
+        "horovod_tpu.tools.lint.checkers",
         "horovod_tpu.torch",
         "horovod_tpu.utils",
     ],

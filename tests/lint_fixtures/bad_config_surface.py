@@ -1,0 +1,20 @@
+"""Known-bad fixture: raw env reads bypassing utils/env.py."""
+
+import os
+
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils.env import get_float
+
+
+def knobs():
+    # BAD: raw read of a declared constant
+    stripes = os.environ.get(env_util.HVD_TPU_RING_STRIPES)
+    # BAD: raw read of an undeclared literal
+    magic = os.environ.get("HVD_UNDECLARED_KNOB")
+    # BAD: raw subscript read
+    rank = os.environ["HVD_RANK"]
+    # BAD: getter called with a string literal instead of the constant
+    seg = env_util.get_int("HVD_TPU_RING_SEGMENT_BYTES", 0)
+    # BAD: bare-imported getter with a literal — same rule applies
+    beat = get_float("HVD_BARE_LITERAL_KNOB", 1.0)
+    return stripes, magic, rank, seg, beat

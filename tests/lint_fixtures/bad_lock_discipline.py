@@ -1,0 +1,20 @@
+"""Known-bad fixture: a guarded attribute read without its lock."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []   # guarded by self._lock
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        with self._lock:
+            self._items.append(1)
+
+    def drain(self):
+        # BAD: reads the guarded list with no lock held
+        return list(self._items)

@@ -1,0 +1,26 @@
+"""Known-bad fixture: two locks taken in opposite orders (cycle), plus
+a condition-wait while a foreign lock is held."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+        self._cv = threading.Condition()
+
+    def forward(self):
+        with self._send_lock:
+            with self._recv_lock:
+                pass
+
+    def backward(self):
+        with self._recv_lock:
+            with self._send_lock:   # BAD: reverse order of forward()
+                pass
+
+    def wait_done(self):
+        with self._send_lock:       # BAD: held across the cv wait
+            with self._cv:
+                self._cv.wait(timeout=1.0)

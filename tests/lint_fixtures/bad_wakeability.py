@@ -1,0 +1,21 @@
+"""Known-bad fixture: timeout-less blocking waits on the collective
+path with no '# wakeable:' registration."""
+
+import queue
+import threading
+
+
+class Plane:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._jobs = queue.Queue()
+
+    def wait_for_chunk(self):
+        with self._cv:
+            self._cv.wait()        # BAD: no timeout, not registered
+
+    def next_job(self):
+        return self._jobs.get()    # BAD: no timeout, not registered
+
+    def read(self, sock):
+        return sock.recv(4096)     # BAD: socket recv, not registered

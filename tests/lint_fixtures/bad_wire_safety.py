@@ -1,0 +1,13 @@
+"""Known-bad fixture: unpickling network input with no verification,
+and emitting raw socket bytes outside the signed transport."""
+
+import pickle
+
+
+def receive(sock):
+    data = sock.recv(65536)
+    return pickle.loads(data)    # BAD: unverified network input
+
+
+def send(sock, frame):
+    sock.sendall(frame)          # BAD: unsigned raw send

@@ -1,0 +1,20 @@
+"""Known-good fixture: every env access goes through a utils/env.py
+constant + typed getter; writes (launcher plumbing) stay raw."""
+
+import os
+
+from horovod_tpu.utils import env as env_util
+
+
+def knobs():
+    stripes = env_util.get_int(env_util.HVD_TPU_RING_STRIPES, 2)
+    rank = env_util.get_required(env_util.HVD_RANK)
+    seg = env_util.get_int(env_util.HVD_TPU_RING_SEGMENT_BYTES, 0)
+    return stripes, rank, seg
+
+
+def export(child_env):
+    # writes are the launcher talking to workers — allowed raw
+    os.environ[env_util.HVD_CONTROLLER] = "tcp"
+    child_env[env_util.HVD_RANK] = "0"
+    return child_env

@@ -1,0 +1,25 @@
+"""Known-good fixture: every guarded access holds the owning lock (or
+declares that its caller does)."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []   # guarded by self._lock
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        with self._lock:
+            self._items.append(1)
+
+    def drain(self):
+        with self._lock:
+            return self._drain_locked()
+
+    def _drain_locked(self):  # holds: self._lock
+        items, self._items = self._items, []
+        return items

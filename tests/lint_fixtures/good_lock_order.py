@@ -1,0 +1,25 @@
+"""Known-good fixture: consistent lock order, waits hold only the
+condition's own lock."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+        self._cv = threading.Condition()
+
+    def forward(self):
+        with self._send_lock:
+            with self._recv_lock:
+                pass
+
+    def backward(self):
+        with self._send_lock:
+            with self._recv_lock:
+                pass
+
+    def wait_done(self):
+        with self._cv:
+            self._cv.wait(timeout=1.0)

@@ -1,0 +1,23 @@
+"""Known-good fixture: every blocking call carries a timeout or is
+registered with the abort-wakeup set via '# wakeable:'."""
+
+import queue
+import threading
+
+
+class Plane:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._jobs = queue.Queue()
+
+    def wait_for_chunk(self):
+        with self._cv:
+            self._cv.wait(timeout=1.0)
+
+    def next_job(self):
+        # wakeable: close() enqueues a None sentinel
+        return self._jobs.get()
+
+    def read(self, sock):
+        # wakeable: abort closes the socket, breaking the recv
+        return sock.recv(4096)

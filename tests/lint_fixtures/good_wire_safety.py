@@ -1,0 +1,17 @@
+"""Known-good fixture: verify-then-unpickle, and frames emitted through
+the signed transport helpers."""
+
+import pickle
+
+from horovod_tpu.run.service import network, secret
+
+
+def receive(key, blob):
+    digest, payload = blob[:secret.DIGEST_LEN], blob[secret.DIGEST_LEN:]
+    if not secret.check(key, payload, digest):
+        raise PermissionError("payload failed HMAC verification")
+    return pickle.loads(payload)
+
+
+def send(sock, key, obj):
+    return network.write_message(sock, key, obj, "q")

@@ -1,0 +1,340 @@
+"""Tier-1 gate for hvd-lint (docs/linting.md).
+
+Two halves:
+
+1. every checker is proven to FIRE on its known-bad fixture under
+   ``tests/lint_fixtures/`` and stay silent on the known-good twin;
+2. the full suite over ``horovod_tpu/`` reports zero non-baselined
+   findings, and the checked-in baseline stays small (<= 25) with a
+   real justification on every entry.
+
+Plus the env-getter warn-once contract (malformed knob values must not
+silently become defaults) that the config-surface checker's typed-getter
+routing relies on.
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.tools.lint import findings as findings_mod
+from horovod_tpu.tools.lint.cli import (
+    DEFAULT_BASELINE,
+    REPO_ROOT,
+    run_lint,
+)
+from horovod_tpu.utils import env as env_util
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+ENV_PY = os.path.join(REPO_ROOT, "horovod_tpu", "utils", "env.py")
+
+# fixture runs check every scanned module (no project scoping) and skip
+# the project-level tri-surface rule (fixtures carry no config_parser)
+FIXTURE_CONFIG = {"skip_tri_surface": True}
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+def _lint_fixture(filename, checker, with_env=False):
+    paths = [_fixture(filename)]
+    if with_env:
+        paths.append(ENV_PY)
+    found = run_lint(paths, config=FIXTURE_CONFIG, checkers=[checker])
+    return [f for f in found
+            if f.path.endswith(f"lint_fixtures/{filename}")]
+
+
+CASES = [
+    ("lock-discipline", "lock_discipline", False),
+    ("lock-order", "lock_order", False),
+    ("abort-wakeability", "wakeability", False),
+    ("config-surface", "config_surface", True),
+    ("wire-safety", "wire_safety", False),
+]
+
+
+@pytest.mark.parametrize("checker,stem,with_env", CASES,
+                         ids=[c[0] for c in CASES])
+def test_checker_fires_on_bad_fixture(checker, stem, with_env):
+    found = _lint_fixture(f"bad_{stem}.py", checker, with_env=with_env)
+    assert found, f"{checker} did not fire on its known-bad fixture"
+
+
+@pytest.mark.parametrize("checker,stem,with_env", CASES,
+                         ids=[c[0] for c in CASES])
+def test_checker_silent_on_good_fixture(checker, stem, with_env):
+    found = _lint_fixture(f"good_{stem}.py", checker, with_env=with_env)
+    assert not found, (
+        f"{checker} false-positived on its known-good fixture: "
+        + "; ".join(f.render() for f in found))
+
+
+def test_bad_fixture_details():
+    """The bad fixtures trip the SPECIFIC rules they encode, not some
+    accidental one."""
+    lock = _lint_fixture("bad_lock_discipline.py", "lock-discipline")
+    assert any(f.detail == "_items" for f in lock)
+
+    order = _lint_fixture("bad_lock_order.py", "lock-order")
+    assert any(f.detail.startswith("cycle:") for f in order)
+    assert any(f.detail.startswith("foreign-wait:") for f in order)
+
+    wake = _lint_fixture("bad_wakeability.py", "abort-wakeability")
+    details = {f.detail for f in wake}
+    assert {"self._cv.wait", "self._jobs.get", "sock.recv"} <= details
+
+    conf = _lint_fixture("bad_config_surface.py", "config-surface",
+                         with_env=True)
+    names = {f.detail for f in conf}
+    assert "HVD_TPU_RING_STRIPES" in names     # raw read via constant
+    assert "HVD_UNDECLARED_KNOB" in names      # undeclared literal
+    assert "HVD_RANK" in names                 # raw subscript
+    assert "HVD_TPU_RING_SEGMENT_BYTES" in names  # literal in getter
+    assert "HVD_BARE_LITERAL_KNOB" in names  # bare-imported getter
+
+    wire = _lint_fixture("bad_wire_safety.py", "wire-safety")
+    details = {f.detail for f in wire}
+    assert details == {"pickle-loads", "raw-send"}
+
+
+# ------------------------------------------------- checker precision pins
+def _lint_source(tmp_path, checker, sources):
+    """Lint throwaway modules given as {name: source}; returns findings."""
+    paths = []
+    for name, src in sources.items():
+        p = tmp_path / name
+        p.write_text(src)
+        paths.append(str(p))
+    return run_lint(paths, config=FIXTURE_CONFIG, checkers=[checker])
+
+
+def test_inline_ignore_does_not_leak_to_next_line(tmp_path):
+    found = _lint_source(tmp_path, "lock-discipline", {"m.py": (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = []   # guarded by self._lock\n"
+        "        self._count = 0    # guarded by self._lock\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self.peek).start()\n"
+        "    def peek(self):\n"
+        "        a = self._items  # hvd-lint: ignore[lock-discipline]\n"
+        "        b = self._count\n"
+        "        return a, b\n")})
+    assert [f.detail for f in found] == ["_count"]
+
+
+def test_queue_get_block_true_is_flagged(tmp_path):
+    found = _lint_source(tmp_path, "abort-wakeability", {"m.py": (
+        "import queue, threading\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._jobs = queue.Queue()\n"
+        "    def blocking(self):\n"
+        "        return self._jobs.get(True)\n"
+        "    def nonblocking(self):\n"
+        "        return self._jobs.get(False)\n"
+        "    def bounded(self):\n"
+        "        return self._jobs.get(True, 1.0)\n")})
+    assert [f.line for f in found] == [6]
+
+
+def test_same_named_classes_do_not_merge_into_fake_cycles(tmp_path):
+    worker = (
+        "import threading\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def go(self):\n"
+        "        with self.{0}:\n"
+        "            with self.{1}:\n"
+        "                pass\n")
+    found = _lint_source(tmp_path, "lock-order", {
+        "one.py": worker.format("_a", "_b"),
+        "two.py": worker.format("_b", "_a")})
+    assert not found, [f.render() for f in found]
+
+
+def test_condition_reacquire_not_called_deadlock(tmp_path):
+    found = _lint_source(tmp_path, "lock-order", {"m.py": (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self._m = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._cv:\n"
+        "            self.inner()\n"
+        "    def inner(self):\n"
+        "        with self._cv:\n"
+        "            pass\n"
+        "    def bad(self):\n"
+        "        with self._m:\n"
+        "            self.worse()\n"
+        "    def worse(self):\n"
+        "        with self._m:\n"
+        "            pass\n")})
+    # Condition wraps an RLock (reentrant) — no finding; the plain
+    # Lock reacquire through the same call shape IS a deadlock
+    details = [f.detail for f in found]
+    assert details == ["reacquire:C._m"], details
+
+
+# --------------------------------------------------------------- the gate
+def test_full_suite_zero_nonbaselined_findings():
+    findings = run_lint([os.path.join(REPO_ROOT, "horovod_tpu")])
+    baseline = findings_mod.load_baseline(DEFAULT_BASELINE)
+    active, _suppressed, _stale = findings_mod.split_baselined(
+        findings, baseline)
+    assert not active, (
+        "hvd-lint found non-baselined violations:\n"
+        + "\n".join(f.render() for f in active))
+
+
+def test_baseline_is_small_and_justified():
+    with open(DEFAULT_BASELINE) as f:
+        data = json.load(f)
+    entries = data.get("suppressions", [])
+    assert len(entries) <= 25, (
+        f"{len(entries)} baselined suppressions — the budget is 25; "
+        f"fix findings instead of baselining them")
+    for entry in entries:
+        just = entry.get("justification", "")
+        assert just and "TODO" not in just, (
+            f"baseline entry {entry.get('key')!r} lacks a real "
+            f"justification")
+
+
+def test_baseline_suppression_roundtrip(tmp_path):
+    """A finding whose key is baselined stops being active; unrelated
+    baseline keys surface as stale."""
+    findings = run_lint([_fixture("bad_wire_safety.py")],
+                        config=FIXTURE_CONFIG, checkers=["wire-safety"])
+    assert findings
+    baseline = {findings[0].key: "fixture", "stale:key:x:y": "gone"}
+    active, suppressed, stale = findings_mod.split_baselined(
+        findings, baseline)
+    assert findings[0].key not in {f.key for f in active}
+    assert suppressed and stale == ["stale:key:x:y"]
+
+    path = tmp_path / "base.json"
+    findings_mod.write_baseline(str(path), findings, previous=baseline)
+    reloaded = findings_mod.load_baseline(str(path))
+    assert reloaded[findings[0].key] == "fixture"
+    assert all("stale:" not in k for k in reloaded)
+
+
+def test_write_baseline_preserves_out_of_scope_entries(tmp_path):
+    """A scoped --write-baseline (checker subset / sub-path) must carry
+    other scopes' justified suppressions over verbatim, not delete
+    them."""
+    findings = run_lint([_fixture("bad_wire_safety.py")],
+                        config=FIXTURE_CONFIG, checkers=["wire-safety"])
+    assert findings
+    previous = {
+        "config-surface:horovod_tpu/x.py:<module>:HVD_Z": "justified",
+        "wire-safety:tests/lint_fixtures/bad_wire_safety.py:gone:x":
+            "was fixed",
+    }
+    path = tmp_path / "base.json"
+    findings_mod.write_baseline(
+        str(path), findings, previous=previous,
+        out_of_scope=lambda key: not key.startswith("wire-safety:"))
+    reloaded = findings_mod.load_baseline(str(path))
+    # unselected checker's entry survives with its justification...
+    assert reloaded[
+        "config-surface:horovod_tpu/x.py:<module>:HVD_Z"] == "justified"
+    # ...while the in-scope stale key is pruned
+    assert not any(":gone:" in k for k in reloaded)
+
+
+# ------------------------------------------------------------------ CLI
+def test_cli_exit_codes_and_json():
+    lint = os.path.join(REPO_ROOT, "bin", "hvd-lint")
+    ok = subprocess.run(
+        [sys.executable, lint, os.path.join(REPO_ROOT, "horovod_tpu")],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+
+    bad = subprocess.run(
+        [sys.executable, lint, _fixture("bad_wire_safety.py"),
+         "--no-baseline", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert bad.returncode == 1
+    payload = json.loads(bad.stdout)
+    assert payload["findings"]
+    assert all({"checker", "path", "line", "key"} <= set(f)
+               for f in payload["findings"])
+
+
+# ------------------------------------------- env getter warn-once contract
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+@pytest.fixture
+def hvd_log_capture():
+    logger = logging.getLogger("horovod_tpu")
+    handler = _Capture()
+    old_level = logger.level
+    logger.addHandler(handler)
+    logger.setLevel(logging.WARNING)
+    env_util._reset_warnings()
+    try:
+        yield handler.records
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+        env_util._reset_warnings()
+
+
+def test_get_int_warns_once_on_malformed(monkeypatch, hvd_log_capture):
+    monkeypatch.setenv(env_util.HVD_TPU_RING_STRIPES, "two")
+    assert env_util.get_int(env_util.HVD_TPU_RING_STRIPES, 4) == 4
+    assert env_util.get_int(env_util.HVD_TPU_RING_STRIPES, 4) == 4
+    msgs = [r.getMessage() for r in hvd_log_capture
+            if env_util.HVD_TPU_RING_STRIPES in r.getMessage()]
+    assert len(msgs) == 1, msgs
+    assert "'two'" in msgs[0] and "4" in msgs[0]
+
+
+def test_get_float_and_bool_warn(monkeypatch, hvd_log_capture):
+    monkeypatch.setenv(env_util.HVD_TPU_ABORT_TIMEOUT, "soon")
+    assert env_util.get_float(env_util.HVD_TPU_ABORT_TIMEOUT,
+                              30.0) == 30.0
+    monkeypatch.setenv(env_util.HVD_AUTOTUNE, "maybe")
+    assert env_util.get_bool(env_util.HVD_AUTOTUNE, False) is False
+    messages = "\n".join(r.getMessage() for r in hvd_log_capture)
+    assert env_util.HVD_TPU_ABORT_TIMEOUT in messages
+    assert env_util.HVD_AUTOTUNE in messages
+
+
+def test_getters_quiet_on_valid_and_unset(monkeypatch, hvd_log_capture):
+    monkeypatch.setenv(env_util.HVD_TPU_RING_STRIPES, "8")
+    monkeypatch.delenv(env_util.HVD_CYCLE_TIME, raising=False)
+    monkeypatch.setenv(env_util.HVD_AUTOTUNE, "off")
+    assert env_util.get_int(env_util.HVD_TPU_RING_STRIPES, 2) == 8
+    assert env_util.get_float(env_util.HVD_CYCLE_TIME, 1.0) == 1.0
+    assert env_util.get_bool(env_util.HVD_AUTOTUNE, True) is False
+    assert not hvd_log_capture
+
+
+def test_get_required(monkeypatch):
+    monkeypatch.setenv(env_util.HVD_RANK, "3")
+    assert env_util.get_required(env_util.HVD_RANK) == "3"
+    monkeypatch.delenv(env_util.HVD_RANK, raising=False)
+    with pytest.raises(RuntimeError, match="HVD_RANK"):
+        env_util.get_required(env_util.HVD_RANK)
