@@ -19,6 +19,18 @@ in ``horovod_tpu.torch``, ``horovod_tpu.tensorflow`` (gated),
 
 __version__ = "0.1.0"
 
+# hvd-race (docs/race_detection.md): the shim must patch the threading
+# primitives BEFORE the runtime modules below import and build their
+# locks, so this gate runs first.  With HVD_TPU_RACE unset the shim
+# module is never imported and threading stays stock — the gate's cost
+# is one env read.
+from horovod_tpu.utils import env as _env_util
+
+if _env_util.get_bool(_env_util.HVD_TPU_RACE):
+    from horovod_tpu.tools.race import shim as _race_shim
+
+    _race_shim.install_from_env()
+
 from horovod_tpu.common.basics import (  # noqa: F401
     init,
     shutdown,

@@ -47,7 +47,8 @@ from horovod_tpu.common.ops_enum import (ReduceOp, RequestType,
                                          is_float_dtype)
 from horovod_tpu.common.response_cache import SignatureCache
 from horovod_tpu.ops.tcp_dataplane import (DEFAULT_RING_THRESHOLD,
-                                           PeerService, RingPlane)
+                                           PeerService, RingPlane,
+                                           RingSendError)
 from horovod_tpu.run.service import network
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils.logging import get_logger
@@ -355,6 +356,8 @@ class CoordinatorService(network.MuxService):
         # sticky-flag read: once done fired, results are immutable
         if self._abort is not None and req.rank not in entry.results:  # hvd-lint: ignore[lock-discipline]
             return self._abort_result()
+        # hvd-race: ok[results written before done.set(); immutable and
+        # deliberately lock-free once the done event ordered this read]
         return entry.results.get(req.rank,
                                  ResultMsg(error="internal: no result"))
 
@@ -1200,11 +1203,21 @@ class TcpController:
             # dead neighbor) into a coordinated abort: the OTHER ranks of
             # this round are blocked on chunks this rank will never send,
             # and without the broadcast they would hang or time out
-            # asymmetrically with leaked mailbox state
+            # asymmetrically with leaked mailbox state.  When the
+            # failure PROVES a peer dead (RingSendError: the transport
+            # write to that rank broke), the abort origin is THAT rank
+            # — the same origin the liveness monitor would name — so
+            # culprit attribution doesn't depend on which detector
+            # fires first under machine load (the mid-ring crash
+            # flake).  A recv timeout is NOT such proof: in a 3+-rank
+            # ring the silent predecessor is usually blocked behind
+            # the real casualty, so it names this rank as before.
+            origin = exc.peer_rank if isinstance(
+                exc, RingSendError) else self._rank
             reason = (f"ring {rtype.name.lower()} '{request.name}' failed "
                       f"on rank {self._rank}: {exc}")
-            self._report_abort(self._rank, reason)
-            raise HvdAbortedError(self._rank, reason) from exc
+            self._report_abort(origin, reason)
+            raise HvdAbortedError(origin, reason) from exc
         finally:
             self._timeline.end(request.name, {"bytes": arr.nbytes})
         return out

@@ -58,6 +58,7 @@ from horovod_tpu.common import faults
 from horovod_tpu.common.handles import HvdAbortedError
 from horovod_tpu.common.ops_enum import INT8_BLOCK, is_float_dtype
 from horovod_tpu.run.service import network
+from horovod_tpu.tools.race import hooks as race_hooks
 from horovod_tpu.utils import env as env_util
 
 # payloads at or above this ride the ring; below it the coordinator star
@@ -183,6 +184,33 @@ class ChunkMsg:
         self.payload = payload
 
 
+class RingSendError(ConnectionError):
+    """A bulk segment write to a SPECIFIC peer failed.  Carrying the
+    peer rank lets the abort that follows name the rank the transport
+    proved unreachable — not the rank that happened to notice first —
+    so culprit attribution stays deterministic under machine-load skew
+    (the mid-ring crash scenario races liveness detection against the
+    survivor's own failed sends; both now name the same origin).
+
+    Only the SEND side carries this evidence: a failed connection to a
+    peer proves THAT peer is gone, while a recv timeout only proves the
+    ring stalled somewhere upstream — in a 3+-rank ring the silent
+    predecessor is usually an innocent rank blocked behind the real
+    casualty, so recv timeouts keep naming the noticing rank and leave
+    precise attribution to the liveness monitor."""
+
+    def __init__(self, peer_rank, cause):
+        super().__init__(
+            f"ring bulk send to rank {peer_rank} failed: {cause}")
+        self.peer_rank = peer_rank
+
+
+class _PlaneClosedError(ConnectionError):
+    """This plane's own close() refused the operation — a local
+    teardown artifact, never evidence about a peer (the sender loop
+    must not convert it into a RingSendError that blames one)."""
+
+
 class PeerService(network.MuxService):
     """Per-worker chunk mailbox: peers push ``ChunkMsg`` frames (pickled
     small ones on the control connection, raw bulk frames on the
@@ -222,6 +250,11 @@ class PeerService(network.MuxService):
                 key = (req.tag, req.src)
                 self._mailbox[key] = req.payload
                 self._by_ring.setdefault(req.tag[0], set()).add(key)
+                if race_hooks.active:
+                    # deliver→recv happens-before edge: even a recv
+                    # that never waits (chunk already buffered) is
+                    # ordered after this insert (docs/race_detection.md)
+                    race_hooks.publish(("mailbox", id(self)) + key)
                 self._cv.notify_all()
             return network.AckResponse()
         if isinstance(req, network.AbortMsg):
@@ -263,6 +296,8 @@ class PeerService(network.MuxService):
                 ring_keys.discard(key)
                 if not ring_keys:
                     del self._by_ring[tag[0]]
+            if race_hooks.active:
+                race_hooks.observe(("mailbox", id(self)) + key)
             return self._mailbox.pop(key)
 
     def purge(self, ring_id):
@@ -323,6 +358,9 @@ class RingPlane:
         # latest async send failure (sticky, written by the sender
         # thread, read by the compute thread); guarded by self._pending_cv
         self._send_error = None
+        # peer the failed write was addressed to (None: not
+        # peer-specific, e.g. close()); guarded by self._pending_cv
+        self._send_error_peer = None
         # enqueued-but-unwritten segments; guarded by self._pending_cv
         self._pending_sends = 0
         self._pending_cv = threading.Condition()
@@ -336,7 +374,7 @@ class RingPlane:
                 # segments when close() empties the pools — refusing
                 # here stops it from repopulating them with fresh
                 # connections nobody would ever close
-                raise ConnectionError("ring plane closed")
+                raise _PlaneClosedError("ring plane closed")
             client = self._clients.get(rank)
             if client is None:
                 client = self._clients[rank] = self._resolve(rank)
@@ -363,7 +401,7 @@ class RingPlane:
     def _stripe(self, dst, index):
         with self._lock:
             if self._closed:
-                raise ConnectionError("ring plane closed")
+                raise _PlaneClosedError("ring plane closed")
             n = max(1, int(self.stripes))
             pool = self._stripe_pools.setdefault(dst, [])
             i = index % n
@@ -421,6 +459,13 @@ class RingPlane:
                 # fast instead of waiting out the recv timeout
                 with self._pending_cv:
                     self._send_error = exc
+                    # peer evidence ONLY for genuine transport failures
+                    # addressed at dst: a local error (framing bug,
+                    # MemoryError, this plane's own close()) must not
+                    # make the abort origin blame a healthy rank
+                    if isinstance(exc, (OSError, TimeoutError)) \
+                            and not isinstance(exc, _PlaneClosedError):
+                        self._send_error_peer = dst
                 # a recv already blocked on the mailbox must wake NOW:
                 # its error_check re-raises this under the condition
                 # (never nested with _pending_cv — no ordering edge)
@@ -437,6 +482,9 @@ class RingPlane:
 
     def _raise_if_send_failed_locked(self):  # holds: self._pending_cv
         if self._send_error is not None:
+            if self._send_error_peer is not None:
+                raise RingSendError(self._send_error_peer,
+                                    self._send_error)
             raise ConnectionError(
                 f"ring bulk send failed: {self._send_error}")
 

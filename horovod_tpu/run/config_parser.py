@@ -49,6 +49,10 @@ _PARAMS = {
     "connect_retry_seconds": (env_util.HVD_TPU_CONNECT_RETRY_SECONDS,
                               "fault_tolerance.connect_retry_seconds"),
     "fault_spec": (env_util.HVD_TPU_FAULT_SPEC, "fault_tolerance.spec"),
+    "race": (env_util.HVD_TPU_RACE, "race.enabled"),
+    "race_seed": (env_util.HVD_TPU_RACE_SEED, "race.seed"),
+    "race_scope": (env_util.HVD_TPU_RACE_SCOPE, "race.scope"),
+    "race_report": (env_util.HVD_TPU_RACE_REPORT, "race.report_prefix"),
 }
 
 # negation flags -> env var forced to "0" (reference: --no-autotune etc.)

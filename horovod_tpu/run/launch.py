@@ -83,6 +83,49 @@ def slot_env(slot, rendezvous_addr, rendezvous_port, extra_env=None):
 SECRET_ENV_VARS = (env_util.HVD_SECRET_KEY,)
 
 
+def fault_crash_ranks(extra_env):
+    """Ranks the job's own fault spec arms with a ``crash``: when the
+    launcher injected the failure itself, the culprit is known by
+    construction and no timing evidence can outvote it."""
+    spec_text = (extra_env or {}).get(env_util.HVD_TPU_FAULT_SPEC)
+    if not spec_text:
+        return frozenset()
+    from horovod_tpu.common.faults import parse_fault_spec
+
+    try:
+        specs = parse_fault_spec(spec_text)
+    except ValueError:
+        return frozenset()  # the workers will fail loudly at init
+    return frozenset(s.rank for s in specs
+                     if s.action == "crash" and s.rank is not None)
+
+
+def pick_culprit(failures, crash_ranks=frozenset()):
+    """(rank, code) of the rank that broke the job.
+
+    ``failures``: [(rank, code, was_victim, exit_ts)] in REAP order —
+    which under machine load is not death order: a survivor that exits
+    nonzero because of the coordinated abort can be reaped before the
+    rank whose death caused it (stream-forwarder drains and thread
+    scheduling sit between a child dying and its failure being
+    recorded).  Attribution therefore ranks by evidence, not arrival:
+
+    1. victims of the kill fan-out are never culprits (all-victims is a
+       launcher-interrupt edge case: fall back to the full list);
+    2. a rank the job's own ``HVD_TPU_FAULT_SPEC`` armed with a crash
+       is the culprit by construction;
+    3. otherwise the earliest ``exit_ts`` wins — the child observed
+       dead first is the closest thing to the true first death.
+    """
+    candidates = [f for f in failures if not f[2]] or list(failures)
+    armed = [f for f in candidates if f[0] in crash_ranks]
+    pool = armed or candidates
+    first = min(enumerate(pool),
+                key=lambda item: (item[1][3] is None,
+                                  item[1][3], item[0]))[1]
+    return first[0], first[1]
+
+
 def _ssh_command(slot, command, env, ssh_port=None):
     """Build the remote launch command.  Secrets never appear on the remote
     command line (visible in ps/verbose logs); they travel over ssh stdin
@@ -112,7 +155,9 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
     that would mask the real error if arrival order decided)."""
     log = get_logger()
     failure = threading.Event()
-    failures = []  # [(rank, code, was_victim)] in arrival order
+    # [(rank, code, was_victim, exit_ts)] in reap order — culprit
+    # attribution re-ranks by evidence, see pick_culprit
+    failures = []
     failures_lock = threading.Lock()
 
     def run_rank(slot):
@@ -164,7 +209,8 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
         if code != 0:
             with failures_lock:
                 failures.append((slot.rank, code,
-                                 info.get("terminated_by_event", False)))
+                                 info.get("terminated_by_event", False),
+                                 info.get("exit_ts")))
             failure.set()
 
     threads = [threading.Thread(target=run_rank, args=(s,), daemon=True)
@@ -186,19 +232,15 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
         raise
 
     if failures:
-        # name the culprit: the first rank that failed on its OWN, not a
-        # victim the fan-out terminated.  (A victim that lost the report
-        # race can no longer steal the blame — its -15 masked the real
-        # error before.)  Known residual: a survivor that exits nonzero
-        # BECAUSE of a coordinated abort (HvdAbortedError) fails "on its
-        # own" from the launcher's viewpoint; it exits causally after
-        # the true culprit, so arrival order almost always ranks it
-        # second, but a ms-scale inversion is possible — the worker's
-        # own stderr (origin rank in the abort message) stays
-        # authoritative.  All-victims is a launcher interrupt edge
-        # case: fall back to arrival order.
-        culprits = [(r, c) for r, c, victim in failures if not victim]
-        rank, code = culprits[0] if culprits else failures[0][:2]
+        # name the culprit: the first rank that failed on its OWN, not
+        # a victim the fan-out terminated, ranked by when each child
+        # was observed dead (and by the fault spec's own crash ranks
+        # when the failure was injected) — see pick_culprit.  Reap
+        # order decided before, and a survivor exiting nonzero because
+        # of the coordinated abort could out-race the true origin
+        # under machine load.
+        rank, code = pick_culprit(failures,
+                                  fault_crash_ranks(extra_env))
         log.error("rank %d failed first (%s); %d other rank(s) were "
                   "terminated", rank, describe_exit(code),
                   len(failures) - 1)

@@ -177,6 +177,27 @@ def make_parser():
                             "bin/hvd-chaos generates seeded random "
                             "specs for soak runs.")
 
+    race = parser.add_argument_group("race detection")
+    race.add_argument("--race", action="store_true", default=None,
+                      help="Run every rank under the hvd-race shim "
+                           "(HVD_TPU_RACE): traced threading/queue "
+                           "primitives + instrumented attribute access "
+                           "on the concurrency-scoped modules; see "
+                           "docs/race_detection.md.")
+    race.add_argument("--race-seed", type=int, default=None,
+                      help="Schedule-fuzz seed (HVD_TPU_RACE_SEED): "
+                           "deterministic preemptions at "
+                           "instrumentation points — same seed, same "
+                           "interleaving perturbation, same report.")
+    race.add_argument("--race-scope", default=None,
+                      help="Comma-separated module relpath suffixes to "
+                           "instrument (HVD_TPU_RACE_SCOPE; 'all' = "
+                           "every horovod_tpu module).")
+    race.add_argument("--race-report", default=None,
+                      help="Report-file prefix (HVD_TPU_RACE_REPORT): "
+                           "each rank writes its race findings to "
+                           "<prefix>.<pid>.json at exit.")
+
     stall = parser.add_argument_group("stall check")
     stall.add_argument("--no-stall-check", action="store_true", default=None)
     stall.add_argument("--stall-check", action="store_true", default=None,

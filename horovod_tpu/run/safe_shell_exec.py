@@ -10,6 +10,7 @@ import os
 import signal
 import subprocess
 import threading
+import time
 
 GRACEFUL_TERMINATION_TIME_S = 5
 
@@ -53,7 +54,11 @@ def execute(command, env=None, stdout=None, stderr=None,
     when the tree was killed by a fired event while still running — the
     launcher uses it to tell the CULPRIT rank (failed on its own) from
     the VICTIMS it subsequently terminated, so the job's reported
-    failure names the rank that actually broke.
+    failure names the rank that actually broke.  ``info["exit_ts"]`` is
+    the monotonic time ``wait()`` observed the child dead — recorded
+    BEFORE the stream forwarders drain (their joins take seconds under
+    load), so the launcher can rank failures by when ranks actually
+    died instead of by reap order.
     Returns the exit code.
     """
 
@@ -103,6 +108,8 @@ def execute(command, env=None, stdout=None, stderr=None,
         raise
     finally:
         stop_watch.set()
+        if info is not None:
+            info["exit_ts"] = time.monotonic()
     for t in forwarders:
         t.join(timeout=5)
     return proc.returncode

@@ -536,6 +536,9 @@ class MuxService(BasicService):
                                 service._inflight -= 1
                                 service._inflight_cv.notify_all()
 
+                    # lifecycle: ends with its single _handle call;
+                    # shutdown() drains in-flight handlers through the
+                    # _inflight_cv barrier before the socket closes
                     threading.Thread(target=run, daemon=True,
                                      name=f"{service._name}-req").start()
 
@@ -624,6 +627,8 @@ class MuxClient:
                             self._retry_for)
         self._sock = sock
         self._broken = None
+        # lifecycle: exits when its socket dies — close() closes the
+        # socket, which breaks the blocked read_message and returns
         self._reader = threading.Thread(
             target=self._read_loop, args=(sock,), daemon=True,
             name="mux-client-reader")

@@ -77,6 +77,9 @@ class TaskService(network.BasicService):
                         self._command_exit = code
                         self._command_proc = None
 
+                # lifecycle: ends when the launched command exits; the
+                # command is killed (terminate_executor) on shutdown,
+                # which unblocks the wait
                 threading.Thread(target=wait, daemon=True).start()
             return network.AckResponse()
         if isinstance(req, CommandExitCodeRequest):

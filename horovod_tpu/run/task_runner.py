@@ -53,6 +53,9 @@ def main():
     key = base64.b64decode(
         env_util.get_required(env_util.HVD_SECRET_KEY))
 
+    # lifecycle: deliberately abandoned — the watchdog polls the driver
+    # for the life of the worker process and os._exit()s it if the
+    # driver disappears; process exit is its only end
     threading.Thread(target=_driver_watchdog, args=(addr, port),
                      daemon=True, name="hvd-driver-watchdog").start()
 

@@ -10,6 +10,8 @@ encodes):
 
 - ``lock_modules``: relpath suffixes checked for lock discipline
 - ``wakeability_modules``: relpath suffixes on the collective path
+- ``thread_lifecycle_modules``: relpath suffixes whose Thread starts
+  must be joined or daemon-and-registered
 - ``wire_pickle_allowlist``: modules allowed to unpickle network input
 - ``docs_dir``: where the tri-surface checker greps for knob mentions
 - ``skip_tri_surface``: disable the project-level tri-surface rule
@@ -19,6 +21,7 @@ from horovod_tpu.tools.lint.checkers import (
     config_surface,
     lock_discipline,
     lock_order,
+    thread_lifecycle,
     wakeability,
     wire_safety,
 )
@@ -27,6 +30,7 @@ ALL_CHECKERS = {
     lock_discipline.NAME: lock_discipline,
     lock_order.NAME: lock_order,
     wakeability.NAME: wakeability,
+    thread_lifecycle.NAME: thread_lifecycle,
     config_surface.NAME: config_surface,
     wire_safety.NAME: wire_safety,
 }

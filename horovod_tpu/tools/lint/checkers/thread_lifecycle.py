@@ -1,0 +1,152 @@
+"""thread-lifecycle: every started thread has a declared way to end.
+
+The fault-tolerant runtime's contract (docs/fault_tolerance.md) is that
+no thread outlives its owner silently: a wedged background thread is
+exactly the unreproducible-stall material hvd-race exists to catch
+dynamically, and this checker keeps the *static* inventory honest.
+
+Rule — every ``threading.Thread(...)`` construction in scope must be:
+
+1. **joined**: some ``<x>.join(...)`` call exists in the same class
+   (or, for module-level functions, the same module) — the owner's
+   shutdown path waits for the thread; OR
+2. **daemon + registered**: the construction passes ``daemon=True``
+   AND the construction lines (or the contiguous comment block above)
+   carry a ``# lifecycle:`` / ``# wakeable:`` annotation saying how the
+   thread exits or why it may be abandoned (the same register-it-or-
+   join-it convention abort-wakeability applies to blocking waits).
+
+The join detection is deliberately coarse (any ``.join(`` in the owning
+class counts): the checker enforces that a lifecycle *story* exists per
+owner, not which exact attribute carries it — the precise wait graph is
+hvd-race's job at runtime.
+
+``config["thread_lifecycle_modules"]``: relpath suffixes in scope
+(None/missing = every scanned module).  Inline escape:
+``# hvd-lint: ignore[thread-lifecycle]``.
+"""
+
+import ast
+import re
+
+from horovod_tpu.tools.lint import model
+from horovod_tpu.tools.lint.findings import Finding
+
+NAME = "thread-lifecycle"
+
+_LIFECYCLE_RE = re.compile(r"lifecycle:|wakeable:")
+
+
+def _is_thread_ctor(node):
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    name = func.attr if isinstance(func, ast.Attribute) \
+        else func.id if isinstance(func, ast.Name) else None
+    # Timer subclasses Thread with the same lifecycle obligations
+    return name in ("Thread", "Timer")
+
+
+def _has_daemon_true(node):
+    for kw in node.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True:
+            return True
+    return False
+
+
+def _is_thread_join(node):
+    """A ``<expr>.join(...)`` call that can plausibly be a thread join:
+    string/bytes separators (``", ".join(...)``, ``b"".join(...)``) and
+    path joins (``os.path.join``) must not discharge the rule — a log
+    line's comma join is not a shutdown path."""
+    func = node.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "join"):
+        return False
+    if isinstance(func.value, ast.Constant):
+        return False  # literal str/bytes separator
+    text = model.expr_text(func.value)
+    if text is not None and text.split(".")[-1] in ("path", "posixpath",
+                                                    "ntpath"):
+        return False
+    return True
+
+
+def _joins_in(funcdefs):
+    """True when any plausible thread join appears in the given
+    function bodies (the owner waits for SOME thread on its shutdown
+    path)."""
+    for funcdef in funcdefs:
+        for node in ast.walk(funcdef):
+            if isinstance(node, ast.Call) and _is_thread_join(node):
+                return True
+    return False
+
+
+def _annotated(module, node):
+    """Annotation on any line of the (possibly multi-line) construction
+    or the contiguous comment block above it."""
+    for line in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+        if module.comment(line) and _LIFECYCLE_RE.search(
+                module.comment(line)):
+            return True
+    return module.annotated(node.lineno, _LIFECYCLE_RE)
+
+
+def _walk_shallow(funcdef):
+    """Walk a function body without descending into nested def/class
+    bodies — those are yielded as their own iter_functions entries, and
+    descending here would double-report their thread constructions."""
+    stack = list(funcdef.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def check(project, config):
+    scope = config.get("thread_lifecycle_modules")
+    findings = []
+    for module in project.modules.values():
+        if not model.in_scope(module, scope):
+            continue
+        # owner -> the function bodies whose joins count for it
+        for context, cls, funcdef in model.iter_functions(module):
+            for node in _walk_shallow(funcdef):
+                if not _is_thread_ctor(node):
+                    continue
+                if module.has_ignore(node.lineno, NAME):
+                    continue
+                if _annotated(module, node):
+                    continue
+                owner_funcs = (cls.methods.values() if cls is not None
+                               else [f for _c, k, f in
+                                     model.iter_functions(module)
+                                     if k is None])
+                joined = _joins_in(list(owner_funcs))
+                daemon = _has_daemon_true(node)
+                if joined:
+                    continue
+                owner = cls.name if cls is not None else "<module>"
+                if daemon:
+                    findings.append(Finding(
+                        NAME, module.relpath, node.lineno, context,
+                        f"daemon-unregistered:{owner}",
+                        "daemon thread is neither joined on the "
+                        "owner's shutdown path nor registered with a "
+                        "'# lifecycle:' annotation saying how it "
+                        "exits"))
+                else:
+                    findings.append(Finding(
+                        NAME, module.relpath, node.lineno, context,
+                        f"unjoined:{owner}",
+                        "non-daemon thread is never joined in its "
+                        "owning " +
+                        ("class" if cls is not None else "module") +
+                        " and carries no '# lifecycle:' annotation — "
+                        "it can outlive shutdown silently"))
+    return findings

@@ -1,0 +1,33 @@
+"""hvd-race — dynamic concurrency sanitizer for the threaded runtime.
+
+The runtime reproduces the reference's background-thread architecture
+(sender threads per RingPlane, MuxService reader loops, heartbeat and
+stall-inspector threads, autotune publication) in Python.  hvd-lint
+(docs/linting.md) enforces the *declared* lock discipline statically;
+this package finds what static analysis cannot see — shared state
+nobody annotated, and cross-thread ordering bugs — by watching the
+program actually run:
+
+- :mod:`shim` patches ``threading.Lock/RLock/Condition/Event``,
+  ``queue.Queue`` and ``Thread`` start/join with traced wrappers, and
+  instruments attribute access on the classes of the concurrency-scoped
+  modules.  Installed only when ``HVD_TPU_RACE`` is set — with the
+  variable unset the stock classes are untouched and this package is
+  never imported.
+- :mod:`detector` runs the hybrid analysis: per-location Eraser-style
+  locksets refined by vector-clock happens-before edges (thread
+  start/join, ``queue`` put→get, condition notify→wake, event
+  set→wait, and the PeerService mailbox deliver→recv hook).  Two
+  accesses to the same attribute race when they are concurrent (no
+  happens-before path) and their locksets are disjoint.
+- :mod:`fuzz` injects short, seeded preemptions at instrumentation
+  points (``HVD_TPU_RACE_SEED``, same determinism contract as
+  ``HVD_TPU_FAULT_SPEC``) so narrow interleavings reproduce
+  run-to-run.
+- :mod:`cli` is ``bin/hvd-race``: runs a target under the shim and
+  reports findings through the same baseline machinery as hvd-lint
+  (``.hvd-race-baseline.json``, justification-preserving regeneration,
+  text/JSON output, exit 0/1).
+
+Model, annotations and the baseline workflow: docs/race_detection.md.
+"""

@@ -1,0 +1,132 @@
+"""``bin/hvd-race`` — run a target under the race shim and report.
+
+Usage::
+
+    bin/hvd-race tests/race_fixtures/bad_unlocked_counter.py
+    bin/hvd-race --seed 7 --format json target.py [args...]
+    bin/hvd-race --write-baseline target.py     # refresh suppressions
+
+Target contract: a Python script that defines its classes at module
+level and exposes a ``main()`` — hvd-race loads the module, instruments
+its classes (plus the scoped ``horovod_tpu`` runtime modules), then
+calls ``main()`` and reports every race the run exposed.
+
+Exit codes: 0 = clean (baselined findings included), 1 = active
+findings, 2 = usage error, 3 = the target itself raised.  The baseline
+lives at ``.hvd-race-baseline.json`` in the repo root and shares
+hvd-lint's format and justification rules (docs/race_detection.md).
+"""
+
+import argparse
+import json
+import os
+import runpy
+import sys
+import traceback
+
+from horovod_tpu.tools.lint import findings as findings_mod
+from horovod_tpu.tools.race import shim
+
+DEFAULT_BASELINE = os.path.join(shim.REPO_ROOT,
+                                ".hvd-race-baseline.json")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="hvd-race",
+        description="Dynamic lockset + happens-before race detector "
+                    "for the threaded runtime (docs/race_detection.md).")
+    parser.add_argument("target", help="Python script to run under the "
+                                       "shim (must define main()).")
+    parser.add_argument("args", nargs=argparse.REMAINDER,
+                        help="Arguments passed to the target's argv.")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="Schedule-fuzz seed (default: "
+                             "HVD_TPU_RACE_SEED, else 0); same seed -> "
+                             "same preemption decisions -> same report.")
+    parser.add_argument("--scope", default=None,
+                        help="Comma-separated module relpath suffixes "
+                             "to instrument ('all' = every horovod_tpu "
+                             "module; default: the concurrency-scoped "
+                             "runtime).")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="Report every finding, suppressing "
+                             "nothing.")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="Rewrite the baseline from this run's "
+                             "findings (existing justifications kept; "
+                             "new entries get a TODO the gate test "
+                             "rejects until justified).")
+    parser.add_argument("--format", choices=["text", "json"],
+                        default="text")
+    args = parser.parse_args(argv)
+
+    scope = None
+    if args.scope:
+        scope = tuple(s.strip() for s in args.scope.split(",")
+                      if s.strip())
+    shim.install(scope=scope, seed=args.seed)
+
+    sys.argv = [args.target] + list(args.args)
+    target_error = None
+    try:
+        namespace = runpy.run_path(args.target,
+                                   run_name="__hvd_race_target__")
+        shim.instrument_namespace(namespace, args.target)
+        entry = namespace.get("main")
+        if not callable(entry):
+            parser.error(f"{args.target} defines no main()")
+        entry()
+    except SystemExit:
+        raise
+    except BaseException:  # noqa: BLE001 — report races seen so far,
+        # then surface the crash distinctly from "active findings"
+        target_error = traceback.format_exc()
+
+    all_findings = shim.collect_findings()
+    baseline = {} if args.no_baseline \
+        else findings_mod.load_baseline(args.baseline)
+    if args.write_baseline:
+        if target_error is not None:
+            # a truncated run observed only a prefix of the findings:
+            # regenerating from it would silently prune every
+            # justified suppression the crash prevented re-observing
+            sys.stderr.write(target_error)
+            sys.stderr.write("hvd-race: target crashed — baseline NOT "
+                             "rewritten (a partial run must not prune "
+                             "suppressions)\n")
+            return 3
+        previous = findings_mod.load_baseline(args.baseline)
+        findings_mod.write_baseline(args.baseline, all_findings,
+                                    previous=previous)
+        written = len(findings_mod.load_baseline(args.baseline))
+        print(f"wrote {written} suppression(s) to {args.baseline}")
+        return 0
+    active, suppressed, stale = findings_mod.split_baselined(
+        all_findings, baseline)
+
+    if args.format == "json":
+        json.dump({
+            "findings": [f.as_dict() for f in active],
+            "suppressed": [f.as_dict() for f in suppressed],
+            "stale_baseline_keys": stale,
+        }, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for finding in active:
+            print(finding.render())
+        summary = (f"hvd-race: {len(active)} finding(s), "
+                   f"{len(suppressed)} baselined")
+        if stale:
+            summary += (f", {len(stale)} stale baseline key(s) — run "
+                        f"--write-baseline to prune")
+        print(summary)
+    if target_error is not None:
+        sys.stderr.write(target_error)
+        return 3
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,520 @@
+"""Hybrid lockset / happens-before race detection.
+
+The model (docs/race_detection.md):
+
+- every traced thread carries a **vector clock** and a **lockset** (the
+  traced locks it currently holds);
+- **happens-before edges** come from thread start/join, ``queue.Queue``
+  put→get, ``Condition`` notify→wake, ``Event`` set→wait, and explicit
+  runtime channels (the PeerService mailbox deliver→recv hook in
+  ``ops/tcp_dataplane.py``).  Plain lock acquire/release deliberately
+  creates NO edge — that is the Eraser insight: two accesses that
+  happen to be ordered by coincidental lock timing are still a race if
+  no lock is *common* to both;
+- every attribute read/write on an instrumented class records
+  ``(epoch, lockset, site)`` per location ``(object, attr)``.  Two
+  accesses to the same location by different threads **race** when at
+  least one is a write, neither happens-before the other, and their
+  locksets are disjoint.
+
+Reports carry both racing access sites, the location's ownership
+history, and the ``# guarded by self._X`` lock-discipline annotation
+(if the owning class declares one) that the race contradicts.  They
+are rendered as :class:`horovod_tpu.tools.lint.findings.Finding`
+objects so the hvd-lint baseline machinery
+(``.hvd-race-baseline.json``) applies unchanged.
+
+Determinism: report keys and messages are built only from source
+locations, attribute names, thread *names* and sorted participant
+sets — never from object ids, clock values or timestamps — so a rerun
+under the same ``HVD_TPU_RACE_SEED`` yields byte-identical findings.
+
+Deliberate lock-free accesses are suppressed at the access site with
+an ``# hvd-race: ok[reason]`` comment (the existing
+``# hvd-lint: ignore[lock-discipline]`` annotations are honored too:
+a read the static checker was told is deliberately lock-free is the
+same statement to the dynamic checker).
+"""
+
+import _thread
+import linecache
+import os
+import re
+import sys
+import threading as _threading_mod
+import weakref
+
+from horovod_tpu.tools.lint.findings import Finding
+from horovod_tpu.tools.race.fuzz import ScheduleFuzzer, thread_key
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_RACE_OK_RE = re.compile(
+    r"hvd-race:\s*ok|hvd-lint:\s*ignore\[[^\]]*lock-discipline")
+
+# bounded side-channel storage: a long-running job must not grow the
+# detector without bound however many mailbox chunks it moves
+_MAX_CHANNELS = 8192
+_MAX_LOCATIONS = 65536
+
+# sentinel for objects that cannot be weakref'd (__slots__ without
+# __weakref__): their identity over an id() can't be verified, so their
+# locations reset on every __init__ write instead
+_FRAGILE = object()
+_MISSING = object()
+
+
+class _ThreadState:
+    __slots__ = ("tid", "name", "key", "clock", "lockset", "counter",
+                 "busy")
+
+    def __init__(self, tid, name):
+        self.tid = tid
+        self.name = name
+        self.key = thread_key(name)
+        self.clock = {tid: 1}
+        self.lockset = {}        # lock key -> hold count
+        self.counter = 0         # fuzz draw counter
+        self.busy = False        # reentrancy guard
+
+
+class _Access:
+    __slots__ = ("tid", "epoch", "lockset", "site", "thread_name")
+
+    def __init__(self, tid, epoch, lockset, site, thread_name):
+        self.tid = tid
+        self.epoch = epoch
+        self.lockset = lockset
+        self.site = site          # (relpath, line, func)
+        self.thread_name = thread_name
+
+
+class _Location:
+    __slots__ = ("cls", "attr", "writes", "reads", "first_writer",
+                 "participants")
+
+    def __init__(self, cls, attr):
+        self.cls = cls
+        self.attr = attr
+        self.writes = {}          # tid -> _Access
+        self.reads = {}           # tid -> _Access
+        self.first_writer = None  # thread name of the first write
+        self.participants = set()  # thread names that touched it
+
+
+class RaceReport:
+    """One deduplicated race: a (class, attribute, kind) triple with
+    the pair of racing access sites that first exposed it."""
+
+    __slots__ = ("relpath", "cls_name", "attr", "kind", "access_a",
+                 "access_b", "first_writer", "participants", "guarded_by")
+
+    def __init__(self, relpath, cls_name, attr, kind, access_a,
+                 access_b, first_writer, participants, guarded_by):
+        self.relpath = relpath
+        self.cls_name = cls_name
+        self.attr = attr
+        self.kind = kind          # "write-write" | "read-write"
+        # canonical order: sorted by (site, thread name) so the same
+        # race renders identically whichever access detected it
+        self.access_a = access_a  # (role, site, thread_name, locknames)
+        self.access_b = access_b
+        self.first_writer = first_writer
+        self.participants = participants  # sorted thread names
+        self.guarded_by = guarded_by      # declared owning lock | None
+
+
+class Detector:
+    def __init__(self, repo_root, seed=0):
+        self.repo_root = repo_root
+        self.fuzzer = ScheduleFuzzer(seed)
+        self._lock = _thread.allocate_lock()  # stock, never traced
+        self._tls = _threading_mod.local()
+        self._next_tid = 0
+        self._locations = {}      # (objid, attr) -> _Location
+        self._by_obj = {}         # objid -> set of attrs with locations
+        self._live = {}           # objid -> weakref | _FRAGILE
+        self._channels = {}       # channel key -> clock snapshot | list
+        self._reports = {}        # dedup key -> RaceReport
+        self._suppressed = 0      # annotation-suppressed race count
+        self._class_info = {}     # cls -> (name, relpath) | None
+        self._lock_names = {}     # lock key -> "Cls.attr"
+        self._guarded = {}        # (relpath, clsname) -> {attr: lock}
+
+    # ------------------------------------------------------------ threads
+    def state(self):
+        ts = getattr(self._tls, "state", None)
+        if ts is None:
+            with self._lock:
+                self._next_tid += 1
+                tid = self._next_tid
+            # NEVER threading.current_thread() here: during thread
+            # bootstrap (_started.set() fires before _active
+            # registration) it would fabricate a _DummyThread whose
+            # OWN _started event re-enters this path, recursing
+            # forever.  Read the registry directly; threads traced by
+            # the shim get their real name in on_thread_begin.
+            thread = _threading_mod._active.get(_thread.get_ident())
+            name = thread.name if thread is not None else "(bootstrap)"
+            ts = _ThreadState(tid, name)
+            self._tls.state = ts
+        return ts
+
+    def _tick_snapshot(self, ts):
+        """Snapshot-then-increment: accesses made before this point are
+        covered by the snapshot, accesses after it are not."""
+        snap = dict(ts.clock)
+        ts.clock[ts.tid] = ts.clock.get(ts.tid, 0) + 1
+        return snap
+
+    def _merge(self, ts, snap):
+        if not snap:
+            return
+        clock = ts.clock
+        for tid, c in snap.items():
+            if clock.get(tid, 0) < c:
+                clock[tid] = c
+
+    def on_thread_created(self, thread):
+        """Parent side of ``Thread.start``: the child inherits
+        everything the parent did up to here."""
+        ts = self.state()
+        thread._hvd_race_parent_clock = self._tick_snapshot(ts)
+
+    def on_thread_begin(self, thread):
+        ts = self.state()
+        ts.name = thread.name
+        ts.key = thread_key(thread.name)
+        self._merge(ts, getattr(thread, "_hvd_race_parent_clock", None))
+
+    def on_thread_end(self, thread):
+        ts = self.state()
+        thread._hvd_race_final_clock = self._tick_snapshot(ts)
+
+    def on_thread_joined(self, thread):
+        self._merge(self.state(),
+                    getattr(thread, "_hvd_race_final_clock", None))
+
+    # -------------------------------------------------------------- locks
+    def fuzz(self):
+        ts = self.state()
+        if ts.busy:
+            return
+        ts.counter += 1
+        self.fuzzer.maybe_preempt(ts.key, ts.counter)
+
+    def on_acquire(self, key):
+        ls = self.state().lockset
+        ls[key] = ls.get(key, 0) + 1
+
+    def on_release(self, key):
+        ls = self.state().lockset
+        n = ls.get(key, 0) - 1
+        if n <= 0:
+            ls.pop(key, None)
+        else:
+            ls[key] = n
+
+    def suspend_lock(self, key):
+        """Condition.wait releases the underlying lock (all recursion
+        levels): drop it from the lockset, remembering the depth."""
+        return self.state().lockset.pop(key, 0)
+
+    def resume_lock(self, key, count):
+        if count:
+            self.state().lockset[key] = count
+
+    def register_lock_name(self, key, name):
+        with self._lock:
+            self._lock_names.setdefault(key, name)
+
+    # -------------------------------------------- happens-before channels
+    def publish(self, channel):
+        """Single-slot channel: the latest publisher's clock is what an
+        observer merges (condition notify, event set, mailbox deliver)."""
+        ts = self.state()
+        snap = self._tick_snapshot(ts)
+        with self._lock:
+            self._channels[("s", channel)] = snap
+            self._trim_channels()
+
+    def observe(self, channel):
+        with self._lock:
+            snap = self._channels.get(("s", channel))
+        self._merge(self.state(), snap)
+
+    def publish_fifo(self, channel):
+        """FIFO channel (queue put→get): snapshots pair up in queue
+        order.  Multi-producer pairing is approximate — a swapped pair
+        merges a sibling producer's clock, which can only ever create
+        an extra edge, never a false race."""
+        ts = self.state()
+        snap = self._tick_snapshot(ts)
+        with self._lock:
+            fifo = self._channels.setdefault(("f", channel), [])
+            fifo.append(snap)
+            self._trim_channels()
+        return snap
+
+    def unpublish_fifo(self, channel, snap):
+        """Roll back a ``publish_fifo`` whose operation failed (a
+        ``queue.Full`` put published nothing)."""
+        with self._lock:
+            fifo = self._channels.get(("f", channel))
+            if fifo and snap in fifo:
+                fifo.remove(snap)
+
+    def observe_fifo(self, channel):
+        with self._lock:
+            fifo = self._channels.get(("f", channel))
+            snap = fifo.pop(0) if fifo else None
+        self._merge(self.state(), snap)
+
+    def _trim_channels(self):  # holds: self._lock
+        while len(self._channels) > _MAX_CHANNELS:
+            self._channels.pop(next(iter(self._channels)))
+
+    # ---------------------------------------------------------- classes
+    def register_class(self, cls, relpath, guarded=None):
+        with self._lock:
+            self._class_info[cls] = (cls.__name__, relpath)
+            if guarded:
+                self._guarded[(relpath, cls.__name__)] = dict(guarded)
+
+    def _info_for(self, cls):  # holds: self._lock
+        info = self._class_info.get(cls)
+        if info is None:
+            # subclass of an instrumented base: attribute to the
+            # nearest registered ancestor's module
+            for base in cls.__mro__[1:]:
+                base_info = self._class_info.get(base)
+                if base_info is not None:
+                    info = (cls.__name__, base_info[1])
+                    break
+            else:
+                info = (cls.__name__, "<unknown>")
+            self._class_info[cls] = info
+        return info
+
+    # ----------------------------------------------------------- accesses
+    def on_read(self, obj, attr):
+        self._on_access(obj, attr, is_write=False)
+
+    def on_write(self, obj, attr):
+        ts = self.state()
+        if ts.busy:
+            return
+        ts.counter += 1
+        self.fuzzer.maybe_preempt(ts.key, ts.counter)
+        self._on_access(obj, attr, is_write=True)
+
+    def _on_access(self, obj, attr, is_write):
+        ts = self.state()
+        if ts.busy:
+            return
+        ts.busy = True
+        try:
+            site = self._user_site()
+            if site is None:
+                return
+            epoch = ts.clock.get(ts.tid, 1)
+            lockset = frozenset(ts.lockset)
+            cls = type(obj)
+            objid = id(obj)
+            lkey = (objid, attr)
+            with self._lock:
+                self._verify_identity(obj, objid)
+                loc = self._locations.get(lkey)
+                if loc is None:
+                    if len(self._locations) >= _MAX_LOCATIONS:
+                        old_key = next(iter(self._locations))
+                        self._locations.pop(old_key)
+                        attrs = self._by_obj.get(old_key[0])
+                        if attrs is not None:
+                            attrs.discard(old_key[1])
+                    loc = self._locations[lkey] = _Location(cls, attr)
+                    self._by_obj.setdefault(objid, set()).add(attr)
+                if is_write and site[2] == "__init__":
+                    # a constructor write marks a FRESH object: any
+                    # history under this id belongs to a dead
+                    # predecessor that recycled the address (short-
+                    # lived message objects do this constantly) — a
+                    # same-object __init__ racing another thread is
+                    # not a pattern this runtime can produce
+                    loc.writes.clear()
+                    loc.reads.clear()
+                    loc.participants.clear()
+                    loc.first_writer = None
+                    loc.cls = cls
+                loc.participants.add(ts.name)
+                racy = []
+                for other in loc.writes.values():
+                    # constructor writes count as publication (the
+                    # Eraser initialization state): an object built by
+                    # one thread and handed off read-only is the
+                    # runtime's standard message pattern, not a race —
+                    # the first POST-constructor write re-arms the
+                    # location and races normally
+                    if other.site[2] == "__init__":
+                        continue
+                    if self._races(ts, lockset, other):
+                        racy.append((other, "w"))
+                if is_write:
+                    for other in loc.reads.values():
+                        if self._races(ts, lockset, other):
+                            racy.append((other, "r"))
+                access = _Access(ts.tid, epoch, lockset, site, ts.name)
+                if is_write:
+                    if loc.first_writer is None:
+                        loc.first_writer = ts.name
+                    loc.writes[ts.tid] = access
+                else:
+                    self._covered_reads_prune(loc)
+                    loc.reads[ts.tid] = access
+                for other, other_role in racy:
+                    self._report(loc, access,
+                                 "w" if is_write else "r",
+                                 other, other_role)
+        finally:
+            ts.busy = False
+
+    def _verify_identity(self, obj, objid):  # holds: self._lock
+        """CPython recycles addresses: an id() seen before may now name
+        a different object, and inheriting the dead predecessor's
+        access history fabricates races.  A liveness weakref per id
+        catches the recycle and purges the stale locations; objects
+        that cannot be weakref'd fall back to the __init__-reset rule
+        in ``_on_access``."""
+        live = self._live.get(objid, _MISSING)
+        if live is not _MISSING:
+            if live is _FRAGILE or live() is obj:
+                return
+            for attr in self._by_obj.pop(objid, ()):
+                self._locations.pop((objid, attr), None)
+        if len(self._live) >= _MAX_LOCATIONS:
+            self._live.pop(next(iter(self._live)))
+        try:
+            self._live[objid] = weakref.ref(obj)
+        except TypeError:
+            self._live[objid] = _FRAGILE
+
+    def _races(self, ts, lockset, other):  # holds: self._lock
+        if other.tid == ts.tid:
+            return False
+        # happens-before: the other access is covered when this
+        # thread's clock component for the accessing thread has reached
+        # its epoch
+        if ts.clock.get(other.tid, 0) >= other.epoch:
+            return False
+        return not (lockset & other.lockset)
+
+    def _covered_reads_prune(self, loc):  # holds: self._lock
+        if len(loc.reads) > 16:
+            loc.reads.clear()
+
+    # ------------------------------------------------------------ reports
+    def _user_site(self, depth=2, frames=1):
+        """(relpath, line, func) of the nearest frame outside this
+        package; ``frames`` > 1 returns a tuple of up to that many."""
+        out = []
+        try:
+            f = sys._getframe(depth)
+        except ValueError:
+            return None
+        while f is not None and len(out) < frames:
+            fn = f.f_code.co_filename
+            if not fn.startswith(_PKG_DIR):
+                out.append((self._rel(fn), f.f_lineno,
+                            f.f_code.co_name))
+            f = f.f_back
+        if not out:
+            return None
+        return out[0] if frames == 1 else tuple(out)
+
+    def _rel(self, path):
+        try:
+            rel = os.path.relpath(path, self.repo_root)
+        except ValueError:
+            return path.replace(os.sep, "/")
+        if rel.startswith(".."):
+            return path.replace(os.sep, "/")
+        return rel.replace(os.sep, "/")
+
+    def _site_ignored(self, site):
+        """Honor ``# hvd-race: ok[...]`` (and the static checker's
+        lock-discipline ignores) on either racing line or the
+        contiguous block of pure comment lines directly above it —
+        the same convention hvd-lint's ``annotated()`` applies."""
+        relpath, line, _func = site
+        path = os.path.join(self.repo_root, relpath) \
+            if not os.path.isabs(relpath) else relpath
+        if _RACE_OK_RE.search(linecache.getline(path, line)):
+            return True
+        ln = line - 1
+        while ln >= 1:
+            text = linecache.getline(path, ln)
+            if not text.lstrip().startswith("#"):
+                return False
+            if _RACE_OK_RE.search(text):
+                return True
+            ln -= 1
+        return False
+
+    def _lock_label(self, lockset):  # holds: self._lock
+        names = sorted(self._lock_names.get(k, "?") for k in lockset)
+        if not names:
+            return "no locks"
+        return "holding {" + ", ".join(names) + "}"
+
+    def _report(self, loc, access, role, other, other_role):
+        # holds: self._lock
+        kind = "write-write" if role == "w" and other_role == "w" \
+            else "read-write"
+        cls_name, relpath = self._info_for(loc.cls)
+        key = (relpath, cls_name, loc.attr, kind)
+        if key in self._reports:
+            return
+        if self._site_ignored(access.site) \
+                or self._site_ignored(other.site):
+            self._suppressed += 1
+            self._reports[key] = None  # don't re-evaluate per access
+            return
+        sides = sorted([
+            (role, access.site, access.thread_name,
+             self._lock_label(access.lockset)),
+            (other_role, other.site, other.thread_name,
+             self._lock_label(other.lockset)),
+        ], key=lambda s: (s[1], s[2], s[0]))
+        guarded = self._guarded.get((relpath, cls_name), {})
+        self._reports[key] = RaceReport(
+            relpath, cls_name, loc.attr, kind, sides[0], sides[1],
+            loc.first_writer, sorted(loc.participants),
+            guarded.get(loc.attr))
+
+    # ------------------------------------------------------------ results
+    def findings(self):
+        """Render the deduplicated reports as lint Findings (sorted, so
+        the same run always serializes identically)."""
+        with self._lock:
+            reports = [r for r in self._reports.values()
+                       if r is not None]
+        out = []
+        for r in reports:
+            def fmt(side):
+                role, site, tname, locks = side
+                return (f"[{role}] {site[0]}:{site[1]} {site[2]} "
+                        f"({tname}; {locks})")
+
+            msg = (f"data race ({r.kind}) on {r.cls_name}.{r.attr}: "
+                   f"{fmt(r.access_a)} <-> {fmt(r.access_b)}; "
+                   f"first write by {r.first_writer or 'none'}, "
+                   f"shared by {', '.join(r.participants)}")
+            if r.guarded_by:
+                msg += (f"; contradicts declared '# guarded by "
+                        f"self.{r.guarded_by}'")
+            out.append(Finding(
+                checker="race", path=r.relpath,
+                line=max(r.access_a[1][1], r.access_b[1][1]),
+                context=r.cls_name, detail=f"{r.attr}:{r.kind}",
+                message=msg))
+        out.sort(key=lambda f: (f.path, f.context, f.detail))
+        return out

@@ -1,0 +1,59 @@
+"""Seeded schedule fuzzing for the race shim.
+
+At instrumentation points (lock acquire, attribute write, queue ops)
+the shim asks the fuzzer whether to inject a short preemption — a GIL
+yield or a sub-millisecond sleep — so a narrow interleaving that hides
+on an idle machine is forced open, and forced open THE SAME WAY on
+every run.
+
+Determinism contract (the ``HVD_TPU_FAULT_SPEC`` contract): the
+decision at the N-th instrumentation point of a given thread is a pure
+function of ``(seed, thread key, N)``.  The thread key is a CRC of the
+thread's *name* (thread names are assigned in creation order, which the
+program controls), never of the OS ident, so a rerun with the same seed
+makes identical preemption decisions even though the kernel schedules
+the threads differently.  The OS still owns true interleaving — the
+contract is that the *perturbation* is reproducible, which in practice
+pins the detector's report (tests/test_race.py asserts the identical
+report twice under a fixed seed).
+"""
+
+import time
+import zlib
+
+
+def thread_key(name):
+    """Deterministic per-thread fuzz key (see module docstring)."""
+    return zlib.crc32(name.encode("utf-8", "replace"))
+
+
+def _mix(seed, key, counter):
+    x = (seed * 1000003 ^ key * 0x9E3779B1 ^ counter * 0x85EBCA6B) \
+        & 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x45D9F3B) & 0xFFFFFFFF
+    x ^= x >> 16
+    return x
+
+
+class ScheduleFuzzer:
+    """One instance per installed shim; stateless between calls apart
+    from the per-thread counters the detector owns."""
+
+    __slots__ = ("seed",)
+
+    # out of 1024 draws: ~1% short sleep (forces a real preemption,
+    # bounded so suites under the shim stay inside tier-1 budgets),
+    # ~8% bare yield (releases the GIL at the instrumentation point)
+    _SLEEP_BELOW = 10
+    _YIELD_BELOW = 92
+
+    def __init__(self, seed):
+        self.seed = int(seed)
+
+    def maybe_preempt(self, key, counter):
+        r = _mix(self.seed, key, counter) & 1023
+        if r < self._SLEEP_BELOW:
+            time.sleep(0.0002)
+        elif r < self._YIELD_BELOW:
+            time.sleep(0)

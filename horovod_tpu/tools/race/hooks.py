@@ -1,0 +1,39 @@
+"""Runtime happens-before hooks for hvd-race.
+
+The runtime has ordering channels the generic primitive shims cannot
+see — most importantly the PeerService mailbox, where a chunk's
+delivery (on a MuxService handler thread) must happen-before the
+compute thread's ``recv`` that consumes it even on the no-wait fast
+path (the chunk was already buffered, so the condition-variable edge
+never fires).
+
+The runtime calls these hooks behind an ``if race_hooks.active:`` guard
+so the off-path cost is one module-attribute read; ``active`` flips to
+True only when the shim installs (``HVD_TPU_RACE=1``).  This module
+deliberately imports nothing from the race package at module level —
+importing it must not pull the detector into an uninstrumented process.
+"""
+
+active = False
+_detector = None
+
+
+def attach(detector):
+    """Called by the shim at install time."""
+    global active, _detector
+    _detector = detector
+    active = True
+
+
+def publish(channel):
+    """Record: everything the calling thread did so far happens-before
+    any later ``observe`` of the same channel."""
+    det = _detector
+    if det is not None:
+        det.publish(("hook",) + tuple(channel))
+
+
+def observe(channel):
+    det = _detector
+    if det is not None:
+        det.observe(("hook",) + tuple(channel))

@@ -1,0 +1,502 @@
+"""Installable instrumentation shim for the race detector.
+
+``install()`` patches, process-wide:
+
+- ``threading.Lock`` / ``RLock`` / ``Condition`` / ``Event`` — traced
+  wrappers that keep per-thread locksets and publish the notify→wake /
+  set→wait happens-before edges;
+- ``queue.Queue.put`` / ``get`` — the put→get edge (FIFO-paired
+  snapshots);
+- ``threading.Thread.start`` / ``join`` — the fork and join edges,
+  plus per-thread detector state bootstrap;
+
+and instruments attribute access (``__getattribute__`` /
+``__setattr__``) on every class of the concurrency-scoped modules
+(``HVD_TPU_RACE_SCOPE``; default: the ring data plane, the tcp
+controller, the python controller cycle loop and the mux transport),
+via a sweep of already-imported modules plus an import hook for the
+rest.
+
+The shim is opt-in and absent by construction when off:
+``horovod_tpu/__init__`` imports this module ONLY when ``HVD_TPU_RACE``
+is set, so with the variable unset ``threading.Lock`` is the stock
+factory and no wrapper exists anywhere in the process
+(tests/test_race.py proves both directions).
+"""
+
+import _thread
+import atexit
+import importlib.abc
+import importlib.machinery
+import json
+import os
+import queue as _queue_mod
+import sys
+import threading as _t
+
+from horovod_tpu.tools.race.detector import Detector
+from horovod_tpu.utils import env as env_util
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+# the concurrency-scoped modules instrumented by default — the same
+# neighborhoods hvd-lint's lock checkers police (docs/race_detection.md)
+DEFAULT_SCOPE = (
+    "horovod_tpu/ops/tcp_dataplane.py",
+    "horovod_tpu/ops/tcp_controller.py",
+    "horovod_tpu/ops/python_controller.py",
+    "horovod_tpu/run/service/network.py",
+)
+
+# saved stock primitives — everything the shim itself needs must come
+# from here so detector internals never recurse through the wrappers
+_real = {
+    "Lock": _t.Lock,
+    "RLock": _t.RLock,
+    "Condition": _t.Condition,
+    "Event": _t.Event,
+    "Thread.start": _t.Thread.start,
+    "Thread.join": _t.Thread.join,
+    "Queue.put": _queue_mod.Queue.put,
+    "Queue.get": _queue_mod.Queue.get,
+}
+
+_det = None             # the installed Detector (None = shim off)
+_instrumented = set()   # classes carrying traced attribute access
+_scope = ()
+
+
+def is_installed():
+    return _det is not None
+
+
+def detector():
+    return _det
+
+
+# ------------------------------------------------------ traced primitives
+class TracedLock:
+    __slots__ = ("_lock",)
+
+    def __init__(self):
+        self._lock = _real["Lock"]()
+
+    def acquire(self, blocking=True, timeout=-1):
+        _det.fuzz()
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _det.on_acquire(id(self))
+        return got
+
+    def release(self):
+        _det.on_release(id(self))
+        self._lock.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return f"<TracedLock {self._lock!r}>"
+
+
+class TracedRLock:
+    __slots__ = ("_lock",)
+
+    def __init__(self):
+        self._lock = _real["RLock"]()
+
+    def acquire(self, blocking=True, timeout=-1):
+        _det.fuzz()
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _det.on_acquire(id(self))
+        return got
+
+    def release(self):
+        _det.on_release(id(self))
+        self._lock.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return f"<TracedRLock {self._lock!r}>"
+
+
+def _raw(lock):
+    """The stock lock under a traced wrapper (a stock lock passes
+    through — code may hand ``Condition`` a pre-shim lock)."""
+    return lock._lock if isinstance(lock, (TracedLock, TracedRLock)) \
+        else lock
+
+
+class TracedCondition:
+    __slots__ = ("_wl", "_cond", "_key")
+
+    def __init__(self, lock=None):
+        self._wl = TracedRLock() if lock is None else lock
+        self._cond = _real["Condition"](_raw(self._wl))
+        # lockset identity is the (possibly shared) wrapper lock, so
+        # ``with q.mutex`` and ``with q.not_empty`` intersect
+        self._key = id(self._wl)
+
+    def acquire(self, *args, **kwargs):
+        return self._wl.acquire(*args, **kwargs)
+
+    def release(self):
+        self._wl.release()
+
+    def __enter__(self):
+        self._wl.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._wl.release()
+
+    def wait(self, timeout=None):
+        # the real wait releases the underlying lock for the duration:
+        # mirror that in the lockset, then merge the notifier's clock
+        # on wakeup (the notify→wake happens-before edge)
+        depth = _det.suspend_lock(self._key)
+        try:
+            got = self._cond.wait(timeout)
+        finally:
+            _det.resume_lock(self._key, depth)
+        _det.observe(("cv", id(self)))
+        return got
+
+    def wait_for(self, predicate, timeout=None):
+        import time as _time
+
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        result = predicate()
+        while not result:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+            self.wait(remaining)
+            result = predicate()
+        return result
+
+    def notify(self, n=1):
+        _det.publish(("cv", id(self)))
+        self._cond.notify(n)
+
+    def notify_all(self):
+        _det.publish(("cv", id(self)))
+        self._cond.notify_all()
+
+    def __repr__(self):
+        return f"<TracedCondition {self._cond!r}>"
+
+
+class TracedEvent:
+    __slots__ = ("_ev",)
+
+    def __init__(self):
+        self._ev = _real["Event"]()
+
+    def set(self):
+        _det.publish(("ev", id(self)))
+        self._ev.set()
+
+    def clear(self):
+        self._ev.clear()
+
+    def is_set(self):
+        return self._ev.is_set()
+
+    def wait(self, timeout=None):
+        got = self._ev.wait(timeout)
+        if got:
+            # the set→wait-return happens-before edge
+            _det.observe(("ev", id(self)))
+        return got
+
+    def __repr__(self):
+        return f"<TracedEvent {self._ev!r}>"
+
+
+# ---------------------------------------------------- thread + queue hooks
+def _traced_start(self):
+    if not getattr(self, "_hvd_race_wrapped", False):
+        self._hvd_race_wrapped = True
+        _det.on_thread_created(self)
+        orig_run = self.run
+
+        def run():
+            _det.on_thread_begin(self)
+            try:
+                orig_run()
+            finally:
+                _det.on_thread_end(self)
+                try:
+                    del self.run  # break the wrapper's ref cycle
+                except AttributeError:
+                    pass
+
+        self.run = run
+    _real["Thread.start"](self)
+
+
+def _traced_join(self, timeout=None):
+    _real["Thread.join"](self, timeout)
+    if not self.is_alive():
+        # the child-exit→joiner happens-before edge
+        _det.on_thread_joined(self)
+
+
+def _traced_put(self, item, block=True, timeout=None):
+    _det.fuzz()
+    snap = _det.publish_fifo(("q", id(self)))
+    try:
+        _real["Queue.put"](self, item, block, timeout)
+    except BaseException:
+        _det.unpublish_fifo(("q", id(self)), snap)
+        raise
+
+
+def _traced_get(self, block=True, timeout=None):
+    _det.fuzz()
+    item = _real["Queue.get"](self, block, timeout)
+    # the put→get happens-before edge (FIFO-paired with the producer)
+    _det.observe_fifo(("q", id(self)))
+    return item
+
+
+# ------------------------------------------------- attribute instrumentation
+def _should_instrument(cls):
+    if cls in _instrumented or not isinstance(cls, type):
+        return False
+    if issubclass(cls, BaseException):
+        return False  # raise/except machinery is not shared state
+    # a base already carries the traced __getattribute__: the subclass
+    # inherits it, and double wrapping would record every access twice
+    return not any(base in _instrumented for base in cls.__mro__[1:])
+
+
+def instrument_class(cls, relpath=None, guarded=None):
+    """Wrap ``cls``'s attribute access with detector callbacks.  Safe
+    to call at most once per class; subclasses of an instrumented base
+    are covered through inheritance."""
+    if not _should_instrument(cls):
+        return
+    _instrumented.add(cls)
+    if relpath is None:
+        relpath = _module_relpath(sys.modules.get(cls.__module__))
+    _det.register_class(cls, relpath or "<unknown>", guarded=guarded)
+    orig_get = cls.__getattribute__
+    orig_set = cls.__setattr__
+
+    def __getattribute__(self, name, _og=orig_get):
+        value = _og(self, name)
+        if name.startswith("_hvd") or name.startswith("__"):
+            return value
+        # data attributes only: methods (and other callables) are
+        # immutable lookup traffic, not shared mutable state
+        if not callable(value):
+            _det.on_read(self, name)
+        return value
+
+    def __setattr__(self, name, value, _os=orig_set):
+        if not name.startswith("_hvd") and not name.startswith("__"):
+            if isinstance(value, (TracedLock, TracedRLock,
+                                  TracedCondition, TracedEvent)):
+                # the race just learned this lock's name: reports can
+                # say "holding {RingPlane._lock}" instead of an id
+                key = id(value._wl) if isinstance(
+                    value, TracedCondition) else id(value)
+                _det.register_lock_name(
+                    key, f"{type(self).__name__}.{name}")
+            elif not callable(value):
+                _det.on_write(self, name)
+            _os(self, name, value)
+            return
+        _os(self, name, value)
+
+    cls.__getattribute__ = __getattribute__
+    cls.__setattr__ = __setattr__
+
+
+def _path_relpath(path):
+    """Repo-relative forward-slash path (absolute when outside the
+    repo) — finding keys and report paths both normalize through
+    here."""
+    path = os.path.abspath(path)
+    rel = os.path.relpath(path, REPO_ROOT)
+    if rel.startswith(".."):
+        return path.replace(os.sep, "/")
+    return rel.replace(os.sep, "/")
+
+
+def _module_relpath(module):
+    path = getattr(module, "__file__", None)
+    return _path_relpath(path) if path else None
+
+
+def _guarded_map(path):
+    """{class name: {attr: owning lock}} — the lock-discipline
+    declarations of the source file, reused from the hvd-lint model so
+    a race report can name the annotation it contradicts."""
+    try:
+        from horovod_tpu.tools.lint.model import SourceModule
+
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        parsed = SourceModule(path, os.path.basename(path), source)
+        return {name: cls.guarded
+                for name, cls in parsed.classes.items() if cls.guarded}
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        return {}
+
+
+def _module_guarded(module):
+    path = getattr(module, "__file__", None)
+    return _guarded_map(path) if path else {}
+
+
+def _in_scope(relpath):
+    if not relpath:
+        return False
+    if "all" in _scope:
+        return relpath.startswith("horovod_tpu/") \
+            and not relpath.startswith("horovod_tpu/tools/")
+    return any(relpath.endswith(suffix) for suffix in _scope)
+
+
+def instrument_module(module):
+    relpath = _module_relpath(module)
+    guarded = _module_guarded(module)
+    for value in list(vars(module).values()):
+        if isinstance(value, type) \
+                and value.__module__ == module.__name__:
+            instrument_class(value, relpath=relpath,
+                             guarded=guarded.get(value.__name__))
+
+
+def instrument_namespace(namespace, path):
+    """Instrument the classes a ``runpy``-loaded target script defined
+    (``bin/hvd-race``'s fixture contract)."""
+    relpath = _path_relpath(path)
+    guarded = _guarded_map(path)
+    for value in list(namespace.values()):
+        if isinstance(value, type) and getattr(
+                value, "__module__", "") in ("__main__",
+                                             "__hvd_race_target__"):
+            instrument_class(value, relpath=relpath,
+                             guarded=guarded.get(value.__name__))
+
+
+class _ScopeImportHook(importlib.abc.MetaPathFinder, importlib.abc.Loader):
+    """Instruments scoped ``horovod_tpu`` modules as they import (the
+    shim installs at package-import time, before the runtime modules
+    load)."""
+
+    def find_spec(self, fullname, path, target=None):
+        if not fullname.startswith("horovod_tpu."):
+            return None
+        spec = importlib.machinery.PathFinder.find_spec(fullname, path)
+        if spec is None or spec.loader is None:
+            return None
+        spec.loader = _LoaderProxy(spec.loader)
+        return spec
+
+
+class _LoaderProxy:
+    def __init__(self, loader):
+        self._loader = loader
+
+    def create_module(self, spec):
+        return self._loader.create_module(spec)
+
+    def exec_module(self, module):
+        self._loader.exec_module(module)
+        if _det is not None and _in_scope(_module_relpath(module)):
+            instrument_module(module)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+
+# ------------------------------------------------------------ installation
+def install(scope=None, seed=None):
+    """Patch the primitives and start detecting.  Idempotent."""
+    global _det, _scope
+    if _det is not None:
+        return _det
+    if seed is None:
+        seed = env_util.get_int(env_util.HVD_TPU_RACE_SEED, 0)
+    if scope is None:
+        raw = env_util.get_str(env_util.HVD_TPU_RACE_SCOPE)
+        scope = tuple(s.strip() for s in raw.split(",") if s.strip()) \
+            if raw else DEFAULT_SCOPE
+    _scope = tuple(scope)
+    _det = Detector(REPO_ROOT, seed=seed)
+
+    _t.Lock = TracedLock
+    _t.RLock = TracedRLock
+    _t.Condition = TracedCondition
+    _t.Event = TracedEvent
+    _t.Thread.start = _traced_start
+    _t.Thread.join = _traced_join
+    _queue_mod.Queue.put = _traced_put
+    _queue_mod.Queue.get = _traced_get
+
+    sys.meta_path.insert(0, _ScopeImportHook())
+    for module in list(sys.modules.values()):
+        if _in_scope(_module_relpath(module)):
+            instrument_module(module)
+
+    from horovod_tpu.tools.race import hooks
+    hooks.attach(_det)
+
+    report_path = env_util.get_str(env_util.HVD_TPU_RACE_REPORT)
+    if report_path:
+        atexit.register(_dump_report, report_path)
+    return _det
+
+
+def install_from_env():
+    """``horovod_tpu/__init__`` entry: install iff HVD_TPU_RACE is on
+    (the caller already checked, but double-gate so an accidental
+    import of this module never arms the shim by itself)."""
+    if env_util.get_bool(env_util.HVD_TPU_RACE):
+        install()
+
+
+def collect_findings():
+    return _det.findings() if _det is not None else []
+
+
+def _dump_report(prefix):
+    """One JSON per process (``<prefix>.<pid>.json``): the suites spawn
+    worker ranks that share the env contract, so every rank writes its
+    own file and the gate test globs them up."""
+    try:
+        findings = collect_findings()
+        with open(f"{prefix}.{os.getpid()}.json", "w") as f:
+            json.dump({"findings": [x.as_dict() for x in findings]}, f,
+                      indent=2)
+    except Exception:  # noqa: BLE001 — report dump must never mask the
+        pass           # process's own exit status
+
+
+# the stock identities, exported so tests can prove neutrality against
+# exactly what the shim would have replaced
+STOCK = dict(_real)
+
+# _thread is intentionally imported (and never patched): the detector's
+# own lock comes from _thread.allocate_lock so shim internals cannot
+# recurse through the traced wrappers
+assert _thread.allocate_lock is not None

@@ -59,6 +59,24 @@ HVD_TPU_RING_STRIPES = "HVD_TPU_RING_STRIPES"
 # instead of the coordinator star (docs/tuning.md)
 HVD_TCP_RING_THRESHOLD = "HVD_TCP_RING_THRESHOLD"
 
+# --- race detection (docs/race_detection.md) ---------------------------------
+# install the hvd-race shim at import: traced threading/queue
+# primitives + instrumented attribute access on the concurrency-scoped
+# modules.  Off (the default) leaves the stock classes untouched and
+# never imports the shim.
+HVD_TPU_RACE = "HVD_TPU_RACE"
+# schedule-fuzz seed: deterministic preemptions at instrumentation
+# points (same contract as HVD_TPU_FAULT_SPEC — same seed, same
+# decisions, same report)
+HVD_TPU_RACE_SEED = "HVD_TPU_RACE_SEED"
+# comma-separated module relpath suffixes to instrument ("all" =
+# every horovod_tpu module outside tools/)
+HVD_TPU_RACE_SCOPE = "HVD_TPU_RACE_SCOPE"
+# report-file prefix: each shimmed process dumps its findings to
+# <prefix>.<pid>.json at exit so the tier-1 gate can collect reports
+# from launcher-spawned worker ranks
+HVD_TPU_RACE_REPORT = "HVD_TPU_RACE_REPORT"
+
 # --- fault-tolerant collective runtime (docs/fault_tolerance.md) -------------
 # bound on "abort initiated anywhere -> every rank raises HvdAbortedError"
 HVD_TPU_ABORT_TIMEOUT = "HVD_TPU_ABORT_TIMEOUT"

@@ -62,6 +62,7 @@ setup(
         "horovod_tpu.tools",
         "horovod_tpu.tools.lint",
         "horovod_tpu.tools.lint.checkers",
+        "horovod_tpu.tools.race",
         "horovod_tpu.torch",
         "horovod_tpu.utils",
     ],

@@ -1,0 +1,47 @@
+"""Known-bad fixture for the thread-lifecycle checker: threads with no
+declared way to end."""
+
+import threading
+
+
+class LeakyWorker:
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        # non-daemon, never joined anywhere in this class, no
+        # annotation: outlives shutdown silently
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def _loop(self):
+        pass
+
+
+class SilentDaemon:
+    def start(self):
+        # daemon, but neither joined nor registered with an
+        # exit-story annotation
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        pass
+
+
+def fire_and_forget():
+    # module-level: same rule applies
+    threading.Thread(target=print).start()
+
+
+class StringJoinerNotAThreadJoin:
+    """A string/bytes separator join must not discharge the rule."""
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def _loop(self):
+        pass
+
+    def describe(self, names):
+        return ", ".join(names) + b"|".join([b"a"]).decode()

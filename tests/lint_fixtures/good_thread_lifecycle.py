@@ -1,0 +1,38 @@
+"""Good twin for the thread-lifecycle checker: every thread is joined
+on its owner's shutdown path or daemon + lifecycle-registered."""
+
+import threading
+
+
+class JoinedWorker:
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def _loop(self):
+        pass
+
+    def shutdown(self):
+        self._thread.join(timeout=5)
+
+
+class RegisteredDaemon:
+    def start(self):
+        # lifecycle: exits when the stop event fires; abandoned at
+        # process exit by design
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        pass
+
+
+def scoped_fanout(hosts):
+    threads = [threading.Thread(target=print, args=(h,), daemon=True)
+               for h in hosts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()

@@ -1,0 +1,42 @@
+"""Known-bad hvd-race fixture: the publisher writes the condition's
+predicate OUTSIDE the lock before notifying under it — the classic
+lost-update shape.  The consumer's predicate read (holding the cv)
+races the unlocked write: disjoint locksets, and no happens-before
+edge connects them (the notify→wake edge orders only the accesses
+AFTER the wakeup)."""
+
+import threading
+import time
+
+
+class Box:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.ready = False   # guarded by self._cv
+        self.value = None    # guarded by self._cv
+
+    def consume(self):
+        with self._cv:
+            while not self.ready:
+                self._cv.wait(timeout=5)
+            return self.value
+
+    def publish(self, value):
+        # BUG: the predicate writes happen before the lock is taken
+        self.value = value
+        self.ready = True
+        with self._cv:
+            self._cv.notify_all()
+
+
+def main():
+    box = Box()
+    consumer = threading.Thread(target=box.consume)
+    consumer.start()
+    time.sleep(0.2)   # let the consumer check the predicate first
+    box.publish(42)
+    consumer.join()
+
+
+if __name__ == "__main__":
+    main()

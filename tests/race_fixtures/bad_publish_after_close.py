@@ -1,0 +1,42 @@
+"""Known-bad hvd-race fixture: close() tears down the output buffer
+while a publisher thread is still reading it — the shape of the real
+close()-strands-_flush_sends race PR 3 fixed by hand in the ring data
+plane.  The publisher's unlocked read of ``out`` races close()'s
+unlocked teardown write: no common lock, no happens-before edge
+(close never waits for the publisher)."""
+
+import threading
+import time
+
+
+class Sink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.out = []      # guarded by self._lock
+        self.closing = False
+
+    def publish_loop(self):
+        for _ in range(100):
+            buf = self.out          # BUG: read without the lock
+            if buf is None:
+                return
+            buf.append(1)
+            time.sleep(0.002)
+
+    def close(self):
+        # BUG: tears down state the publisher still reads, without
+        # taking the lock or waiting for the publisher to exit
+        self.out = None
+
+
+def main():
+    sink = Sink()
+    publisher = threading.Thread(target=sink.publish_loop)
+    publisher.start()
+    time.sleep(0.05)
+    sink.close()
+    publisher.join()
+
+
+if __name__ == "__main__":
+    main()

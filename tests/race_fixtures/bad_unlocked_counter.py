@@ -1,0 +1,31 @@
+"""Known-bad hvd-race fixture: a shared counter incremented by two
+threads with no lock at all — the canonical Eraser write-write (and
+read-write: ``+=`` is a read then a write) race.  Caught regardless of
+interleaving: the accesses have empty locksets and no happens-before
+path connects sibling threads that were both started before either
+join."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        for _ in range(200):
+            self.count += 1
+
+
+def main():
+    counter = Counter()
+    workers = [threading.Thread(target=counter.bump) for _ in range(2)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    return counter.count
+
+
+if __name__ == "__main__":
+    main()

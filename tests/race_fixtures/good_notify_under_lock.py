@@ -1,0 +1,38 @@
+"""Good twin of ``bad_notify_without_lock``: predicate and payload are
+written under the condition's lock, exactly as the annotation
+declares — consumer and publisher locksets share the cv."""
+
+import threading
+import time
+
+
+class Box:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.ready = False   # guarded by self._cv
+        self.value = None    # guarded by self._cv
+
+    def consume(self):
+        with self._cv:
+            while not self.ready:
+                self._cv.wait(timeout=5)
+            return self.value
+
+    def publish(self, value):
+        with self._cv:
+            self.value = value
+            self.ready = True
+            self._cv.notify_all()
+
+
+def main():
+    box = Box()
+    consumer = threading.Thread(target=box.consume)
+    consumer.start()
+    time.sleep(0.2)
+    box.publish(42)
+    consumer.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Good twin of ``bad_publish_after_close``: close() signals the
+publisher to stop (event set→wait edge) and JOINS it before tearing
+the buffer down — the child-exit→joiner happens-before edge orders
+every publisher read before close()'s write, so the same unlocked
+teardown is race-free."""
+
+import threading
+import time
+
+
+class Sink:
+    def __init__(self):
+        self._stop = threading.Event()
+        self.out = []
+        self._publisher = None
+
+    def publish_loop(self):
+        while not self._stop.is_set():
+            self.out.append(1)
+            time.sleep(0.002)
+
+    def start(self):
+        self._publisher = threading.Thread(target=self.publish_loop)
+        self._publisher.start()
+
+    def close(self):
+        self._stop.set()
+        self._publisher.join()
+        # ordered after every publisher access by the join edge
+        self.out = None
+
+
+def main():
+    sink = Sink()
+    sink.start()
+    time.sleep(0.05)
+    sink.close()
+
+
+if __name__ == "__main__":
+    main()

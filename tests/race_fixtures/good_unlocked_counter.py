@@ -1,0 +1,30 @@
+"""Good twin of ``bad_unlocked_counter``: the same increment loop with
+the counter's lock held — both threads' locksets share ``_lock``, so
+no report."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0   # guarded by self._lock
+
+    def bump(self):
+        for _ in range(200):
+            with self._lock:
+                self.count += 1
+
+
+def main():
+    counter = Counter()
+    workers = [threading.Thread(target=counter.bump) for _ in range(2)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    return counter.count
+
+
+if __name__ == "__main__":
+    main()

@@ -211,12 +211,14 @@ def test_safe_shell_exec_reports_event_termination():
 
     from horovod_tpu.run import safe_shell_exec
 
-    # natural failure: no event involvement recorded
+    # natural failure: no event involvement recorded; the exit
+    # timestamp is recorded for the launcher's death-order attribution
     info = {}
     code = safe_shell_exec.execute([sys.executable, "-c", "exit(3)"],
                                    info=info)
     assert code == 3
     assert not info.get("terminated_by_event")
+    assert info.get("exit_ts") is not None
 
     # event-driven kill: the victim is marked so the launcher does not
     # blame it for the job failure
@@ -228,6 +230,51 @@ def test_safe_shell_exec_reports_event_termination():
         events=[event], info=info)
     assert code != 0
     assert info.get("terminated_by_event") is True
+
+
+def test_culprit_attribution_survives_reap_order_skew():
+    """Deflake regression (the load-sensitive culprit flake): reap
+    order is NOT death order — stream-forwarder drains and thread
+    scheduling sit between a child dying and its failure being
+    recorded, so under machine load a survivor that exits nonzero
+    because of the coordinated abort can be reaped BEFORE the rank
+    whose death caused it.  Attribution must rank by exit timestamp
+    and by the fault spec's own crash ranks, never by arrival."""
+    from horovod_tpu.run.launch import fault_crash_ranks, pick_culprit
+    from horovod_tpu.utils import env as env_util
+
+    # induced reap-order skew: the survivor (abort exit, ts 105) was
+    # recorded first; the true culprit (died at ts 100) second
+    failures = [(0, 1, False, 105.0), (1, 7, False, 100.0)]
+    assert pick_culprit(failures) == (1, 7)
+
+    # a victim of the kill fan-out never steals the blame, even with
+    # the earliest timestamp
+    failures = [(2, -15, True, 99.0), (1, 7, False, 100.0)]
+    assert pick_culprit(failures) == (1, 7)
+
+    # all-victims (launcher interrupt edge case): fall back to the
+    # earliest observed death
+    failures = [(2, -15, True, 99.0), (0, -15, True, 98.0)]
+    assert pick_culprit(failures) == (0, -15)
+
+    # an injected-crash rank is the culprit by construction — timing
+    # evidence cannot outvote the fault spec
+    failures = [(0, 1, False, 100.0), (1, 1, False, 101.0)]
+    assert pick_culprit(failures, frozenset({1})) == (1, 1)
+
+    # a missing timestamp (launch-phase failure) sorts last
+    failures = [(0, 1, False, None), (1, 7, False, 100.0)]
+    assert pick_culprit(failures) == (1, 7)
+
+    # crash-rank extraction from the worker env contract
+    assert fault_crash_ranks(
+        {env_util.HVD_TPU_FAULT_SPEC:
+         "rank1:ring:1:crash,rank0:send:2:drop,*:connect:1:refuse"}) \
+        == frozenset({1})
+    assert fault_crash_ranks({}) == frozenset()
+    assert fault_crash_ranks(
+        {env_util.HVD_TPU_FAULT_SPEC: "garbage"}) == frozenset()
 
 
 # ------------------------------------------------------ injected matrix -----
@@ -311,7 +358,17 @@ def test_injected_crash_mid_ring_allreduce():
     """The acceptance scenario: rank 1 dies AFTER the coordinator's
     ring go-ahead, with rank 0 already blocked on its chunks — the ring
     path's worst case.  Liveness converts the silence into an abort and
-    the blocked recv wakes with the typed error, mailbox clean."""
+    the blocked recv wakes with the typed error, mailbox clean.
+
+    origin=1 is deterministic whichever detector fires first under
+    machine load: liveness names the silent rank, and the survivor's
+    own hard failure evidence (RingSendError — the transport write to
+    the dead peer broke) now carries the peer rank into the abort
+    origin instead of blaming the rank that noticed.  (A recv timeout
+    deliberately still names the noticing rank: in a 3+-rank ring the
+    silent predecessor is usually an innocent rank blocked behind the
+    real casualty — and its 30s bound can never beat the 2s liveness
+    window here anyway.)"""
     results = spawn_tcp_ranks(2, MATRIX_WORKER, extra_env={
         **_FT_ENV,
         "FT_OP": "allreduce",

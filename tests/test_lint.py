@@ -54,6 +54,7 @@ CASES = [
     ("lock-discipline", "lock_discipline", False),
     ("lock-order", "lock_order", False),
     ("abort-wakeability", "wakeability", False),
+    ("thread-lifecycle", "thread_lifecycle", False),
     ("config-surface", "config_surface", True),
     ("wire-safety", "wire_safety", False),
 ]
@@ -101,6 +102,14 @@ def test_bad_fixture_details():
     wire = _lint_fixture("bad_wire_safety.py", "wire-safety")
     details = {f.detail for f in wire}
     assert details == {"pickle-loads", "raw-send"}
+
+    life = _lint_fixture("bad_thread_lifecycle.py", "thread-lifecycle")
+    details = {f.detail for f in life}
+    assert "unjoined:LeakyWorker" in details
+    assert "daemon-unregistered:SilentDaemon" in details
+    assert "unjoined:<module>" in details
+    # a string/bytes separator join is not a thread join
+    assert "unjoined:StringJoinerNotAThreadJoin" in details
 
 
 # ------------------------------------------------- checker precision pins

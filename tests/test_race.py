@@ -1,0 +1,394 @@
+"""Tier-1 gate for hvd-race (docs/race_detection.md).
+
+Four halves:
+
+1. every known-bad fixture under ``tests/race_fixtures/`` is caught
+   DETERMINISTICALLY under a fixed seed (the same seed twice yields the
+   byte-identical report) and every good twin stays silent;
+2. the concurrency-heavy suite paths — the loopback ring data plane
+   (the tcp-matrix harness), the fault-injection worker harness
+   (including the mid-ring crash), and the python-controller
+   stall-inspector path — run under the shim with ZERO non-baselined
+   reports;
+3. shim neutrality: with ``HVD_TPU_RACE`` unset the shim is provably
+   not installed (stock identities, module absent, stock lock
+   throughput); with it set the shim is provably installed;
+4. the baseline stays small (<= 10) and justified.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import spawn_tcp_ranks
+from horovod_tpu.tools.lint import findings as findings_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "race_fixtures")
+HVD_RACE = os.path.join(REPO, "bin", "hvd-race")
+BASELINE = os.path.join(REPO, ".hvd-race-baseline.json")
+
+
+def _run_hvd_race(fixture, seed=7, extra=()):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, HVD_RACE, "--seed", str(seed), "--no-baseline",
+         "--format", "json", *extra, os.path.join(FIXTURES, fixture)],
+        env=env, capture_output=True, text=True, timeout=240, cwd=REPO)
+    payload = json.loads(out.stdout) if out.stdout.strip() else {}
+    return out.returncode, payload, out.stderr
+
+
+BAD_CASES = [
+    ("bad_unlocked_counter.py", "Counter", "count"),
+    ("bad_notify_without_lock.py", "Box", "ready"),
+    ("bad_publish_after_close.py", "Sink", "out"),
+]
+
+
+@pytest.mark.parametrize("fixture,cls,attr", BAD_CASES,
+                         ids=[c[0] for c in BAD_CASES])
+def test_bad_fixture_is_caught(fixture, cls, attr):
+    code, payload, err = _run_hvd_race(fixture)
+    assert code == 1, f"{fixture}: expected findings, got rc={code}\n{err}"
+    found = payload["findings"]
+    assert any(f["context"] == cls and f["detail"].startswith(attr + ":")
+               for f in found), found
+
+
+@pytest.mark.parametrize("fixture", [
+    "good_unlocked_counter.py",
+    "good_notify_under_lock.py",
+    "good_publish_join_before_close.py",
+])
+def test_good_twin_is_silent(fixture):
+    code, payload, err = _run_hvd_race(fixture)
+    assert code == 0, (f"{fixture}: false positive(s): "
+                       f"{payload.get('findings')}\n{err}")
+    assert payload["findings"] == []
+
+
+def test_same_seed_reproduces_identical_report():
+    """The HVD_TPU_RACE_SEED determinism contract: the fuzzer's
+    preemption decisions — and therefore the report, down to the racing
+    sites, thread names and message text — are a pure function of the
+    seed."""
+    _, first, _ = _run_hvd_race("bad_unlocked_counter.py", seed=7)
+    _, second, _ = _run_hvd_race("bad_unlocked_counter.py", seed=7)
+    assert first["findings"], "fixture produced no findings"
+    assert first == second
+
+
+def test_report_attributes_both_stacks_and_annotation():
+    """A report names both racing sites with thread names, the
+    ownership history, and the '# guarded by' declaration it
+    contradicts."""
+    _, payload, _ = _run_hvd_race("bad_notify_without_lock.py")
+    (finding,) = [f for f in payload["findings"]
+                  if f["detail"].startswith("ready:")]
+    msg = finding["message"]
+    assert "consume" in msg and "publish" in msg      # both sites
+    assert "MainThread" in msg                        # thread names
+    assert "first write by" in msg                    # ownership history
+    assert "contradicts declared '# guarded by self._cv'" in msg
+
+
+# ------------------------------------------------------- shim neutrality --
+NEUTRALITY_PROBE = r"""
+import sys, time
+import horovod_tpu  # the install gate runs (or not) here
+import threading, queue, _thread
+
+race_on = __RACE_ON__
+if race_on:
+    assert "horovod_tpu.tools.race.shim" in sys.modules, \
+        "HVD_TPU_RACE=1 did not install the shim"
+    from horovod_tpu.tools.race import shim
+    assert shim.is_installed()
+    assert threading.Lock is shim.TracedLock
+    assert threading.Event is shim.TracedEvent
+else:
+    assert "horovod_tpu.tools.race.shim" not in sys.modules, \
+        "shim module imported with HVD_TPU_RACE unset"
+    assert threading.Lock is _thread.allocate_lock, threading.Lock
+    assert threading.Thread.start.__module__ == "threading"
+    assert threading.Thread.join.__module__ == "threading"
+    assert queue.Queue.put.__module__ == "queue"
+    assert queue.Queue.get.__module__ == "queue"
+    # micro-benchmark: stock lock throughput (instrumentation would
+    # cost an order of magnitude; the floor is generous so machine
+    # load cannot flake it)
+    lock = threading.Lock()
+    n = 200_000
+    start = time.perf_counter()
+    for _ in range(n):
+        lock.acquire()
+        lock.release()
+    elapsed = time.perf_counter() - start
+    assert elapsed < 2.0, f"{n} stock lock cycles took {elapsed:.2f}s"
+print("NEUTRAL-OK")
+"""
+
+
+def _run_probe(race_on):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("HVD_TPU_RACE", None)
+    if race_on:
+        env["HVD_TPU_RACE"] = "1"
+    script = NEUTRALITY_PROBE.replace("__RACE_ON__", str(race_on))
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr
+    assert "NEUTRAL-OK" in out.stdout
+
+
+def test_shim_absent_when_off():
+    _run_probe(race_on=False)
+
+
+def test_shim_installed_when_on():
+    _run_probe(race_on=True)
+
+
+# ------------------------------------------- suites under the shim --------
+def _nonbaselined(report_glob):
+    baseline = findings_mod.load_baseline(BASELINE)
+    active = []
+    for path in sorted(glob.glob(report_glob)):
+        with open(path) as f:
+            data = json.load(f)
+        for finding in data["findings"]:
+            if finding["key"] not in baseline:
+                active.append(finding)
+    return active
+
+
+def _run_inline_under_shim(body, report_prefix, tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HVD_TPU_RACE": "1",
+        "HVD_TPU_RACE_SEED": "3",
+        "HVD_TPU_RACE_REPORT": str(tmp_path / report_prefix),
+    })
+    out = subprocess.run([sys.executable, "-c", body], env=env,
+                         capture_output=True, text=True, timeout=300,
+                         cwd=REPO)
+    assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
+    return _nonbaselined(str(tmp_path / (report_prefix + ".*.json")))
+
+
+RING_HARNESS = r"""
+import numpy as np
+import threading
+import horovod_tpu  # installs the shim
+import bench
+
+services, planes = bench._ring_harness(2, 1024, 2)
+def run_all(fn):
+    errs = []
+    def run(r):
+        try:
+            fn(r)
+        except BaseException as e:
+            errs.append(e)
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts: t.start()
+    for t in ts: t.join()
+    assert not errs, errs
+
+arrs = [np.arange(4000, dtype=np.float32) * (r + 1) for r in range(2)]
+out = [None, None]
+def ar(r):
+    out[r] = planes[r].allreduce(1, arrs[r], [0, 1],
+                                 op_average=False, world_size=2)
+run_all(ar)
+assert np.array_equal(out[0], out[1])
+def ar8(r):
+    out[r] = planes[r].allreduce(2, arrs[r], [0, 1], op_average=False,
+                                 world_size=2, compression="int8")
+run_all(ar8)
+def bc(r):
+    out[r] = planes[r].broadcast(3, arrs[0] if r == 0 else None,
+                                 [0, 1], 0, shape=arrs[0].shape,
+                                 dtype="float32")
+run_all(bc)
+# abort waking a blocked stripe recv, then teardown
+caught = []
+def blocked():
+    try:
+        planes[1].recv_chunk((99, "rs", 0), 0, 3 * 1024, timeout=30)
+    except BaseException as e:
+        caught.append(e)
+t = threading.Thread(target=blocked); t.start()
+import time; time.sleep(0.3)
+services[1].abort(0, "race-gate abort")
+t.join(5)
+assert caught
+for p in planes: p.close()
+for s in services: s.shutdown()
+print("RING-OK")
+"""
+
+
+def test_ring_dataplane_clean_under_shim(tmp_path):
+    """The tcp-matrix harness path: exact + int8 + broadcast rounds and
+    an abort wakeup over the real loopback transport, shim on — every
+    report is baselined or nonexistent."""
+    active = _run_inline_under_shim(RING_HARNESS, "ring", tmp_path)
+    assert not active, "\n".join(f["message"] for f in active)
+
+
+STALL_HARNESS = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+
+hvd.init()
+def per_rank():
+    hvd.allreduce(jnp.ones((64,)), op=hvd.Sum, name="race.stall")
+    hvd.allgather(jnp.ones((8,)), name="race.gather")
+basics.run_parallel(per_rank)
+import time; time.sleep(1.5)   # let the stall inspector run cycles
+basics.run_parallel(per_rank)
+hvd.shutdown()
+print("STALL-OK")
+"""
+
+
+def test_stall_path_clean_under_shim(tmp_path):
+    """The test_stall harness path: the python controller's cycle loop
+    + stall inspector under the shim."""
+    env_body = (
+        "import os\n"
+        "os.environ['HVD_CONTROLLER'] = 'python'\n"
+        "os.environ['HVD_STALL_CHECK_TIME_SECONDS'] = '1'\n"
+        + STALL_HARNESS)
+    active = _run_inline_under_shim(env_body, "stall", tmp_path)
+    assert not active, "\n".join(f["message"] for f in active)
+
+
+FT_WORKER = r"""
+import os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+r = hvd.rank()
+t = jnp.ones((70000,)) * (r + 1)
+start = time.monotonic()
+try:
+    hvd.allreduce(t, op=hvd.Sum, name="race.ft")
+    print(f"rank {r} COMPLETED", flush=True)
+except hvd.HvdAbortedError as exc:
+    print(f"rank {r} ABORTED origin={exc.origin_rank}", flush=True)
+"""
+
+
+def test_fault_harness_clean_under_shim_and_origin_deterministic(
+        tmp_path):
+    """The fault-injection harness path under the shim: a mid-ring
+    crash at rank 1.  Two assertions ride one spawn: (1) zero
+    non-baselined race reports from the surviving rank (the crashed
+    rank os._exit()s, so it writes none, by design); (2) the abort
+    origin is ALWAYS the dead rank — liveness detection and the
+    survivor's own failed sends now name the same origin
+    (RingSendError carries the proven-dead peer), so culprit naming
+    no longer depends on which detector fires first under load."""
+    results = spawn_tcp_ranks(2, FT_WORKER, extra_env={
+        "JAX_PLATFORMS": "cpu",
+        "HVD_TPU_RACE": "1",
+        "HVD_TPU_RACE_SEED": "3",
+        "HVD_TPU_RACE_REPORT": str(tmp_path / "ft"),
+        "HVD_TPU_HEARTBEAT_INTERVAL": "0.25",
+        "HVD_TPU_ABORT_TIMEOUT": "10",
+        "HVD_TPU_LIVENESS_TIMEOUT": "2",
+        "HVD_STALL_CHECK_TIME_SECONDS": "1",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "30",
+        "HVD_TCP_RING_THRESHOLD": "1024",
+        "HVD_TPU_FAULT_SPEC": "rank1:ring:1:crash",
+    }, timeout=240)
+    code0, out0, err0 = results[0]
+    code1, out1, _ = results[1]
+    assert code1 == 1, f"crashed rank: {out1}"
+    assert code0 == 0, f"survivor: {out0}\n{err0}"
+    assert "rank 0 ABORTED origin=1" in out0, out0
+    active = _nonbaselined(str(tmp_path / "ft.*.json"))
+    assert not active, "\n".join(f["message"] for f in active)
+
+
+# ------------------------------------------------------------- baseline --
+def test_baseline_is_small_and_justified():
+    with open(BASELINE) as f:
+        data = json.load(f)
+    entries = data.get("suppressions", [])
+    assert len(entries) <= 10, (
+        f"{len(entries)} baselined race suppressions — the budget is "
+        f"10; fix races (or annotate deliberate lock-free reads at the "
+        f"site) instead of baselining them")
+    for entry in entries:
+        just = entry.get("justification", "")
+        assert just and "TODO" not in just, (
+            f"baseline entry {entry.get('key')!r} lacks a real "
+            f"justification")
+
+
+def test_write_baseline_roundtrip(tmp_path):
+    """hvd-race shares hvd-lint's baseline machinery: --write-baseline
+    captures this run's findings and preserves prior justifications."""
+    base = tmp_path / "race-base.json"
+    base.write_text(json.dumps({"suppressions": [
+        {"key": "race:tests/race_fixtures/bad_unlocked_counter.py:"
+                "Counter:count:write-write",
+         "justification": "fixture"}]}))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, HVD_RACE, "--seed", "7", "--baseline",
+         str(base), "--write-baseline",
+         os.path.join(FIXTURES, "bad_unlocked_counter.py")],
+        env=env, capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    reloaded = findings_mod.load_baseline(str(base))
+    key = ("race:tests/race_fixtures/bad_unlocked_counter.py:"
+           "Counter:count:write-write")
+    assert reloaded[key] == "fixture"           # justification survives
+    assert any(k != key for k in reloaded)      # new finding captured
+
+
+def test_write_baseline_refuses_partial_run(tmp_path):
+    """A target that crashes observed only a prefix of the findings:
+    regenerating the baseline from it would silently prune every
+    justified suppression the crash prevented re-observing — the CLI
+    must refuse (exit 3) and leave the baseline untouched."""
+    target = tmp_path / "crasher.py"
+    target.write_text("def main():\n    raise RuntimeError('boom')\n")
+    base = tmp_path / "race-base.json"
+    original = json.dumps({"suppressions": [
+        {"key": "race:x.py:C:attr:write-write",
+         "justification": "justified elsewhere"}]})
+    base.write_text(original)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, HVD_RACE, "--baseline", str(base),
+         "--write-baseline", str(target)],
+        env=env, capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert out.returncode == 3, out.stdout + out.stderr
+    assert "baseline NOT rewritten" in out.stderr
+    assert base.read_text() == original
