@@ -38,6 +38,13 @@ class _GlobalState:
         self.executor = executor
         self.controller = controller
         self.timeline = timeline
+        # Elastic membership (docs/elastic.md): ``worker_id`` is this
+        # process's STABLE identity — the launcher-assigned initial rank,
+        # never rewritten by reconfiguration (fault-injection determinism
+        # and log attribution key off it).  ``rank`` is merely this
+        # worker id's current position in the membership list.
+        self.worker_id = topology.rank if topology.mode == "process" else 0
+        self.epoch = 0
 
 
 def init(comm=None, controller=None):
@@ -179,6 +186,99 @@ def shutdown():
         _state.controller.shutdown()
         _state.timeline.close()
         _state = None
+
+
+def worker_id() -> int:
+    """This process's stable elastic identity (the launcher-assigned
+    initial rank; unchanged by reconfiguration)."""
+    return _get_state().worker_id
+
+
+def _elastic_reinit(epoch, members):
+    """Move this surviving process to a new membership epoch
+    (docs/elastic.md): tear down the current-generation controller (no
+    job-end barriers — the job is not ending), re-key rank/size from
+    this worker's position in the new membership, and gang-start a
+    fresh TcpController under the epoch's rendezvous scopes — which
+    rebuilds the ring topology and stripe connections from scratch."""
+    global _state
+    import dataclasses
+
+    with _state_lock:
+        state = _get_state()
+        wid = state.worker_id
+        if wid not in members:
+            raise ValueError(
+                f"worker {wid} is not part of membership {members}")
+        if epoch <= state.epoch:
+            return  # stale directive: this process already moved on
+        try:
+            state.controller.close_for_reconfig()
+        except Exception:  # noqa: BLE001 — tearing down a dead world
+            get_logger().debug("reconfig teardown error", exc_info=True)
+        new_rank = members.index(wid)
+        new_size = len(members)
+        # the global and local axes are re-keyed densely; the cross axis
+        # keeps its launch value (single-host elastic — see docs)
+        topology = dataclasses.replace(
+            state.topology, rank=new_rank, size=new_size,
+            local_rank=new_rank, local_size=new_size)
+        from horovod_tpu.ops.tcp_controller import TcpController
+        impl = TcpController(topology, state.executor, state.timeline,
+                             state.config, epoch=epoch,
+                             members=list(members))
+        impl.start()
+        state.topology = topology
+        state.controller = impl
+        state.epoch = epoch
+        get_logger().warning(
+            "elastic: worker %d re-formed at epoch %d as rank %d/%d",
+            wid, epoch, new_rank, new_size)
+
+
+def _elastic_join_init(epoch, members):
+    """Initialize a late-joining worker directly at an admitted
+    membership epoch (it never belonged to epoch 0; a plain ``init()``
+    would gang-start against the dead world's rendezvous scope)."""
+    global _state
+    with _state_lock:
+        if _state is not None:
+            raise RuntimeError(
+                "horovod_tpu is already initialized; joiners call "
+                "hvd.elastic.wait_for_membership() INSTEAD of hvd.init()")
+        import jax
+
+        config = Config.from_env()
+        config.controller = "tcp"
+        from horovod_tpu.common import faults
+        wid = env_util.get_int(env_util.HVD_RANK, 0)
+        faults.configure(config.fault_spec, rank=wid)
+        new_rank = members.index(wid)
+        topology = topology_mod.Topology(
+            rank=new_rank, size=len(members),
+            local_rank=new_rank, local_size=len(members),
+            cross_rank=0, cross_size=1, mode="process")
+        devices = jax.local_devices()
+        from horovod_tpu.ops.xla_executor import XlaExecutor
+        executor = XlaExecutor(devices)
+        executor.hierarchical_allreduce = config.hierarchical_allreduce
+        executor.hierarchical_allgather = config.hierarchical_allgather
+        executor.adasum_hierarchical = config.adasum_hierarchical
+        path = config.timeline_path
+        if path:
+            path = f"{path}.rank{wid}"
+        timeline = Timeline(path, config.timeline_mark_cycles)
+        from horovod_tpu.ops.tcp_controller import TcpController
+        impl = TcpController(topology, executor, timeline, config,
+                             epoch=epoch, members=list(members))
+        impl.start()
+        _state = _GlobalState(topology, devices, config, executor, impl,
+                              timeline)
+        _state.worker_id = wid
+        _state.epoch = epoch
+        get_logger().warning(
+            "elastic: worker %d joined at epoch %d as rank %d/%d",
+            wid, epoch, new_rank, len(members))
 
 
 def is_initialized() -> bool:

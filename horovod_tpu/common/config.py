@@ -50,6 +50,14 @@ class Config:
     liveness_timeout_seconds: float = \
         env_util.DEFAULT_LIVENESS_TIMEOUT_SECONDS
     fault_spec: str | None = None
+    # Elastic membership (docs/elastic.md): survive rank loss by
+    # reconfiguring instead of raising; bounds on the reconfiguration
+    # window and on how small/large membership may become.
+    elastic: bool = False
+    reconfig_timeout_seconds: float = \
+        env_util.DEFAULT_RECONFIG_TIMEOUT_SECONDS
+    min_ranks: int = env_util.DEFAULT_MIN_RANKS
+    max_ranks: int = env_util.DEFAULT_MAX_RANKS
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -107,6 +115,16 @@ class Config:
                 env_util.DEFAULT_LIVENESS_TIMEOUT_SECONDS),
             fault_spec=_validated_fault_spec(env_util.get_str(
                 env_util.HVD_TPU_FAULT_SPEC)),
+            elastic=env_util.get_bool(env_util.HVD_TPU_ELASTIC),
+            reconfig_timeout_seconds=env_util.get_float(
+                env_util.HVD_TPU_RECONFIG_TIMEOUT,
+                env_util.DEFAULT_RECONFIG_TIMEOUT_SECONDS),
+            min_ranks=max(1, env_util.get_int(
+                env_util.HVD_TPU_MIN_RANKS,
+                env_util.DEFAULT_MIN_RANKS)),
+            max_ranks=_validated_nonneg(
+                env_util.HVD_TPU_MAX_RANKS,
+                env_util.DEFAULT_MAX_RANKS),
         )
 
 

@@ -6,6 +6,7 @@ The reference exposes integer handles managed by a poll/wait map
 API fidelity.
 """
 
+import json
 import threading
 
 
@@ -28,6 +29,53 @@ class HvdAbortedError(HvdError):
             f"{reason}")
         self.origin_rank = origin_rank
         self.reason = reason
+
+
+class HvdReconfigureError(HvdAbortedError):
+    """An abort carrying an elastic membership directive (the coordinator
+    decided the job can survive the failure).  Subclasses
+    :class:`HvdAbortedError` so every existing ``except HvdAbortedError``
+    site — and the non-elastic contract — is untouched; ``hvd.elastic.run``
+    catches this subtype, reconfigures, and retries the step instead of
+    letting the job die."""
+
+    def __init__(self, origin_rank, reason, *, epoch, members, dead,
+                 cause=""):
+        super().__init__(origin_rank, reason)
+        self.epoch = epoch          # new membership epoch to move to
+        self.members = list(members)  # stable worker ids, new-rank order
+        self.dead = list(dead)      # worker ids removed this epoch
+        self.cause = cause          # the original (pre-rewrite) reason
+
+
+# Elastic reconfiguration directives ride the existing abort fan-out
+# (peer pushes, heartbeat replies, negotiation responses) as a marked
+# reason string, so no wire message gains a new field for delivery.
+RECONFIG_MARKER = "__hvd_elastic_reconfig__:"
+
+
+def encode_reconfig_reason(epoch, members, dead, cause):
+    """Serialize a membership directive into an abort ``reason``."""
+    return RECONFIG_MARKER + json.dumps(
+        {"epoch": epoch, "members": list(members), "dead": list(dead),
+         "cause": str(cause)})
+
+
+def make_abort_error(origin_rank, reason):
+    """Build the right typed error for a learned ``(origin, reason)``
+    abort: a plain :class:`HvdAbortedError`, or the
+    :class:`HvdReconfigureError` subtype when the reason carries an
+    elastic membership directive."""
+    if isinstance(reason, str) and reason.startswith(RECONFIG_MARKER):
+        try:
+            d = json.loads(reason[len(RECONFIG_MARKER):])
+            return HvdReconfigureError(
+                origin_rank, reason, epoch=d["epoch"],
+                members=d["members"], dead=d.get("dead", ()),
+                cause=d.get("cause", ""))
+        except (ValueError, KeyError, TypeError):
+            pass  # malformed directive degrades to a plain abort
+    return HvdAbortedError(origin_rank, reason)
 
 
 class Handle:

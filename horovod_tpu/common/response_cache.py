@@ -63,5 +63,12 @@ class SignatureCache:
     def evict(self, name):
         self._entries.pop(name, None)
 
+    def clear(self):
+        """Drop every cached signature.  Called on coordinated abort: a
+        signature validated before the abort must never short-circuit
+        validation for a post-reconfiguration membership (same tensor
+        name, different world)."""
+        self._entries.clear()
+
     def __len__(self):
         return len(self._entries)

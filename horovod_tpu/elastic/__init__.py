@@ -1,0 +1,133 @@
+"""Elastic membership: survive rank loss, re-form the ring, converge
+(docs/elastic.md).
+
+Reference: Elastic Horovod (``horovod/run/elastic/``, Sergeev & Del
+Balso 1802.05799 follow-up) — here layered on the fault-tolerant TCP
+runtime's coordinated abort: with ``HVD_TPU_ELASTIC=1`` the coordinator
+rewrites a survivable failure into a membership-reconfiguration
+directive (a marked abort reason carried by the existing fan-out), and
+:func:`run` catches the resulting :class:`HvdReconfigureError`,
+re-forms the world at the next epoch, restores committed state, and
+retries the step.
+
+Surface::
+
+    state = hvd.elastic.State(params=params, optimizer_state=opt)
+    hvd.elastic.run(train_fn, state)      # incumbents (after hvd.init())
+
+    hvd.elastic.wait_for_membership()     # late joiner (INSTEAD of init)
+    hvd.elastic.run(train_fn, state)
+"""
+
+import time
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.handles import (HvdAbortedError,
+                                        HvdReconfigureError)
+from horovod_tpu.elastic.membership import (ELASTIC_SCOPE, JOIN_SCOPE,
+                                            MEMBERSHIP_KEY,
+                                            ElasticContext,
+                                            decode_membership)
+from horovod_tpu.elastic.state import State
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils.logging import get_logger
+
+__all__ = ["State", "run", "reconfigure", "wait_for_membership",
+           "worker_id", "HvdReconfigureError", "ElasticContext"]
+
+
+def worker_id() -> int:
+    """This process's stable elastic identity: the launcher-assigned
+    initial rank, unchanged by reconfiguration (``hvd.rank()`` is
+    re-keyed at every membership epoch; this never is)."""
+    return basics.worker_id()
+
+
+def reconfigure(exc: HvdReconfigureError):
+    """Apply a received reconfiguration directive: survivors re-form at
+    the directive's epoch; a worker voted out of the membership raises
+    the underlying abort instead."""
+    wid = basics.worker_id()
+    if wid not in exc.members:
+        raise HvdAbortedError(
+            exc.origin_rank,
+            f"worker {wid} evicted from elastic membership at epoch "
+            f"{exc.epoch} ({exc.cause})") from exc
+    basics._elastic_reinit(exc.epoch, exc.members)
+
+
+def run(fn, state, *args, **kwargs):
+    """Drive ``fn(state, *args, **kwargs)`` elastically: sync state to
+    every member first, then on each reconfiguration signal re-form the
+    world, roll back to the last commit, re-sync, and retry ``fn``.
+    Any other error (including a fatal ``HvdAbortedError``) propagates
+    unchanged — elastic never swallows a non-survivable failure."""
+    log = get_logger()
+    pending_sync = True
+    while True:
+        try:
+            if pending_sync:
+                state.sync()
+                pending_sync = False
+            return fn(state, *args, **kwargs)
+        except HvdReconfigureError as exc:
+            log.warning(
+                "elastic: reconfiguration signal at step %s (epoch %d, "
+                "members %s); re-forming", getattr(state, "step", "?"),
+                exc.epoch, exc.members)
+            reconfigure(exc)
+            state.restore()
+            pending_sync = True
+
+
+def _rendezvous_contract():
+    addr = env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR)
+    port = env_util.get_str(env_util.HVD_RENDEZVOUS_PORT)
+    if addr is None or port is None:
+        raise RuntimeError(
+            "elastic join requires the rendezvous env contract "
+            "(HVD_RENDEZVOUS_ADDR/PORT — launch with hvdrun)")
+    return addr, int(port)
+
+
+def wait_for_membership(timeout=None, poll_interval=0.25):
+    """Late-joiner entry point, called INSTEAD of ``hvd.init()``:
+    register this worker's id with the rendezvous server, poll the
+    published membership until an epoch admits it, then initialize the
+    runtime directly at that epoch (catching up state is ``run``'s
+    first sync).  Admission only happens at a reconfiguration window —
+    a healthy job never readmits mid-flight."""
+    from horovod_tpu.run import http_client
+
+    addr, port = _rendezvous_contract()
+    wid = env_util.get_int(env_util.HVD_RANK, 0)
+    if timeout is None:
+        timeout = env_util.get_float(
+            env_util.HVD_TPU_RECONFIG_TIMEOUT,
+            env_util.DEFAULT_RECONFIG_TIMEOUT_SECONDS)
+    http_client.put(addr, port, JOIN_SCOPE, str(wid), b"1")
+    log = get_logger()
+    log.info("elastic: worker %d registered, waiting for admission",
+             wid)
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(
+                f"worker {wid} was not admitted to any membership "
+                f"within {timeout:g}s")
+        try:
+            blob = http_client.get(addr, port, ELASTIC_SCOPE,
+                                   MEMBERSHIP_KEY, timeout=remaining)
+        except KeyError:
+            raise TimeoutError(
+                f"worker {wid} saw no reconfiguration window within "
+                f"{timeout:g}s")
+        epoch, members = decode_membership(blob)
+        if wid in members:
+            basics._elastic_join_init(epoch, members)
+            return epoch
+        # published membership predates our registration: wait for the
+        # next window (sleep-poll; there is nothing to wake on — the
+        # membership blob only changes at a reconfiguration)
+        time.sleep(poll_interval)

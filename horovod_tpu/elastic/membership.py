@@ -1,0 +1,143 @@
+"""Elastic membership planning (coordinator side) and the rendezvous
+contract joiners use to register (docs/elastic.md).
+
+The reference's Elastic Horovod (``horovod/run/elastic/driver.py``)
+re-discovers hosts and rebuilds the worker set when a slot is lost;
+here membership is a list of **stable worker ids** (the launcher-
+assigned initial ranks) in new-rank order, and the decision point is
+the coordinator's ``_initiate_abort``: an attached :class:`ElasticContext`
+rewrites a survivable failure into a reconfiguration directive that the
+EXISTING abort fan-out (peer pushes, heartbeat replies, negotiation
+responses) delivers to every survivor.
+"""
+
+import json
+import threading
+
+from horovod_tpu.common.handles import encode_reconfig_reason
+from horovod_tpu.utils.logging import get_logger
+
+# rendezvous scopes of the elastic contract (shared with joiners):
+# the coordinator publishes admitted membership under
+# ``elastic/membership``; a candidate joiner registers its worker id as
+# a key in ``elastic-join`` and polls the membership blob until admitted
+ELASTIC_SCOPE = "elastic"
+MEMBERSHIP_KEY = "membership"
+JOIN_SCOPE = "elastic-join"
+
+# an explicit ``hvd.abort()`` is a kill switch, never rescued
+# (common/basics.py uses this default reason prefix)
+USER_ABORT_PREFIX = "aborted by user"
+
+
+def encode_membership(epoch, members) -> bytes:
+    return json.dumps({"epoch": epoch,
+                       "members": list(members)}).encode()
+
+
+def decode_membership(blob):
+    d = json.loads(blob.decode())
+    return int(d["epoch"]), [int(m) for m in d["members"]]
+
+
+class ElasticContext:
+    """Rank 0's membership planner, attached to the CoordinatorService.
+
+    ``plan(origin_rank, reason)`` decides whether a failure is
+    survivable and, if so, returns the encoded reconfiguration
+    directive (the rewritten abort reason).  Sticky: the first plan
+    wins, racing aborts read the cached directive — mirroring the
+    coordinator's own sticky abort flag.
+    """
+
+    def __init__(self, members, epoch, min_ranks=1, max_ranks=0,
+                 rendezvous=None):
+        self._members = list(members)   # worker ids, current-rank order
+        self._epoch = epoch
+        self._min_ranks = min_ranks
+        self._max_ranks = max_ranks
+        self._rendezvous = rendezvous   # (addr, port) | None
+        self._lock = threading.Lock()
+        # encoded directive once planned (None: fatal); sticky once
+        # ``_decided`` is set; guarded by self._lock
+        self._planned = None
+        self._decided = False
+        self._log = get_logger()
+
+    def plan(self, origin_rank, reason):
+        with self._lock:
+            if not self._decided:
+                self._planned = self._plan_locked(origin_rank, reason)
+                self._decided = True
+            return self._planned
+
+    def _plan_locked(self, origin_rank, reason):  # holds: self._lock
+        if isinstance(reason, str) \
+                and reason.startswith(USER_ABORT_PREFIX):
+            return None  # explicit kill switch: never rescued
+        if not (0 <= origin_rank < len(self._members)):
+            return None  # can't attribute the loss to a member
+        if origin_rank == 0:
+            # rank 0 hosts the coordinator itself: the component that
+            # would orchestrate the rescue is the casualty
+            return None
+        dead_wid = self._members[origin_rank]
+        survivors = [w for w in self._members if w != dead_wid]
+        if len(survivors) < self._min_ranks:
+            self._log.error(
+                "elastic: %d survivors < --min-ranks %d; failure of "
+                "worker %d is fatal", len(survivors), self._min_ranks,
+                dead_wid)
+            return None
+        joiners = self._registered_joiners(
+            exclude=set(survivors) | {dead_wid})
+        if self._max_ranks > 0:
+            joiners = joiners[:max(0,
+                                   self._max_ranks - len(survivors))]
+        new_members = survivors + joiners
+        new_epoch = self._epoch + 1
+        self._publish(new_epoch, new_members)
+        self._log.warning(
+            "elastic: worker %d lost (%s); reconfiguring to epoch %d "
+            "with members %s", dead_wid, reason, new_epoch, new_members)
+        return encode_reconfig_reason(new_epoch, new_members,
+                                      [dead_wid], reason)
+
+    def _registered_joiners(self, exclude):
+        """Worker ids registered under the join scope, admission order
+        = sorted (deterministic across racing registrations)."""
+        if self._rendezvous is None:
+            return []
+        from horovod_tpu.run import http_client
+        addr, port = self._rendezvous
+        try:
+            names = http_client.list_keys(addr, port, JOIN_SCOPE,
+                                          retry_for=2.0)
+        except Exception:  # noqa: BLE001 — no joiners this window
+            return []
+        out = []
+        for name in names:
+            try:
+                wid = int(name)
+            except ValueError:
+                continue
+            if wid not in exclude:
+                out.append(wid)
+        return sorted(out)
+
+    def _publish(self, epoch, members):
+        """Advertise the admitted membership for polling joiners.  A
+        publish failure only costs this window's admissions — survivors
+        get the directive via the abort fan-out regardless."""
+        if self._rendezvous is None:
+            return
+        from horovod_tpu.run import http_client
+        addr, port = self._rendezvous
+        try:
+            http_client.put(addr, port, ELASTIC_SCOPE, MEMBERSHIP_KEY,
+                            encode_membership(epoch, members),
+                            retry_for=5.0)
+        except Exception:  # noqa: BLE001 — see docstring
+            self._log.warning(
+                "elastic: could not publish membership for epoch %d",
+                epoch, exc_info=True)

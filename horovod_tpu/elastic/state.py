@@ -1,0 +1,83 @@
+"""User-facing elastic training state (docs/elastic.md).
+
+Reference: ``horovod/common/elastic.py`` — ``State`` with
+``commit``/``restore``/``sync`` driven by ``elastic.run``.  Here the
+state holds a params pytree, an optional optimizer-state pytree, and
+integer counters; ``sync`` replays everything from the designated
+survivor (new rank 0) over the existing broadcast path using
+DETERMINISTIC tensor names (the eager auto-name counters diverge
+between incumbents and late joiners, so sync must never rely on them).
+"""
+
+import numpy as np
+
+
+def _tree_copy(tree):
+    """Deep value copy of a pytree of arrays (jax arrays land as numpy:
+    a committed snapshot must be immune to later in-place updates AND
+    to device-buffer invalidation across a controller rebuild)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.array(x), tree)
+
+
+class State:
+    """Training state that survives membership reconfiguration.
+
+    - ``commit()`` snapshots (params, optimizer_state, counters); call
+      it at step boundaries you are willing to roll back to.
+    - ``restore()`` rolls back to the last commit — the ``run`` driver
+      calls it after a reconfiguration, because the interrupted step
+      may have partially applied on some survivors.
+    - ``sync(root_rank=0)`` replays the state from ``root_rank`` to
+      every member (incumbents AND admitted joiners) over broadcast.
+    """
+
+    def __init__(self, params=None, optimizer_state=None, step=0,
+                 epoch=0):
+        self.params = params
+        self.optimizer_state = optimizer_state
+        self.step = int(step)
+        self.epoch = int(epoch)   # user-level epoch counter, NOT the
+        # membership epoch (that lives on the runtime)
+        self._committed = None
+        self.commit()
+
+    def commit(self):
+        self._committed = (_tree_copy(self.params),
+                           _tree_copy(self.optimizer_state),
+                           self.step, self.epoch)
+
+    def restore(self):
+        params, opt, step, epoch = self._committed
+        self.params = _tree_copy(params)
+        self.optimizer_state = _tree_copy(opt)
+        self.step = step
+        self.epoch = epoch
+
+    def sync(self, root_rank=0):
+        """Broadcast the designated survivor's committed view to every
+        member.  Names are deterministic (tree-order indices under a
+        fixed prefix), so a joiner that never issued the incumbents'
+        earlier collectives still pairs correctly."""
+        from horovod_tpu import jax_api
+        from horovod_tpu.common import objects
+
+        if self.params is not None:
+            self.params = jax_api.broadcast_parameters(
+                self.params, root_rank=root_rank,
+                name_prefix="elastic.sync.params")
+        if self.optimizer_state is not None:
+            self.optimizer_state = jax_api.broadcast_parameters(
+                self.optimizer_state, root_rank=root_rank,
+                name_prefix="elastic.sync.opt")
+        self.step, self.epoch = objects.broadcast_object(
+            (self.step, self.epoch), root_rank=root_rank,
+            name="elastic.sync.counters")
+        self.commit()
+
+    def __repr__(self):
+        return (f"State(step={self.step}, epoch={self.epoch}, "
+                f"params={'set' if self.params is not None else 'None'}, "
+                f"optimizer_state="
+                f"{'set' if self.optimizer_state is not None else 'None'})")

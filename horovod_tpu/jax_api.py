@@ -242,13 +242,19 @@ def sharded_state_unwrap(state):
     return jax.tree.map(lambda a: a[0], state)
 
 
-def broadcast_parameters(params, root_rank=0):
+def broadcast_parameters(params, root_rank=0, name_prefix=None):
     """Broadcast a parameter pytree from ``root_rank`` to all ranks via the
     eager collective path (reference: ``horovod/torch/__init__.py:452``).
 
     In single-controller SPMD mode parameters are already consistent; this is
     the eager-mode / process-mode synchronization primitive, used after
     checkpoint restore or at train start.
+
+    ``name_prefix`` overrides the default tensor-name prefix.  Elastic
+    state sync uses it to keep replay rounds in their own namespace:
+    names here are DETERMINISTIC (tree-order indices), never the eager
+    auto-name counters — a late joiner that skipped the incumbents'
+    earlier collectives must still pair leaf-for-leaf.
     """
     from horovod_tpu.common import basics
     from horovod_tpu.ops import eager
@@ -262,10 +268,11 @@ def broadcast_parameters(params, root_rank=0):
         if getattr(basics._tls, "local_rank", None) is None:
             return params
 
+    prefix = name_prefix or "broadcast.parameters"
     leaves, treedef = jax.tree.flatten(params)
     handles = [
         eager.broadcast_async(leaf, root_rank,
-                              name=f"broadcast.parameters.{i}")
+                              name=f"{prefix}.{i}")
         for i, leaf in enumerate(leaves)
     ]
     # drain EVERY handle before raising: abandoning the rest mid-pytree
@@ -286,7 +293,8 @@ def broadcast_parameters(params, root_rank=0):
     return jax.tree.unflatten(treedef, results)
 
 
-def broadcast_optimizer_state(opt_state, root_rank=0):
+def broadcast_optimizer_state(opt_state, root_rank=0, name_prefix=None):
     """Broadcast optimizer state from ``root_rank`` (reference:
     ``horovod/torch/__init__.py:484`` broadcast_optimizer_state)."""
-    return broadcast_parameters(opt_state, root_rank=root_rank)
+    return broadcast_parameters(opt_state, root_rank=root_rank,
+                                name_prefix=name_prefix)

@@ -243,6 +243,9 @@ class PythonController:
             join_handles = dict(self._join_handles)
             self._join_handles.clear()
             self._joined.clear()
+            # a signature validated before the abort must not satisfy a
+            # post-abort (or post-reconfiguration) round of the same name
+            self._sig_cache.clear()
         for request in queued:
             request.handle.set_error(exc)
         for handle in join_handles.values():

@@ -42,7 +42,8 @@ import time
 import numpy as np
 
 from horovod_tpu.common import faults
-from horovod_tpu.common.handles import HvdAbortedError, HvdError
+from horovod_tpu.common.handles import (HvdAbortedError, HvdError,
+                                        make_abort_error)
 from horovod_tpu.common.ops_enum import (ReduceOp, RequestType,
                                          is_float_dtype)
 from horovod_tpu.common.response_cache import SignatureCache
@@ -63,8 +64,9 @@ TIMELINE_SCOPE = "timeline"
 class CollectiveMsg:
     def __init__(self, name, rank, req_type, op, payload, shape, dtype,
                  root_rank=-1, splits=None, prescale=1.0, postscale=1.0,
-                 ring=False, sig=None, compression="none"):
+                 ring=False, sig=None, compression="none", epoch=0):
         self.name = name
+        self.epoch = epoch              # sender's membership epoch
         self.rank = rank
         self.req_type = int(req_type)
         self.op = int(op)
@@ -181,8 +183,17 @@ class CoordinatorService(network.MuxService):
 
     def __init__(self, size, key, stall_warning_sec=60.0,
                  stall_shutdown_sec=0.0, cache_capacity=1024,
-                 autotune=None, liveness_timeout_sec=0.0):
+                 autotune=None, liveness_timeout_sec=0.0, epoch=0,
+                 elastic=None):
         self._size = size
+        # membership epoch this coordinator serves; a CollectiveMsg
+        # stamped with a different epoch is refused (stale negotiation
+        # from a torn-down membership must not form entries here)
+        self._epoch = epoch
+        # ElasticContext (rank 0, HVD_TPU_ELASTIC=1) or None: consulted
+        # by _initiate_abort to rewrite a survivable failure into a
+        # reconfiguration directive instead of a fatal abort
+        self._elastic = elastic
         self._stall_warning = stall_warning_sec
         self._stall_shutdown = stall_shutdown_sec
         self._liveness = liveness_timeout_sec
@@ -250,11 +261,28 @@ class CoordinatorService(network.MuxService):
         shutdown path, promoted from a log line into action): fail every
         negotiating rank NOW with one typed, symmetric error; ranks not
         currently negotiating learn the abort from their next heartbeat
-        reply.  Sticky — the surviving ranks are expected to unwind."""
+        reply.  Sticky — the surviving ranks are expected to unwind.
+
+        With an ElasticContext attached, a survivable failure is
+        rewritten into a membership-reconfiguration directive BEFORE the
+        sticky flag is set: the same fan-out then delivers "re-form at
+        epoch N+1" instead of "die" (docs/elastic.md)."""
+        # plan() runs outside the lock (it talks to the rendezvous
+        # server); idempotence is re-checked under the lock, and the
+        # plan itself is sticky, so a racing second abort just reads
+        # the cached directive
+        if self._elastic is not None and self._abort is None:  # hvd-lint: ignore[lock-discipline]
+            planned = self._elastic.plan(origin_rank, reason)
+            if planned is not None:
+                reason = planned
         with self._cv:
             if self._abort is not None:
                 return
             self._abort = (origin_rank, reason)
+            # satellite bugfix: a signature validated pre-abort must not
+            # short-circuit validation after a reconfiguration reuses
+            # the same tensor names with a different membership
+            self._sig_cache.clear()
             forming, self._forming = self._forming, {}
             waiters, self._join_waiters = self._join_waiters, []
             self._joined.clear()
@@ -293,6 +321,13 @@ class CoordinatorService(network.MuxService):
         return live <= entry.requests.keys()
 
     def _handle_collective(self, req):
+        if getattr(req, "epoch", 0) != self._epoch:
+            # stale membership epoch: a straggler negotiation from a
+            # torn-down world must not form entries at this coordinator
+            return ResultMsg(error=(
+                f"stale membership epoch {getattr(req, 'epoch', 0)} for "
+                f"tensor '{req.name}' (coordinator is at epoch "
+                f"{self._epoch})"))
         with self._cv:
             if self._abort is not None:
                 return self._abort_result()
@@ -324,19 +359,24 @@ class CoordinatorService(network.MuxService):
                         del self._forming[req.name]
                 return self._abort_result()
             age = time.monotonic() - entry.first_ts
+            # hvd-race: ok[racy fast-path check only; warn-once is
+            # decided by the re-check under the lock below]
             if age > self._stall_warning and not entry.stall_warned:
                 with self._cv:
+                    already, entry.stall_warned = entry.stall_warned, \
+                        True
                     missing = [r for r in range(self._size)
                                if r not in entry.requests
                                and r not in self._joined]
                     ready = sorted(entry.requests)
-                    entry.stall_warned = True
-                    # reference: InvalidateStalledCachedTensors
-                    self._sig_cache.evict(req.name)
-                self._log.warning(
-                    "Stalled tensor: %s ready ranks: %s, waiting on: %s "
-                    "for more than %ds", req.name, ready, missing,
-                    int(self._stall_warning))
+                    if not already:
+                        # reference: InvalidateStalledCachedTensors
+                        self._sig_cache.evict(req.name)
+                if not already:
+                    self._log.warning(
+                        "Stalled tensor: %s ready ranks: %s, waiting "
+                        "on: %s for more than %ds", req.name, ready,
+                        missing, int(self._stall_warning))
             if deadline is not None and time.monotonic() > deadline:
                 # stall shutdown, promoted into a coordinated abort: the
                 # first missing rank is the culprit, EVERY rank (not just
@@ -678,13 +718,22 @@ class TcpController:
     """Per-process controller facade (same interface as the in-process
     controllers: enqueue / join / start / shutdown)."""
 
-    def __init__(self, topology, executor, timeline, config):
+    def __init__(self, topology, executor, timeline, config, epoch=0,
+                 members=None):
         self._topo = topology
         self._executor = executor
         self._timeline = timeline
         self._config = config
         self._rank = topology.rank
         self._size = topology.size
+        # elastic membership (docs/elastic.md): the epoch names this
+        # controller's generation of the world; rendezvous scopes are
+        # suffixed with it so a re-formed job can never read the old
+        # world's addresses.  ``members`` lists the stable worker ids in
+        # new-rank order (None: pre-elastic identity mapping).
+        self._epoch = epoch
+        self._members = (list(members) if members is not None
+                         else list(range(self._size)))
         self._coordinator = None
         self._client_addrs = None
         self._mux = None            # guarded by self._mux_lock
@@ -707,6 +756,21 @@ class TcpController:
         self._hb_thread = None
         self._log = get_logger()
 
+    def _scope(self, base):
+        """Rendezvous scope for this membership epoch.  Epoch 0 keeps
+        the bare names (wire/rendezvous compatibility with every
+        pre-elastic artifact); later epochs get a fresh namespace so
+        survivors re-forming the job can never read the dead world's
+        addresses."""
+        return base if self._epoch == 0 else f"{base}.e{self._epoch}"
+
+    def _start_timeout(self):
+        # initial gang start keeps its own deadline; a reconfiguration
+        # window is bounded by the (usually tighter) reconfig budget
+        if self._epoch == 0:
+            return env_util.get_float(env_util.HVD_START_TIMEOUT, 120.0)
+        return self._config.reconfig_timeout_seconds
+
     # -------------------------------------------------------------- lifecycle
     def start(self):
         key_b64 = env_util.get_str(env_util.HVD_SECRET_KEY)
@@ -726,20 +790,30 @@ class TcpController:
             from horovod_tpu.ops.autotune import AutotuneManager
             self._autotune = AutotuneManager.create(self._config,
                                                     self._log)
+            elastic_ctx = None
+            if self._config.elastic and addr is not None:
+                from horovod_tpu.elastic.membership import ElasticContext
+                elastic_ctx = ElasticContext(
+                    members=self._members, epoch=self._epoch,
+                    min_ranks=self._config.min_ranks,
+                    max_ranks=self._config.max_ranks,
+                    rendezvous=(addr, int(port)))
             self._coordinator = CoordinatorService(
                 self._size, self._key,
                 stall_warning_sec=self._config.stall_warning_seconds,
                 stall_shutdown_sec=self._config.stall_shutdown_seconds,
                 cache_capacity=self._config.cache_capacity,
                 autotune=self._autotune,
-                liveness_timeout_sec=self._config.liveness_timeout_seconds)
+                liveness_timeout_sec=self._config.liveness_timeout_seconds,
+                epoch=self._epoch, elastic=elastic_ctx)
             tagged = [(iface, ip, self._coordinator.port)
                       for iface, ip in network.local_interfaces().items()]
             tagged.append(("lo", "127.0.0.1", self._coordinator.port))
             if addr is not None:
                 from horovod_tpu.run import http_client
                 http_client.put(
-                    addr, int(port), CONTROLLER_SCOPE, CONTROLLER_KEY,
+                    addr, int(port), self._scope(CONTROLLER_SCOPE),
+                    CONTROLLER_KEY,
                     ";".join(f"{i}={ip}:{p}"
                              for i, ip, p in tagged).encode())
             self._client_addrs = self._filter_ifaces(tagged)
@@ -750,9 +824,8 @@ class TcpController:
                     "contract (launch with hvdrun)")
             from horovod_tpu.run import http_client
             blob = http_client.get(
-                addr, int(port), CONTROLLER_SCOPE, CONTROLLER_KEY,
-                timeout=env_util.get_float(
-                    env_util.HVD_START_TIMEOUT, 120.0)).decode()
+                addr, int(port), self._scope(CONTROLLER_SCOPE),
+                CONTROLLER_KEY, timeout=self._start_timeout()).decode()
             tagged = []
             for part in blob.split(";"):
                 iface, rest = part.split("=", 1)
@@ -760,8 +833,9 @@ class TcpController:
                 tagged.append((iface, ip, int(p)))
             self._client_addrs = self._filter_ifaces(tagged)
 
-        # peer mailbox for the ring data plane
-        self._peer_service = PeerService(self._key)
+        # peer mailbox for the ring data plane (epoch-stamped: stale
+        # chunks from a pre-reconfiguration ring are refused at framing)
+        self._peer_service = PeerService(self._key, epoch=self._epoch)
         # a peer-pushed abort must fail negotiation-blocked handles too,
         # not only blocked ring recvs (no re-fan-out: the pusher
         # already reached every peer)
@@ -771,14 +845,16 @@ class TcpController:
             tagged = [(iface, ip, self._peer_service.port)
                       for iface, ip in network.local_interfaces().items()]
             tagged.append(("lo", "127.0.0.1", self._peer_service.port))
-            http_client.put(addr, int(port), PEERS_SCOPE, str(self._rank),
+            http_client.put(addr, int(port), self._scope(PEERS_SCOPE),
+                            str(self._rank),
                             ";".join(f"{i}={ip}:{p}"
                                      for i, ip, p in tagged).encode())
             self._ring = RingPlane(
                 self._rank, self._peer_service, self._resolve_peer,
                 resolve_bulk=self._resolve_stripe,
                 segment_bytes=self._config.ring_segment_bytes,
-                stripes=self._config.ring_stripes)
+                stripes=self._config.ring_stripes,
+                epoch=self._epoch)
 
         # peer liveness: a background heartbeat per worker keeps the
         # coordinator's last-seen table fresh AND carries the abort
@@ -814,8 +890,8 @@ class TcpController:
         addr = env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR)
         port = env_util.get_str(env_util.HVD_RENDEZVOUS_PORT)
         kwargs = {} if retry_for is None else {"retry_for": retry_for}
-        blob = http_client.get(addr, int(port), PEERS_SCOPE, str(rank),
-                               timeout=resolve_timeout,
+        blob = http_client.get(addr, int(port), self._scope(PEERS_SCOPE),
+                               str(rank), timeout=resolve_timeout,
                                **kwargs).decode()
         tagged = []
         for part in blob.split(";"):
@@ -942,7 +1018,7 @@ class TcpController:
             self._push_abort_to_peers(origin_rank, reason)
         if self._peer_service is not None:
             self._peer_service.abort(origin_rank, reason)
-        exc = HvdAbortedError(origin_rank, reason)
+        exc = make_abort_error(origin_rank, reason)
         for handle in inflight:
             handle.set_error(exc)
 
@@ -1018,7 +1094,7 @@ class TcpController:
             if ab is None:
                 self._inflight[id(request.handle)] = request.handle
         if ab is not None:
-            request.handle.set_error(HvdAbortedError(*ab))
+            request.handle.set_error(make_abort_error(*ab))
             return
         self._spawn(self._run_one, request)
 
@@ -1064,7 +1140,8 @@ class TcpController:
                 root_rank=request.root_rank, splits=request.splits,
                 prescale=request.prescale_factor,
                 postscale=request.postscale_factor, ring=ring,
-                compression=getattr(request, "compression", "none"))
+                compression=getattr(request, "compression", "none"),
+                epoch=self._epoch)
             msg.sig = _signature(msg)
             self._timeline.begin(request.name,
                                  f"NEGOTIATE_{rtype.name}")
@@ -1080,7 +1157,7 @@ class TcpController:
                 # sticky: _local_abort just set it (or an earlier abort
                 # did); set-once means this read cannot tear
                 request.handle.set_error(
-                    HvdAbortedError(*self._abort_state))  # hvd-lint: ignore[lock-discipline]
+                    make_abort_error(*self._abort_state))  # hvd-lint: ignore[lock-discipline]
                 return
             self._timeline.end(request.name)
             self._maybe_apply_params(resp)
@@ -1089,7 +1166,7 @@ class TcpController:
                 # coordinated abort: fail EVERY in-flight handle (this
                 # one included) with the one typed error + purge rings
                 self._learned_abort(*ab)
-                request.handle.set_error(HvdAbortedError(*ab))
+                request.handle.set_error(make_abort_error(*ab))
                 return
             if resp.error is not None:
                 request.handle.set_error(resp.error)
@@ -1229,7 +1306,7 @@ class TcpController:
                 ab = getattr(resp, "abort", None)
                 if ab is not None:
                     self._learned_abort(*ab)
-                    handle.set_error(HvdAbortedError(*ab))
+                    handle.set_error(make_abort_error(*ab))
                     return
                 handle.set_result(resp.last_rank)
             except Exception as exc:  # noqa: BLE001
@@ -1243,7 +1320,7 @@ class TcpController:
             if ab is None:
                 self._inflight[id(handle)] = handle
         if ab is not None:
-            handle.set_error(HvdAbortedError(*ab))
+            handle.set_error(make_abort_error(*ab))
             return
         self._spawn(run)
 
@@ -1301,6 +1378,36 @@ class TcpController:
         from horovod_tpu.ops.autotune import default_params
         return default_params(self._config)
 
+    def close_for_reconfig(self):
+        """Tear down this controller's generation of the world so a
+        successor at the next membership epoch can be built: no
+        ShutdownMsg (the coordinator we would deregister from is part of
+        the dead world), no timeline merge (that is a job-end barrier —
+        the job is NOT ending).  Closing the ring plane and peer
+        service here is what "rebuild ring topology + stripe
+        connections" means: the successor's RingPlane re-resolves every
+        peer through the new epoch's rendezvous scope from scratch."""
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+        with self._mux_lock:
+            mux, self._mux = self._mux, None
+        if mux is not None:
+            mux.close()
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
+        if self._peer_service is not None:
+            self._peer_service.shutdown()
+            self._peer_service = None
+        if self._coordinator is not None:
+            self._coordinator.shutdown()
+            self._coordinator = None
+        if self._autotune is not None:
+            self._autotune.close()
+            self._autotune = None
+
     def shutdown(self):
         self._hb_stop.set()
         if self._hb_thread is not None:
@@ -1339,4 +1446,4 @@ class TcpController:
 
         publish_and_merge(self._rank, self._size,
                           self._config.timeline_path, self._timeline,
-                          scope=TIMELINE_SCOPE)
+                          scope=self._scope(TIMELINE_SCOPE))

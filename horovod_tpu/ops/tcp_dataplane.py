@@ -55,7 +55,7 @@ import threading
 import numpy as np
 
 from horovod_tpu.common import faults
-from horovod_tpu.common.handles import HvdAbortedError
+from horovod_tpu.common.handles import make_abort_error
 from horovod_tpu.common.ops_enum import INT8_BLOCK, is_float_dtype
 from horovod_tpu.run.service import network
 from horovod_tpu.tools.race import hooks as race_hooks
@@ -176,12 +176,23 @@ def _wire_spec(dtype, prescale, widen):
 
 
 class ChunkMsg:
-    __slots__ = ("tag", "src", "payload")
+    # ``epoch`` is the membership epoch the sender's plane belongs to
+    # (docs/elastic.md): the header rides pickled on BOTH frame kinds
+    # (control-connection chunks and raw bulk stripes share the pickled
+    # header in write_bulk_message), so a straggler chunk from a
+    # pre-reconfiguration ring is droppable at the framing layer.
+    # __weakref__ keeps instances weakref-able despite __slots__: the
+    # race shim's address-recycling check needs a liveness weakref, and
+    # chunk headers churn through recycled addresses constantly.
+    # (pickle skips the __weakref__ slot, so the wire format is
+    # unchanged.)
+    __slots__ = ("tag", "src", "payload", "epoch", "__weakref__")
 
-    def __init__(self, tag, src, payload):
+    def __init__(self, tag, src, payload, epoch=0):
         self.tag = tag
         self.src = src
         self.payload = payload
+        self.epoch = epoch
 
 
 class RingSendError(ConnectionError):
@@ -225,7 +236,12 @@ class PeerService(network.MuxService):
     # however long the job runs.
     _PURGED_KEEP = 256
 
-    def __init__(self, key):
+    def __init__(self, key, epoch=0):
+        # membership epoch this plane accepts; stale-epoch frames are
+        # dropped in _handle so a straggler chunk from a torn-down ring
+        # can never corrupt a post-reconfiguration collective
+        self._epoch = epoch
+        self.stale_epoch_drops = 0   # guarded by self._cv
         self._cv = threading.Condition()
         self._mailbox = {}   # (tag, src) -> payload; guarded by self._cv
         # ring-id index over the mailbox: purge and the late-chunk drop
@@ -244,6 +260,12 @@ class PeerService(network.MuxService):
     def _handle(self, req, client_address):
         if isinstance(req, ChunkMsg):
             with self._cv:
+                if getattr(req, "epoch", 0) != self._epoch:
+                    # stale-epoch frame (or one from the future — a
+                    # peer that reconfigured ahead of us): refuse it at
+                    # the framing layer, before it can touch the mailbox
+                    self.stale_epoch_drops += 1
+                    return network.AckResponse()
                 if self._aborted is not None \
                         or req.tag[0] in self._purged:
                     return network.AckResponse()  # aborted round, drop
@@ -280,7 +302,7 @@ class PeerService(network.MuxService):
         with self._cv:
             while key not in self._mailbox:
                 if self._aborted is not None:
-                    raise HvdAbortedError(*self._aborted)
+                    raise make_abort_error(*self._aborted)
                 if error_check is not None:
                     error_check()
                 remaining = None
@@ -333,13 +355,14 @@ class RingPlane:
     """This process's endpoint of the worker ring."""
 
     def __init__(self, rank, service, resolve_peer, resolve_bulk=None, *,
-                 segment_bytes=None, stripes=None):
+                 segment_bytes=None, stripes=None, epoch=0):
         """``resolve_peer(rank) -> MuxClient`` (control; lazy, cached).
         ``resolve_bulk(rank) -> StripeClient`` builds one bulk-data
         stripe (called up to ``stripes`` times per peer; None routes
         bulk frames through the control client's bulk companion —
         still a dedicated socket, just a single one)."""
         self.rank = rank
+        self.epoch = epoch        # stamped on every outgoing ChunkMsg
         self._service = service
         self._resolve = resolve_peer
         self._resolve_bulk = resolve_bulk
@@ -418,7 +441,8 @@ class RingPlane:
         # control-sized chunks.
         if faults.check("send"):
             return  # injected drop: the chunk vanishes on the wire
-        self._peer(dst).post(ChunkMsg(tag, self.rank, payload))
+        self._peer(dst).post(
+            ChunkMsg(tag, self.rank, payload, epoch=self.epoch))
 
     def recv(self, tag, src, timeout=None) -> bytes:
         if faults.check("recv"):
@@ -504,7 +528,8 @@ class RingPlane:
             with self._pending_cv:
                 self._pending_sends += 1
         self._sendq.put(
-            (dst, stripe_i, ChunkMsg(tag, self.rank, None), payload))
+            (dst, stripe_i,
+             ChunkMsg(tag, self.rank, None, epoch=self.epoch), payload))
 
     def _flush_sends(self, timeout=None):
         """Block until every enqueued segment has been WRITTEN to its

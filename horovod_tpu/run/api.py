@@ -26,7 +26,7 @@ RESULT_SCOPE = "results"
 
 
 def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
-        verbose=False, use_tpu=False):
+        verbose=False, use_tpu=False, elastic=False, min_ranks=1):
     """Run ``fn(*args, **kwargs)`` on ``np`` ranks; returns the list of
     per-rank return values (rank order)."""
     kwargs = kwargs or {}
@@ -71,9 +71,12 @@ def run(fn, args=(), kwargs=None, np=1, hosts=None, extra_env=None,
 
             addr = _routable_addr(slots)
 
+        if elastic:
+            env.setdefault(env_util.HVD_TPU_ELASTIC, "1")
         command = f"{sys.executable} -m horovod_tpu.run.task_runner"
         code = launch_job(slots, command, addr, port, extra_env=env,
-                          verbose=verbose)
+                          verbose=verbose, elastic=elastic,
+                          min_ranks=min_ranks)
         if code != 0:
             raise RuntimeError(f"hvdrun job failed with exit code {code}")
         results = []

@@ -49,6 +49,11 @@ _PARAMS = {
     "connect_retry_seconds": (env_util.HVD_TPU_CONNECT_RETRY_SECONDS,
                               "fault_tolerance.connect_retry_seconds"),
     "fault_spec": (env_util.HVD_TPU_FAULT_SPEC, "fault_tolerance.spec"),
+    "elastic": (env_util.HVD_TPU_ELASTIC, "elastic.enabled"),
+    "min_ranks": (env_util.HVD_TPU_MIN_RANKS, "elastic.min_ranks"),
+    "max_ranks": (env_util.HVD_TPU_MAX_RANKS, "elastic.max_ranks"),
+    "reconfig_timeout": (env_util.HVD_TPU_RECONFIG_TIMEOUT,
+                         "elastic.reconfig_timeout"),
     "race": (env_util.HVD_TPU_RACE, "race.enabled"),
     "race_seed": (env_util.HVD_TPU_RACE_SEED, "race.seed"),
     "race_scope": (env_util.HVD_TPU_RACE_SCOPE, "race.scope"),

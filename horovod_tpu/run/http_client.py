@@ -55,6 +55,14 @@ def delete(addr, port, scope, key, retry_for=DEFAULT_RETRY_FOR):
     request("DELETE", addr, port, scope, key, retry_for=retry_for)
 
 
+def list_keys(addr, port, scope, retry_for=DEFAULT_RETRY_FOR):
+    """Key names currently present in ``scope`` (may be empty) — the
+    server's ``/__list__/<scope>`` enumeration endpoint."""
+    body = request("GET", addr, port, "__list__", scope,
+                   retry_for=retry_for)
+    return [name for name in body.decode().split("\n") if name]
+
+
 def get(addr, port, scope, key, timeout=None, retry_for=DEFAULT_RETRY_FOR):
     """GET; if ``timeout`` is set, poll until the key appears.
 

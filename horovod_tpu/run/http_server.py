@@ -38,6 +38,19 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         scope, key = self._split()
+        if scope == "__list__":
+            # key enumeration for a scope (reference analog: the elastic
+            # driver's discovered-hosts poll): newline-joined key names,
+            # 200 + empty body when the scope holds nothing — callers
+            # distinguish "no keys yet" from a dead server
+            with self.server.kv_lock:
+                names = sorted(self.server.kv.get(key, {}))
+            body = "\n".join(names).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         with self.server.kv_lock:
             value = self.server.kv.get(scope, {}).get(key)
         if value is None:

@@ -147,18 +147,26 @@ def _ssh_command(slot, command, env, ssh_port=None):
 
 def launch_job(slots, command, rendezvous_addr, rendezvous_port,
                extra_env=None, ssh_port=None, verbose=False,
-               output_filename=None) -> int:
+               output_filename=None, elastic=False, min_ranks=1) -> int:
     """Launch one process per slot; kill everything on first failure.
     Returns the CULPRIT's exit code (or 0): the first rank that failed
     on its own — ranks the kill-on-first-failure fan-out subsequently
     terminated report as victims (they die with signal codes like -15
-    that would mask the real error if arrival order decided)."""
+    that would mask the real error if arrival order decided).
+
+    With ``elastic=True`` (docs/elastic.md) a non-rank-0 failure does
+    NOT trigger the kill fan-out: the in-job runtime re-forms the ring
+    around the survivors, so the launcher's job is to supervise them to
+    completion.  The fan-out still fires when rank 0 dies (it hosts the
+    coordinator — nothing can orchestrate a rescue) or when fewer than
+    ``min_ranks`` workers remain."""
     log = get_logger()
     failure = threading.Event()
     # [(rank, code, was_victim, exit_ts)] in reap order — culprit
     # attribution re-ranks by evidence, see pick_culprit
     failures = []
     failures_lock = threading.Lock()
+    alive = [len(slots)]  # guarded by failures_lock
 
     def run_rank(slot):
         info = {}
@@ -211,7 +219,20 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
                 failures.append((slot.rank, code,
                                  info.get("terminated_by_event", False),
                                  info.get("exit_ts")))
-            failure.set()
+                alive[0] -= 1
+                survivors = alive[0]
+            if elastic and slot.rank != 0 and survivors >= min_ranks:
+                # survivable under elastic: the runtime re-forms around
+                # the remaining ranks; keep supervising, don't kill
+                log.warning(
+                    "rank %d failed (%s); elastic mode: supervising "
+                    "%d surviving rank(s)", slot.rank,
+                    describe_exit(code), survivors)
+            else:
+                failure.set()
+        else:
+            with failures_lock:
+                alive[0] -= 1
 
     threads = [threading.Thread(target=run_rank, args=(s,), daemon=True)
                for s in slots]
@@ -231,6 +252,13 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
             t.join(timeout=15)
         raise
 
+    if failures and elastic and not failure.is_set():
+        # every loss was absorbed by a reconfiguration and the
+        # survivors ran to completion: the job succeeded
+        log.warning("%d rank(s) were lost but the surviving ranks "
+                    "completed after elastic reconfiguration",
+                    len(failures))
+        return 0
     if failures:
         # name the culprit: the first rank that failed on its OWN, not
         # a victim the fan-out terminated, ranked by when each child

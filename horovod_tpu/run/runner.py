@@ -177,6 +177,27 @@ def make_parser():
                             "bin/hvd-chaos generates seeded random "
                             "specs for soak runs.")
 
+    elastic = parser.add_argument_group("elastic membership")
+    elastic.add_argument("--elastic", action="store_true", default=None,
+                         help="Survive rank loss: re-form the ring "
+                              "around the survivors at a new "
+                              "membership epoch instead of killing "
+                              "the job (HVD_TPU_ELASTIC; see "
+                              "docs/elastic.md).")
+    elastic.add_argument("--min-ranks", type=int, default=None,
+                         help="Smallest membership the job may shrink "
+                              "to; below this a rank loss is fatal "
+                              "(HVD_TPU_MIN_RANKS, default 1).")
+    elastic.add_argument("--max-ranks", type=int, default=None,
+                         help="Cap on membership size when admitting "
+                              "late joiners (HVD_TPU_MAX_RANKS; 0 = "
+                              "unlimited).")
+    elastic.add_argument("--reconfig-timeout", type=float, default=None,
+                         help="Deadline in seconds for survivors to "
+                              "re-form the world at the new epoch "
+                              "(HVD_TPU_RECONFIG_TIMEOUT, default "
+                              "60).")
+
     race = parser.add_argument_group("race detection")
     race.add_argument("--race", action="store_true", default=None,
                       help="Run every rank under the hvd-race shim "
@@ -378,7 +399,9 @@ def run_commandline(argv=None) -> int:
     try:
         code = launch_job(slots, command, addr, port, extra_env=extra_env,
                           ssh_port=args.ssh_port, verbose=args.verbose,
-                          output_filename=args.output_filename)
+                          output_filename=args.output_filename,
+                          elastic=bool(args.elastic),
+                          min_ranks=args.min_ranks or 1)
     finally:
         rendezvous.stop()
     # a signal death surfaces as Popen's negative code; exit statuses

@@ -90,6 +90,17 @@ HVD_TPU_CONNECT_RETRY_SECONDS = "HVD_TPU_CONNECT_RETRY_SECONDS"
 # deterministic fault injection spec (common/faults.py grammar)
 HVD_TPU_FAULT_SPEC = "HVD_TPU_FAULT_SPEC"
 
+# --- elastic membership (docs/elastic.md) ------------------------------------
+# survive rank loss: reconfigure membership instead of raising on abort
+HVD_TPU_ELASTIC = "HVD_TPU_ELASTIC"
+# budget for one reconfiguration window: survivors must re-rendezvous,
+# rebuild the ring, and replay state within this many seconds
+HVD_TPU_RECONFIG_TIMEOUT = "HVD_TPU_RECONFIG_TIMEOUT"
+# below this many survivors the failure is fatal even under elastic
+HVD_TPU_MIN_RANKS = "HVD_TPU_MIN_RANKS"
+# cap on admitted membership after rejoins (0 = unlimited)
+HVD_TPU_MAX_RANKS = "HVD_TPU_MAX_RANKS"
+
 # --- launcher -> worker contract (reference: gloo_run.py:152-157,261-273) ----
 HVD_RANK = "HVD_RANK"
 HVD_SIZE = "HVD_SIZE"
@@ -140,6 +151,9 @@ DEFAULT_ABORT_TIMEOUT_SECONDS = 30.0
 DEFAULT_HEARTBEAT_INTERVAL_SECONDS = 2.0
 DEFAULT_LIVENESS_TIMEOUT_SECONDS = 15.0
 DEFAULT_CONNECT_RETRY_SECONDS = 30.0
+DEFAULT_RECONFIG_TIMEOUT_SECONDS = 60.0
+DEFAULT_MIN_RANKS = 1
+DEFAULT_MAX_RANKS = 0  # unlimited
 
 
 # A malformed knob value must not silently vanish into the default

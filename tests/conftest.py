@@ -71,14 +71,23 @@ def hvd_init(hvd):
     return hvd
 
 
-def spawn_tcp_ranks(n, script, extra_env=None, timeout=90):
+def spawn_tcp_ranks(n, script, extra_env=None, timeout=90,
+                    world_size=None):
     """Launch ``n`` worker processes under the tcp-controller env
     contract WITHOUT the hvdrun kill-on-first-failure fan-out — the
     fault-tolerance tests need surviving ranks to keep running (and
     observe the coordinated abort) after a sibling dies, which the
     launcher would otherwise preempt with SIGTERM.
 
-    Returns [(returncode, stdout, stderr)] per rank.
+    ``world_size`` (default ``n``) is what HVD_SIZE advertises; ranks
+    at/above it are spawned OUTSIDE the initial gang — late joiners for
+    the elastic tests, which enter via ``hvd.elastic.wait_for_membership``
+    instead of ``hvd.init``.
+
+    Returns [(returncode, stdout, stderr)] per rank.  Every child is
+    reaped on ANY exit path: a spawn failure or per-rank timeout kills
+    and joins the remaining workers instead of leaking them past the
+    test (they would hold the rendezvous port and skew later timings).
     """
     import base64
     import subprocess
@@ -93,15 +102,17 @@ def spawn_tcp_ranks(n, script, extra_env=None, timeout=90):
     server = RendezvousServer()
     port = server.start()
     key = base64.b64encode(secret.make_secret_key()).decode()
+    size = n if world_size is None else world_size
     procs = []
+    reaped = set()
     try:
         for r in range(n):
             env = dict(os.environ)
             env["PYTHONPATH"] = _REPO + os.pathsep + env.get(
                 "PYTHONPATH", "")
             env.update({
-                "HVD_RANK": str(r), "HVD_SIZE": str(n),
-                "HVD_LOCAL_RANK": str(r), "HVD_LOCAL_SIZE": str(n),
+                "HVD_RANK": str(r), "HVD_SIZE": str(size),
+                "HVD_LOCAL_RANK": str(r), "HVD_LOCAL_SIZE": str(size),
                 "HVD_CROSS_RANK": "0", "HVD_CROSS_SIZE": "1",
                 "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
                 "HVD_RENDEZVOUS_PORT": str(port),
@@ -116,17 +127,25 @@ def spawn_tcp_ranks(n, script, extra_env=None, timeout=90):
         results = []
         import time
         deadline = time.monotonic() + timeout
-        for p in procs:
+        for i, p in enumerate(procs):
             remaining = max(1.0, deadline - time.monotonic())
             out, err = p.communicate(timeout=remaining)
+            reaped.add(i)
             results.append((p.returncode, out, err))
         return results
-    except Exception:
-        for p in procs:
+    finally:
+        # reap EVERYTHING still alive (spawn failure, timeout, or any
+        # other exception above): kill, then join — a killed child left
+        # un-waited would linger as a zombie holding its pipes
+        for i, p in enumerate(procs):
+            if i in reaped:
+                continue
             if p.poll() is None:
                 p.kill()
-        raise
-    finally:
+            try:
+                p.communicate(timeout=15)
+            except Exception:  # noqa: BLE001 — reaping is best-effort
+                pass
         server.stop()
 
 

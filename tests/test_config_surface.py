@@ -230,9 +230,10 @@ def test_start_timeout_bounds_gang_start(tmp_path, monkeypatch):
 
     monkeypatch.setattr(network, "MuxClient", _NoClient)
     stub = types.SimpleNamespace(
-        _key=b"k", _filter_ifaces=lambda tagged: tagged)
+        _key=b"k", _epoch=0, _filter_ifaces=lambda tagged: tagged)
     stub._peer_addrs = types.MethodType(
         tc.TcpController._peer_addrs, stub)
+    stub._scope = types.MethodType(tc.TcpController._scope, stub)
     tc.TcpController._resolve_peer(stub, 1)
     assert seen["timeout"] == 7.5
     # and the default is the documented 120 s

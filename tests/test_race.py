@@ -330,6 +330,62 @@ def test_fault_harness_clean_under_shim_and_origin_deterministic(
     assert not active, "\n".join(f["message"] for f in active)
 
 
+ELASTIC_WORKER = r"""
+import os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+state = hvd.elastic.State(
+    params={"w": jnp.zeros((2000,), dtype=jnp.float32)}, step=0)
+
+def train(state):
+    while state.step < 3:
+        g = jnp.full((2000,), float(state.step + 1), dtype=jnp.float32)
+        avg = hvd.allreduce(g, op=hvd.Average,
+                            name=f"race.el.{state.step}")
+        state.params = {"w": state.params["w"] - avg}
+        state.step += 1
+        state.commit()
+
+hvd.elastic.run(train, state)
+print(f"rank {hvd.rank()} RECONFIGURED size={hvd.size()} "
+      f"steps={state.step}", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_elastic_reconfig_path_clean_under_shim(tmp_path):
+    """The elastic reconfiguration path under the shim: membership
+    planning racing the abort fan-out, the controller-generation
+    teardown racing in-flight ring traffic, and the epoch-scoped
+    gang restart — zero non-baselined race reports on any survivor."""
+    results = spawn_tcp_ranks(3, ELASTIC_WORKER, extra_env={
+        "JAX_PLATFORMS": "cpu",
+        "HVD_TPU_RACE": "1",
+        "HVD_TPU_RACE_SEED": "3",
+        "HVD_TPU_RACE_REPORT": str(tmp_path / "el"),
+        "HVD_TPU_ELASTIC": "1",
+        "HVD_TPU_HEARTBEAT_INTERVAL": "0.25",
+        "HVD_TPU_ABORT_TIMEOUT": "10",
+        "HVD_TPU_LIVENESS_TIMEOUT": "2",
+        "HVD_TPU_RECONFIG_TIMEOUT": "60",
+        "HVD_STALL_CHECK_TIME_SECONDS": "1",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "30",
+        "HVD_TCP_RING_THRESHOLD": "1024",
+        "HVD_TPU_FAULT_SPEC": "rank2:allreduce:2:crash",
+    }, timeout=240)
+    assert results[2][0] == 1, f"crashed rank: {results[2][1]}"
+    for r in (0, 1):
+        code, out, err = results[r]
+        assert code == 0, f"rank {r}: {out}\n{err}"
+        assert "RECONFIGURED size=2 steps=3" in out, f"rank {r}: {out}"
+    active = _nonbaselined(str(tmp_path / "el.*.json"))
+    assert not active, "\n".join(f["message"] for f in active)
+
+
 # ------------------------------------------------------------- baseline --
 def test_baseline_is_small_and_justified():
     with open(BASELINE) as f:
