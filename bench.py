@@ -511,6 +511,125 @@ def _bench_ring_pipelined_bandwidth(p=4):
     return out
 
 
+def _bench_optimizer_state_bytes():
+    """Per-rank optimizer-state footprint, replicated vs ZeRO-sharded
+    (docs/sharding.md): adam state bytes for a flat parameter vector at
+    world sizes 1/2/4/8.  The sharded figure is the LARGEST rank's
+    (np.array_split gives the first ranks one extra element) and must
+    scale ~1/N — the whole point of the sharded update."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.sharding.zero import zero_shard_layout
+
+    n_params = int(os.environ.get("BENCH_ZERO_PARAMS", 1 << 20))
+    params = jnp.zeros((n_params,), jnp.float32)
+    opt = optax.adam(1e-3)
+
+    def nbytes(state):
+        return int(sum(np.asarray(l).nbytes
+                       for l in jax.tree.leaves(state)))
+
+    replicated = nbytes(opt.init(params))
+    out = {"n_params": n_params, "replicated_bytes": replicated,
+           "zero_max_rank_bytes": {}, "zero_ratio": {}}
+    for world in (1, 2, 4, 8):
+        per_rank = []
+        for rank in range(world):
+            _, off, cnt = zero_shard_layout(n_params, world, rank)
+            per_rank.append(nbytes(opt.init(params[off:off + cnt])))
+        out["zero_max_rank_bytes"][str(world)] = max(per_rank)
+        out["zero_ratio"][str(world)] = round(
+            max(per_rank) / replicated, 4)
+    return out
+
+
+def _bench_sharded_step():
+    """ZeRO vs replicated eager step throughput on the current topology
+    (docs/sharding.md): both legs run the SAME machinery
+    (ZeroDistributedOptimizer; min_size forces the replicated fallback
+    for the baseline), so the ratio isolates reduce-scatter + shard
+    update + allgather vs allreduce + full update."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    n_params = int(os.environ.get("BENCH_ZERO_STEP_PARAMS", 1 << 18))
+    steps = int(os.environ.get("BENCH_ZERO_STEPS", 10))
+
+    def leg(min_size):
+        def run(rank=0):
+            params = jnp.zeros((n_params,), jnp.float32)
+            opt = hvd.ZeroDistributedOptimizer(optax.adam(1e-3),
+                                               min_size=min_size)
+            state = opt.init(params)
+            grad = jnp.ones((n_params,), jnp.float32)
+            upd, state = opt.update(grad, state, params)  # warmup
+            p = optax.apply_updates(params, upd)
+            float(np.asarray(p[0]))
+            start = time.perf_counter()
+            s = state
+            for _ in range(steps):
+                upd, s = opt.update(grad, s, p)
+                p = optax.apply_updates(p, upd)
+            float(np.asarray(p[0]))
+            return time.perf_counter() - start
+
+        if hvd.local_size() > 1:
+            return basics.run_parallel(run)[0]
+        return run()
+
+    replicated_s = leg(min_size=n_params + 1)   # forces fallback
+    sharded_s = leg(min_size=1)
+    return {
+        "n_params": n_params, "steps": steps,
+        "replicated_steps_per_s": round(steps / replicated_s, 2),
+        "sharded_steps_per_s": round(steps / sharded_s, 2),
+        "sharded_vs_replicated": round(replicated_s / sharded_s, 3),
+    }
+
+
+def sharding_worker():
+    """Sharding legs (docs/sharding.md), CPU-mesh by default like the
+    scaling harness; runs unchanged on real chips.  Prints one JSON
+    object (not the driver headline line)."""
+    import jax
+
+    if not os.environ.get("BENCH_SHARDING_REAL"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    out = {
+        "optimizer_state_bytes": _bench_optimizer_state_bytes(),
+        "sharded_step": _bench_sharded_step(),
+        "n_ranks": hvd.size(),
+        "platform": jax.devices()[0].platform,
+    }
+    hvd.shutdown()
+    print(json.dumps(out))
+
+
+def _run_sharding(timeout=600):
+    """Run the sharding legs in a CPU-forced subprocess; returns the
+    parsed dict or None."""
+    line, _, _ = _run_worker_once(
+        flag="--sharding-worker",
+        extra_env={"XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                                 " --xla_force_host_platform_device_count=4"
+                                 ).strip()},
+        timeout=timeout)
+    if line is None:
+        return None
+    return json.loads(line)
+
+
 def worker():
     # watchdog: a held/unreachable TPU can make backend init BLOCK
     # (not fail); bail out so the supervisor's retry loop stays snappy
@@ -1101,6 +1220,10 @@ def _attach_scaling(line):
     except json.JSONDecodeError:
         return line
     record.setdefault("extra", {})["scaling"] = scaling
+    if os.environ.get("BENCH_SHARDING", "1") not in ("0", "false", "no"):
+        sharding = _run_sharding()
+        if sharding is not None:
+            record["extra"]["sharding"] = sharding
     return json.dumps(record)
 
 
@@ -1111,6 +1234,13 @@ if __name__ == "__main__":
         profile_worker()
     elif "--scaling-worker" in sys.argv:
         scaling_worker()
+    elif "--sharding-worker" in sys.argv:
+        sharding_worker()
+    elif "--sharding" in sys.argv:
+        result = _run_sharding()
+        print(json.dumps(result if result is not None else
+                         {"error": "sharding run failed"}))
+        sys.exit(0 if result is not None else 1)
     elif "--pipeline" in sys.argv:
         pipeline_worker()
     elif "--scaling" in sys.argv:

@@ -57,6 +57,7 @@ enum class RequestType : uint8_t {
   kJoin = 3,
   kAdasum = 4,
   kAlltoall = 5,
+  kReduceScatter = 6,
 };
 
 enum class ResponseType : uint8_t {
@@ -66,7 +67,8 @@ enum class ResponseType : uint8_t {
   kJoin = 3,
   kAdasum = 4,
   kAlltoall = 5,
-  kError = 6,
+  kError = 6,  // pinned: the Python wire decoder keys errors on 6
+  kReduceScatter = 7,
 };
 
 enum class ReduceOp : uint8_t { kAverage = 0, kSum = 1, kAdasum = 2 };

@@ -218,7 +218,9 @@ void Core::RunCycle() {
                            : req.type == RequestType::kBroadcast ? "BROADCAST"
                            : req.type == RequestType::kAlltoall ? "ALLTOALL"
                            : req.type == RequestType::kAdasum   ? "ADASUM"
-                                                                : "JOIN"));
+                           : req.type == RequestType::kReduceScatter
+                               ? "REDUCE_SCATTER"
+                               : "JOIN"));
       table_.emplace_back(req.name, std::move(entry));
       it = std::prev(table_.end());
     }
@@ -337,10 +339,13 @@ Response Core::ConstructResponse(const std::string& name, NameEntry& entry) {
 
   if (!joined_view_.empty() && (entry.type == RequestType::kAllgather ||
                                 entry.type == RequestType::kBroadcast ||
-                                entry.type == RequestType::kAlltoall)) {
-    const char* tname = entry.type == RequestType::kAllgather ? "ALLGATHER"
-                        : entry.type == RequestType::kBroadcast ? "BROADCAST"
-                                                                : "ALLTOALL";
+                                entry.type == RequestType::kAlltoall ||
+                                entry.type == RequestType::kReduceScatter)) {
+    const char* tname =
+        entry.type == RequestType::kAllgather ? "ALLGATHER"
+        : entry.type == RequestType::kBroadcast ? "BROADCAST"
+        : entry.type == RequestType::kReduceScatter ? "REDUCE_SCATTER"
+                                                    : "ALLTOALL";
     return error(std::string(tname) +
                  " is not supported while ranks have joined");
   }
@@ -353,7 +358,13 @@ Response Core::ConstructResponse(const std::string& name, NameEntry& entry) {
 
   switch (entry.type) {
     case RequestType::kAllreduce:
-    case RequestType::kAdasum: {
+    case RequestType::kAdasum:
+    case RequestType::kReduceScatter: {
+      if (entry.type == RequestType::kReduceScatter && first.shape.empty()) {
+        return error("reduce_scatter '" + name +
+                     "': 0-d tensors are not supported; reshape to (1,) "
+                     "first");
+      }
       for (const auto& kv : entry.requests) {
         const Request& r = kv.second;
         if (r.op != first.op) {
@@ -440,6 +451,9 @@ Response Core::ConstructResponse(const std::string& name, NameEntry& entry) {
     case RequestType::kBroadcast: resp.type = ResponseType::kBroadcast; break;
     case RequestType::kAdasum:    resp.type = ResponseType::kAdasum;    break;
     case RequestType::kAlltoall:  resp.type = ResponseType::kAlltoall;  break;
+    case RequestType::kReduceScatter:
+      resp.type = ResponseType::kReduceScatter;
+      break;
     default:                      resp.type = ResponseType::kError;     break;
   }
   resp.op = first.op;
@@ -501,13 +515,15 @@ void Core::PublishBatch(std::vector<Response> responses) {
   ResponseBatch batch;
   std::vector<std::string> names;
   for (auto& resp : responses) {
-    const char* phase = resp.type == ResponseType::kAllreduce ? "ALLREDUCE"
-                        : resp.type == ResponseType::kAllgather ? "ALLGATHER"
-                        : resp.type == ResponseType::kBroadcast ? "BROADCAST"
-                        : resp.type == ResponseType::kAlltoall ? "ALLTOALL"
-                        : resp.type == ResponseType::kAdasum   ? "ADASUM"
-                        : resp.type == ResponseType::kJoin     ? "JOIN"
-                                                               : "ERROR";
+    const char* phase =
+        resp.type == ResponseType::kAllreduce ? "ALLREDUCE"
+        : resp.type == ResponseType::kAllgather ? "ALLGATHER"
+        : resp.type == ResponseType::kBroadcast ? "BROADCAST"
+        : resp.type == ResponseType::kAlltoall ? "ALLTOALL"
+        : resp.type == ResponseType::kAdasum   ? "ADASUM"
+        : resp.type == ResponseType::kReduceScatter ? "REDUCE_SCATTER"
+        : resp.type == ResponseType::kJoin     ? "JOIN"
+                                               : "ERROR";
     if (resp.type != ResponseType::kError &&
         resp.type != ResponseType::kJoin) {
       for (const auto& e : resp.entries) {

@@ -73,6 +73,9 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     alltoall,
     alltoall_async,
     grouped_allreduce,
+    grouped_allgather,
+    reduce_scatter,
+    reduce_scatter_async,
     synchronize,
     poll,
     join,
@@ -87,5 +90,10 @@ from horovod_tpu.jax_api import (  # noqa: F401
     shard_chunk_size,
     sharded_state_wrap,
     sharded_state_unwrap,
+)
+from horovod_tpu.sharding import (  # noqa: F401
+    ZeroDistributedOptimizer,
+    gather_zero_state,
+    reshard_zero_state,
 )
 from horovod_tpu.common.compression import Compression  # noqa: F401

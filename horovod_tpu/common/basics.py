@@ -47,6 +47,23 @@ class _GlobalState:
         self.epoch = 0
 
 
+def _make_executor(config, devices):
+    """Build the XLA data plane ``config.executor`` selects: ``"psum"``
+    is the flat hvd-axis :class:`XlaExecutor`; ``"mesh"`` the
+    NamedSharding :class:`MeshExecutor` over the ``parallel.mesh``
+    dp-axis vocabulary (docs/sharding.md)."""
+    if config.executor == "mesh":
+        from horovod_tpu.sharding.mesh_executor import MeshExecutor
+        executor = MeshExecutor(devices)
+    else:
+        from horovod_tpu.ops.xla_executor import XlaExecutor
+        executor = XlaExecutor(devices)
+    executor.hierarchical_allreduce = config.hierarchical_allreduce
+    executor.hierarchical_allgather = config.hierarchical_allgather
+    executor.adasum_hierarchical = config.adasum_hierarchical
+    return executor
+
+
 def init(comm=None, controller=None):
     """Initialize horovod_tpu.
 
@@ -123,11 +140,7 @@ def init(comm=None, controller=None):
             topology = topology_mod.from_devices(
                 devices, jax.process_index(), jax.process_count())
 
-        from horovod_tpu.ops.xla_executor import XlaExecutor
-        executor = XlaExecutor(devices)
-        executor.hierarchical_allreduce = config.hierarchical_allreduce
-        executor.hierarchical_allgather = config.hierarchical_allgather
-        executor.adasum_hierarchical = config.adasum_hierarchical
+        executor = _make_executor(config, devices)
 
         timeline = None
         impl = None
@@ -259,11 +272,7 @@ def _elastic_join_init(epoch, members):
             local_rank=new_rank, local_size=len(members),
             cross_rank=0, cross_size=1, mode="process")
         devices = jax.local_devices()
-        from horovod_tpu.ops.xla_executor import XlaExecutor
-        executor = XlaExecutor(devices)
-        executor.hierarchical_allreduce = config.hierarchical_allreduce
-        executor.hierarchical_allgather = config.hierarchical_allgather
-        executor.adasum_hierarchical = config.adasum_hierarchical
+        executor = _make_executor(config, devices)
         path = config.timeline_path
         if path:
             path = f"{path}.rank{wid}"

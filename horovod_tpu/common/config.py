@@ -58,6 +58,14 @@ class Config:
         env_util.DEFAULT_RECONFIG_TIMEOUT_SECONDS
     min_ranks: int = env_util.DEFAULT_MIN_RANKS
     max_ranks: int = env_util.DEFAULT_MAX_RANKS
+    # ZeRO-sharded weight update + executor selection (docs/sharding.md):
+    # ``zero`` turns on optimizer-state sharding in the high-level
+    # training wrappers; ``zero_min_size`` keeps tiny models on the
+    # replicated path; ``executor`` picks the XLA data plane ("psum" =
+    # flat hvd-axis mesh, "mesh" = NamedSharding dp-axis executor).
+    zero: bool = False
+    zero_min_size: int = env_util.DEFAULT_ZERO_MIN_SIZE
+    executor: str = "psum"
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -125,6 +133,12 @@ class Config:
             max_ranks=_validated_nonneg(
                 env_util.HVD_TPU_MAX_RANKS,
                 env_util.DEFAULT_MAX_RANKS),
+            zero=env_util.get_bool(env_util.HVD_TPU_ZERO),
+            zero_min_size=_validated_nonneg(
+                env_util.HVD_TPU_ZERO_MIN_SIZE,
+                env_util.DEFAULT_ZERO_MIN_SIZE),
+            executor=_validated_executor(env_util.get_str(
+                env_util.HVD_TPU_EXECUTOR, "psum")),
         )
 
 
@@ -158,6 +172,15 @@ def _validated_fault_spec(text):
 
         parse_fault_spec(text)
     return text
+
+
+def _validated_executor(name: str) -> str:
+    """Same fail-at-init rule: an HVD_TPU_EXECUTOR typo must not
+    silently run the default data plane."""
+    if name not in ("psum", "mesh"):
+        raise ValueError(
+            f"HVD_TPU_EXECUTOR must be 'psum' or 'mesh', got {name!r}")
+    return name
 
 
 def _validated_compression(name: str) -> str:

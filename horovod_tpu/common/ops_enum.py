@@ -34,6 +34,7 @@ class RequestType(enum.IntEnum):
     JOIN = 3
     ADASUM = 4
     ALLTOALL = 5
+    REDUCE_SCATTER = 6
 
 
 class ResponseType(enum.IntEnum):
@@ -46,6 +47,16 @@ class ResponseType(enum.IntEnum):
     ADASUM = 4
     ALLTOALL = 5
     ERROR = 6
+    REDUCE_SCATTER = 7
+
+
+def reduce_scatter_split_sizes(dim0, num_ranks):
+    """First-dimension block sizes for REDUCE_SCATTER, np.array_split
+    style: the first ``dim0 % num_ranks`` ranks get one extra row.  Both
+    data planes and every controller must agree on this partition, so it
+    lives here (jax- and numpy-free)."""
+    base, extra = divmod(int(dim0), int(num_ranks))
+    return [base + 1 if r < extra else base for r in range(num_ranks)]
 
 
 def is_float_dtype(dt) -> bool:

@@ -31,27 +31,67 @@ class State:
       may have partially applied on some survivors.
     - ``sync(root_rank=0)`` replays the state from ``root_rank`` to
       every member (incumbents AND admitted joiners) over broadcast.
+
+    ZeRO interplay (docs/sharding.md): with ``zero_n_params`` set, the
+    live ``optimizer_state`` is the rank's 1/N shard, so the committed
+    snapshot holds the FULL (allgathered) state instead — a lost rank
+    takes its live shard with it, and a shard committed at world N
+    cannot be re-assembled at world N-1.  ``restore``/``sync`` re-shard
+    the full snapshot at whatever the CURRENT world size is.
     """
 
     def __init__(self, params=None, optimizer_state=None, step=0,
-                 epoch=0):
+                 epoch=0, zero_n_params=None):
         self.params = params
         self.optimizer_state = optimizer_state
         self.step = int(step)
         self.epoch = int(epoch)   # user-level epoch counter, NOT the
         # membership epoch (that lives on the runtime)
+        self.zero_n_params = (None if zero_n_params is None
+                              else int(zero_n_params))
         self._committed = None
-        self.commit()
+        self._opt_full = False   # committed opt tree is gathered (full)
+        # the constructor snapshot is LOCAL (no collectives): a late
+        # joiner builds its State while incumbents are elsewhere, so a
+        # gather here could not pair; the first in-loop commit() (or the
+        # driver's first sync()) establishes the recoverable snapshot
+        self.commit(_local=True)
 
-    def commit(self):
-        self._committed = (_tree_copy(self.params),
-                           _tree_copy(self.optimizer_state),
-                           self.step, self.epoch)
+    def _reshard_opt(self, full):
+        """Live view of a committed FULL optimizer state: this rank's
+        shard at the CURRENT (possibly reconfigured) world size."""
+        from horovod_tpu.sharding.zero import reshard_zero_state
+
+        return reshard_zero_state(_tree_copy(full), self.zero_n_params)
+
+    def commit(self, _local=False):
+        """Snapshot the state.  With ``zero_n_params`` set this is a
+        COLLECTIVE (the shard-form optimizer state is allgathered into
+        the snapshot), so every member must commit at the same point —
+        which the step-boundary contract already implies."""
+        # snapshot params first: if the gather is interrupted by a
+        # reconfiguration, _committed keeps the previous complete tuple
+        params = _tree_copy(self.params)
+        if (self.zero_n_params is None or _local
+                or self.optimizer_state is None):
+            opt, full = _tree_copy(self.optimizer_state), False
+        else:
+            from horovod_tpu.sharding.zero import gather_zero_state
+
+            opt = _tree_copy(gather_zero_state(
+                self.optimizer_state, self.zero_n_params,
+                name_prefix="elastic.zero.gather"))
+            full = True
+        self._committed = (params, opt, self.step, self.epoch)
+        self._opt_full = full
 
     def restore(self):
         params, opt, step, epoch = self._committed
         self.params = _tree_copy(params)
-        self.optimizer_state = _tree_copy(opt)
+        if self._opt_full:
+            self.optimizer_state = self._reshard_opt(opt)
+        else:
+            self.optimizer_state = _tree_copy(opt)
         self.step = step
         self.epoch = epoch
 
@@ -68,9 +108,26 @@ class State:
                 self.params, root_rank=root_rank,
                 name_prefix="elastic.sync.params")
         if self.optimizer_state is not None:
-            self.optimizer_state = jax_api.broadcast_parameters(
-                self.optimizer_state, root_rank=root_rank,
-                name_prefix="elastic.sync.opt")
+            if self.zero_n_params is not None:
+                # shard shapes differ across ranks (np.array_split
+                # remainder), so the wire view is the committed FULL
+                # state, shipped as an object: a joiner's own committed
+                # tree is shard-form and could not template a tensor
+                # broadcast.  Every member participates unconditionally
+                # (a flag-gated send would deadlock joiner vs incumbent)
+                # and the root's full/local status rides the payload.
+                is_full, full = objects.broadcast_object(
+                    (self._opt_full, self._committed[1]),
+                    root_rank=root_rank, name="elastic.sync.zero_opt")
+                if is_full:
+                    self.optimizer_state = self._reshard_opt(full)
+                # else: the root never committed past its freshly-
+                # initialized state, which every member (re)derives
+                # identically by construction — keep the local shard
+            else:
+                self.optimizer_state = jax_api.broadcast_parameters(
+                    self.optimizer_state, root_rank=root_rank,
+                    name_prefix="elastic.sync.opt")
         self.step, self.epoch = objects.broadcast_object(
             (self.step, self.epoch), root_rank=root_rank,
             name="elastic.sync.counters")

@@ -21,10 +21,17 @@ Two usage styles:
 import jax
 import optax
 
-from horovod_tpu.common.compression import (Compression,
-                                            quantized_allreduce,
-                                            quantized_reduce_scatter)
+from horovod_tpu.common.compression import Compression, quantized_allreduce
 from horovod_tpu.common.ops_enum import Adasum, Average, ReduceOp, Sum
+# The ZeRO-sharded weight update grew into its own subsystem
+# (docs/sharding.md); these stay importable here for API continuity.
+from horovod_tpu.sharding.zero import (  # noqa: F401
+    ShardedDistributedOptimizer,
+    ZeroDistributedOptimizer,
+    shard_chunk_size,
+    sharded_state_unwrap,
+    sharded_state_wrap,
+)
 
 
 def _single_axis(named_axes, what):
@@ -124,122 +131,6 @@ def DistributedOptimizer(optimizer, named_axes=("hvd",), op=Average,
         chained = optax.MultiSteps(
             chained, every_k_schedule=backward_passes_per_step)
     return chained
-
-
-def ShardedDistributedOptimizer(optimizer, axis_name="hvd", op=Average,
-                                compression=Compression.none):
-    """Cross-replica sharded weight update — ZeRO-1 on the data-parallel
-    axis (the technique is TPU-native in origin: arXiv:2004.13336,
-    "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
-    Training"; the reference framework has no analog).
-
-    Instead of every replica reducing the FULL gradient and holding the
-    FULL optimizer state, each replica:
-
-    1. ``psum_scatter``s the flattened gradient — one 1/N shard arrives
-       reduced (half the ICI traffic of a full allreduce),
-    2. applies the inner optimizer to its shard only (optimizer state is
-       1/N per replica — Adam on a P-param model stores 2P/N here),
-    3. ``all_gather``s the update shards back to apply everywhere.
-
-    Both ``init`` and ``update`` must run INSIDE ``shard_map`` over
-    ``axis_name`` (init the state in a jitted sharded step — see
-    ``tests/test_spmd.py``).  Use
-    ``horovod_tpu.parallel._compat.shard_map_unchecked``: the gathered
-    updates ARE replicated, but jax's varying-manual-axes checker cannot
-    infer replication through ``all_gather`` (no public un-vary
-    annotation exists), so the check must be off for the step.  Average
-    divides by the axis size; Adasum is not supported (its combination
-    needs full vectors).
-    """
-    from jax.flatten_util import ravel_pytree
-
-    import jax.numpy as jnp
-
-    op_ = ReduceOp(op)
-    if op_ == Adasum:
-        raise ValueError(
-            "ShardedDistributedOptimizer does not support Adasum; use "
-            "DistributedOptimizer(op=Adasum)")
-    quantized = getattr(compression, "block_quantized", False)
-
-    def _layout(flat):
-        n = jax.lax.psum(1, axis_name)  # concrete inside shard_map
-        chunk = shard_chunk_size(flat.size, n)
-        if quantized:
-            # block-align the shard so the quantized reduce-scatter's
-            # per-destination chunks land on scale-block boundaries;
-            # init and update share this layout, so the optimizer-state
-            # shape is stable either way
-            chunk = -(-chunk // compression.block) * compression.block
-        return n, chunk
-
-    def _my_shard(flat):
-        n, chunk = _layout(flat)
-        padded = jnp.pad(flat, (0, n * chunk - flat.size))
-        return jax.lax.dynamic_slice(
-            padded, (jax.lax.axis_index(axis_name) * chunk,), (chunk,))
-
-    def init_fn(params):
-        flat, _ = ravel_pytree(params)
-        return optimizer.init(_my_shard(flat))
-
-    def update_fn(grads, state, params=None):
-        flat_g, unravel = ravel_pytree(grads)
-        n, chunk = _layout(flat_g)
-
-        if quantized and jnp.issubdtype(flat_g.dtype, jnp.floating):
-            # quantized reduce-scatter: each rank's contribution to every
-            # shard travels as int8 + block scales, the owned shard
-            # accumulates in fp32 — half of the quantized allreduce (the
-            # allgather of UPDATES below stays full precision)
-            padded = jnp.pad(flat_g.astype(jnp.float32),
-                             (0, n * chunk - flat_g.size))
-            g_shard = quantized_reduce_scatter(
-                padded.reshape(n, chunk), axis_name,
-                compression.block).astype(flat_g.dtype)
-        else:
-            compressed, ctx = compression.compress(flat_g)
-            padded = jnp.pad(compressed, (0, n * chunk - flat_g.size))
-            g_shard = jax.lax.psum_scatter(
-                padded.reshape(n, chunk), axis_name, scatter_dimension=0)
-            g_shard = compression.decompress(g_shard, ctx)
-        if op_ == Average:
-            g_shard = g_shard / n
-
-        p_shard = None
-        if params is not None:
-            flat_p, _ = ravel_pytree(params)
-            p_shard = _my_shard(flat_p)
-        upd_shard, new_state = optimizer.update(g_shard, state, p_shard)
-
-        full = jax.lax.all_gather(upd_shard, axis_name,
-                                  tiled=True)[:flat_g.size]
-        return unravel(full), new_state
-
-    return optax.GradientTransformation(init_fn, update_fn)
-
-
-def shard_chunk_size(n_params, axis_size):
-    """Per-replica flat-shard length the sharded optimizer uses
-    (ceil-divided so the last shard is zero-padded)."""
-    return -(-n_params // axis_size)
-
-
-def sharded_state_wrap(state):
-    """Prepare a ShardedDistributedOptimizer state to LEAVE a
-    ``shard_map`` region: every leaf (including scalar counters) gains a
-    leading length-1 per-rank axis so ``out_specs=P(axis)`` can
-    concatenate the per-replica shards."""
-    import jax.numpy as jnp
-
-    return jax.tree.map(lambda a: jnp.asarray(a)[None], state)
-
-
-def sharded_state_unwrap(state):
-    """Inverse of :func:`sharded_state_wrap` on ENTRY to the region
-    (``in_specs=P(axis)`` hands each replica its own length-1 slice)."""
-    return jax.tree.map(lambda a: a[0], state)
 
 
 def broadcast_parameters(params, root_rank=0, name_prefix=None):

@@ -137,6 +137,35 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
     return [synchronize(h) for h in handles]
 
 
+# -------------------------------------------------------- reduce_scatter ----
+def reduce_scatter_async(tensor, op=None, average=None, name=None,
+                         prescale_factor=1.0, postscale_factor=1.0,
+                         compression=None) -> Handle:
+    """Reduce across ranks, then scatter row blocks of the first
+    dimension: rank ``r`` receives rows ``split_sizes[r]`` of the reduced
+    tensor (np.array_split partition — the first ``dim0 % size`` ranks
+    get one extra row).  The ZeRO decomposition's first half (PAPERS.md
+    arXiv:2004.13336); paired with :func:`allgather` it replaces an
+    allreduce with the optimizer update in between."""
+    op = _resolve_op(op, average)
+    if op == Adasum:
+        raise ValueError("reduce_scatter does not support the Adasum op")
+    return _submit(RequestType.REDUCE_SCATTER, tensor,
+                   name or _auto_name("reduce_scatter"), op=op,
+                   prescale_factor=prescale_factor,
+                   postscale_factor=postscale_factor,
+                   compression=compression)
+
+
+def reduce_scatter(tensor, op=None, average=None, name=None,
+                   prescale_factor=1.0, postscale_factor=1.0,
+                   compression=None):
+    return synchronize(reduce_scatter_async(
+        tensor, op=op, average=average, name=name,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        compression=compression))
+
+
 # ------------------------------------------------------------- allgather ----
 def allgather_async(tensor, name=None) -> Handle:
     return _submit(RequestType.ALLGATHER, tensor,
@@ -145,6 +174,15 @@ def allgather_async(tensor, name=None) -> Handle:
 
 def allgather(tensor, name=None):
     return synchronize(allgather_async(tensor, name=name))
+
+
+def grouped_allgather(tensors, name=None):
+    """Allgather a list of tensors as one negotiation group, mirroring
+    :func:`grouped_allreduce`'s naming contract (``base.{i}``)."""
+    base = name or _auto_name("grouped_allgather")
+    handles = [allgather_async(t, name=f"{base}.{i}")
+               for i, t in enumerate(tensors)]
+    return [synchronize(h) for h in handles]
 
 
 # ------------------------------------------------------------- broadcast ----

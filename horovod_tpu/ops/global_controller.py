@@ -371,6 +371,7 @@ class MetaCoordinatorService(network.MuxService):
                     prescale=meta["prescale"],
                     postscale=meta["postscale"],
                     root_rank=meta["root_rank"],
+                    compression=meta.get("compression", "none"),
                     all_dims0=meta.get("all_dims0"),
                     splits_matrix=meta.get("splits_matrix"),
                     joined=sorted(self._joined)))
@@ -430,7 +431,8 @@ class MetaCoordinatorService(network.MuxService):
 
         if self._joined and rtype in (RequestType.ALLGATHER,
                                       RequestType.BROADCAST,
-                                      RequestType.ALLTOALL):
+                                      RequestType.ALLTOALL,
+                                      RequestType.REDUCE_SCATTER):
             return (f"{rtype.name} is not supported while ranks have "
                     f"joined", None)
 
@@ -446,6 +448,17 @@ class MetaCoordinatorService(network.MuxService):
         if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM):
             if any(r.shape != first.shape for r in reqs):
                 return (f"mismatched shapes for allreduce '{name}'", None)
+            if any(r.op != first.op or r.prescale != first.prescale
+                   or r.postscale != first.postscale for r in reqs):
+                return (f"mismatched reduce ops or scale factors for "
+                        f"tensor '{name}'", None)
+        elif rtype == RequestType.REDUCE_SCATTER:
+            if any(not r.shape for r in reqs):
+                return (f"reduce_scatter '{name}': 0-d tensors are not "
+                        f"supported; reshape to (1,) first", None)
+            if any(r.shape != first.shape for r in reqs):
+                return (f"mismatched shapes for reduce_scatter '{name}'",
+                        None)
             if any(r.op != first.op or r.prescale != first.prescale
                    or r.postscale != first.postscale for r in reqs):
                 return (f"mismatched reduce ops or scale factors for "

@@ -435,6 +435,11 @@ class NativeController:
             elif rtype == ResponseType.ALLTOALL:
                 for g in groups:
                     self._executor.alltoall(g)
+            elif rtype == ResponseType.REDUCE_SCATTER:
+                # never fused by the core (FuseAndPublish only buckets
+                # ALLREDUCE), so each group is its own compiled program
+                for g in groups:
+                    self._executor.reduce_scatter(g)
             else:
                 raise RuntimeError(f"unknown response type {rtype}")
         except Exception as exc:

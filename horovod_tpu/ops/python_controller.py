@@ -419,7 +419,8 @@ class PythonController:
 
         if joined and req_type in (RequestType.ALLGATHER,
                                    RequestType.BROADCAST,
-                                   RequestType.ALLTOALL):
+                                   RequestType.ALLTOALL,
+                                   RequestType.REDUCE_SCATTER):
             return (f"{req_type.name} is not supported while ranks have "
                     f"joined")
 
@@ -459,6 +460,22 @@ class PythonController:
             shapes = {tuple(r.tensor.shape) for r in requests.values()}
             if len(shapes) > 1:
                 return f"mismatched shapes for broadcast '{name}'"
+        elif req_type == RequestType.REDUCE_SCATTER:
+            ops = {r.op for r in requests.values()}
+            if len(ops) > 1:
+                return f"mismatched reduce ops for tensor '{name}'"
+            pre = {r.prescale_factor for r in requests.values()}
+            post = {r.postscale_factor for r in requests.values()}
+            if len(pre) > 1 or len(post) > 1:
+                return f"mismatched scale factors for tensor '{name}'"
+            ndims = {r.tensor.ndim for r in requests.values()}
+            if 0 in ndims:
+                return (f"reduce_scatter '{name}': 0-d tensors are not "
+                        f"supported; reshape to (1,) first")
+            shapes = {tuple(r.tensor.shape) for r in requests.values()}
+            if len(shapes) > 1:
+                return (f"mismatched shapes for reduce_scatter '{name}': "
+                        f"{sorted(shapes)}")
         elif req_type == RequestType.ALLTOALL:
             for r in requests.values():
                 if len(r.splits) != size:
@@ -552,6 +569,8 @@ class PythonController:
             self._executor.alltoall(group)
         elif req_type == RequestType.ADASUM:
             self._executor.adasum(group)
+        elif req_type == RequestType.REDUCE_SCATTER:
+            self._executor.reduce_scatter(group)
         self._timeline_end_groups([group])
 
     def _timeline_begin_groups(self, groups, phase):

@@ -45,7 +45,8 @@ from horovod_tpu.common import faults
 from horovod_tpu.common.handles import (HvdAbortedError, HvdError,
                                         make_abort_error)
 from horovod_tpu.common.ops_enum import (ReduceOp, RequestType,
-                                         is_float_dtype)
+                                         is_float_dtype,
+                                         reduce_scatter_split_sizes)
 from horovod_tpu.common.response_cache import SignatureCache
 from horovod_tpu.ops.tcp_dataplane import (DEFAULT_RING_THRESHOLD,
                                            PeerService, RingPlane,
@@ -541,7 +542,8 @@ class CoordinatorService(network.MuxService):
 
         if self._joined and rtype in (RequestType.ALLGATHER,
                                       RequestType.BROADCAST,
-                                      RequestType.ALLTOALL):
+                                      RequestType.ALLTOALL,
+                                      RequestType.REDUCE_SCATTER):
             raise ValueError(f"{rtype.name} is not supported while ranks "
                              f"have joined")
 
@@ -601,6 +603,53 @@ class CoordinatorService(network.MuxService):
             else:
                 out = self._allreduce(arrs, first)
             return {r: _encode(out) for r in reqs}
+
+        if rtype == RequestType.REDUCE_SCATTER:
+            if not cached:
+                if not first.shape:
+                    raise ValueError(
+                        f"reduce_scatter '{first.name}': 0-d tensors are "
+                        f"not supported; reshape to (1,) first")
+                for r in reqs.values():
+                    if r.shape != first.shape:
+                        raise ValueError(
+                            f"mismatched shapes for reduce_scatter "
+                            f"'{first.name}'")
+                    if r.op != first.op or r.prescale != first.prescale \
+                            or r.postscale != first.postscale:
+                        raise ValueError(
+                            f"mismatched reduce ops or scale factors for "
+                            f"tensor '{first.name}'")
+                self._cache_store(name, entry)
+            if ring:
+                participants = sorted(reqs.keys())
+                self._ring_seq += 1
+                from horovod_tpu.ops.python_controller import \
+                    PythonController
+
+                comp = PythonController.resolve_group_compression(
+                    getattr(r, "compression", "none")
+                    for r in reqs.values())
+                return {r: ResultMsg(ring_go=True,
+                                     participants=participants,
+                                     ring_id=self._ring_seq,
+                                     compression=comp,
+                                     ring_segment_bytes=self._ring_seg())
+                        for r in reqs}
+            # star path: reduce exactly like the allreduce (ascending-
+            # rank float64/int64 sum), then hand each rank its row block
+            # of the np.array_split partition
+            arrs = {r: _decode(m) for r, m in reqs.items()}
+            out = self._allreduce(arrs, first)
+            participants = sorted(reqs.keys())
+            counts = reduce_scatter_split_sizes(first.shape[0],
+                                                len(participants))
+            results = {}
+            off = 0
+            for i, r in enumerate(participants):
+                results[r] = _encode(out[off:off + counts[i]])
+                off += counts[i]
+            return results
 
         if rtype == RequestType.ALLGATHER:
             shapes = {r: m.shape for r, m in reqs.items()}
@@ -1115,7 +1164,8 @@ class TcpController:
                     and self._size & (self._size - 1) == 0)
         return (nbytes >= self._ring_threshold
                 and rtype in (RequestType.ALLREDUCE,
-                              RequestType.BROADCAST))
+                              RequestType.BROADCAST,
+                              RequestType.REDUCE_SCATTER))
 
     def _run_one(self, request, force_payload=False):
         dropped = False
@@ -1237,6 +1287,15 @@ class TcpController:
         try:
             if rtype == RequestType.ALLREDUCE:
                 out = self._ring.allreduce(
+                    resp.ring_id, arr, resp.participants,
+                    op_average=(ReduceOp(request.op) == ReduceOp.AVERAGE),
+                    world_size=self._size,
+                    prescale=request.prescale_factor,
+                    postscale=request.postscale_factor, timeout=timeout,
+                    compression=getattr(resp, "compression", "none"),
+                    segment_bytes=seg)
+            elif rtype == RequestType.REDUCE_SCATTER:
+                out = self._ring.reduce_scatter(
                     resp.ring_id, arr, resp.participants,
                     op_average=(ReduceOp(request.op) == ReduceOp.AVERAGE),
                     world_size=self._size,

@@ -56,7 +56,8 @@ import numpy as np
 
 from horovod_tpu.common import faults
 from horovod_tpu.common.handles import make_abort_error
-from horovod_tpu.common.ops_enum import INT8_BLOCK, is_float_dtype
+from horovod_tpu.common.ops_enum import (INT8_BLOCK, is_float_dtype,
+                                         reduce_scatter_split_sizes)
 from horovod_tpu.run.service import network
 from horovod_tpu.tools.race import hooks as race_hooks
 from horovod_tpu.utils import env as env_util
@@ -776,6 +777,114 @@ class RingPlane:
             carry = recv_owner
         self._flush_sends(timeout)
         return np.concatenate([dec(blobs[i], sizes[i]) for i in range(p)])
+
+    # -------------------------------------------------------- reduce_scatter
+    def reduce_scatter(self, ring_id, arr, participants, *, op_average,
+                       world_size, prescale=1.0, postscale=1.0,
+                       timeout=None, compression="none",
+                       segment_bytes=None):
+        """First-class reduce-scatter: the ring allreduce's reduce-scatter
+        half, exposed on its own (the ZeRO decomposition's first stage).
+        Chunk boundaries sit at FIRST-DIMENSION rows, partitioned
+        np.array_split style (``reduce_scatter_split_sizes``), and the
+        rank at position ``idx`` of the sorted participants receives
+        chunk ``idx`` — unlike the fused allreduce's internal leg, whose
+        element-granular chunks land one position rotated.  Returns this
+        rank's reduced row block in the input dtype."""
+        participants = sorted(participants)
+        p = len(participants)
+        idx = participants.index(self.rank)
+
+        out_dtype = arr.dtype
+        rest = arr.shape[1:]
+        counts = reduce_scatter_split_sizes(arr.shape[0], p)
+        row = int(np.prod(rest or (1,)))
+        sizes = [c * row for c in counts]
+        bounds = np.cumsum([0] + sizes)
+
+        float_in = is_float_dtype(arr.dtype)
+        wire_dt, acc_dtype = _wire_spec(
+            arr.dtype, prescale, widen=op_average or postscale != 1.0)
+        flat = arr.reshape(-1).astype(acc_dtype)
+        if prescale != 1.0:
+            flat = flat * prescale
+        codec = (_codecs().get(compression)
+                 if float_in and compression not in (None, "none") else None)
+        seg = (self.segment_bytes if segment_bytes is None
+               else int(segment_bytes))
+        chunks = [flat[bounds[i]:bounds[i + 1]] for i in range(p)]
+        if p == 1:
+            own = chunks[0]
+        elif codec is not None:
+            own = self._reduce_scatter_compressed(
+                ring_id, chunks, sizes, participants, idx, codec, timeout,
+                seg)
+        else:
+            own = self._reduce_scatter_exact(
+                ring_id, chunks, sizes, participants, idx, wire_dt, timeout,
+                seg)
+        if op_average:
+            own = own / world_size
+        if postscale != 1.0:
+            own = own * postscale
+        return own.astype(out_dtype).reshape((counts[idx],) + rest)
+
+    def _reduce_scatter_exact(self, ring_id, chunks, sizes, participants,
+                              idx, wire_dt, timeout, seg):
+        """The pipelined ring's reduce-scatter leg, shifted one chunk so
+        rank ``idx`` ends up owning chunk ``idx`` (the fused allreduce
+        leaves rank ``idx`` holding chunk ``(idx+1) % p``): at step ``s``
+        send the running partial of chunk ``(idx-1-s) % p`` rightward and
+        accumulate chunk ``(idx-2-s) % p`` from the left."""
+        p = len(participants)
+        right = participants[(idx + 1) % p]
+        left = participants[(idx - 1) % p]
+        item = wire_dt.itemsize
+        for s in range(p - 1):
+            send_i = (idx - 1 - s) % p
+            recv_i = (idx - 2 - s) % p
+            out = chunks[send_i].astype(wire_dt)
+            self.send_chunk(right, (ring_id, "rs", s), _as_bytes_view(out),
+                            seg_bytes=seg, align=item)
+            target = chunks[recv_i]
+
+            def accumulate(offset, segment, target=target):
+                lo = offset // item
+                decoded = np.frombuffer(segment, dtype=wire_dt)
+                target[lo:lo + decoded.size] += decoded.astype(
+                    target.dtype, copy=False)
+
+            self.recv_chunk((ring_id, "rs", s), left,
+                            sizes[recv_i] * item, timeout=timeout,
+                            consume=accumulate, seg_bytes=seg, align=item)
+        self._flush_sends(timeout)
+        return chunks[idx]
+
+    def _reduce_scatter_compressed(self, ring_id, chunks, sizes,
+                                   participants, idx, codec, timeout, seg):
+        """The compressed allreduce's owner-targeted reduce-scatter half
+        without the allgather rotation: each rank encodes its
+        contribution to every destination chunk ONCE and ships it
+        straight to the chunk's owner, who accumulates in float64 — one
+        quantization per contribution, same wire format as the fused
+        path."""
+        enc, dec, enc_nbytes = codec
+        p = len(participants)
+        for d in range(p):
+            if d != idx:
+                self.send_chunk(participants[d], (ring_id, "qrs", d),
+                                enc(np.ascontiguousarray(chunks[d])),
+                                seg_bytes=seg)
+        acc = chunks[idx].astype(np.float64, copy=True)
+        for src_i, src in enumerate(participants):
+            if src_i == idx:
+                continue
+            blob = self.recv_chunk((ring_id, "qrs", idx), src,
+                                   enc_nbytes(sizes[idx]),
+                                   timeout=timeout, seg_bytes=seg)
+            acc += dec(blob, sizes[idx])
+        self._flush_sends(timeout)
+        return acc
 
     # ----------------------------------------------------- seed reference
     def allreduce_seed(self, ring_id, arr, participants, *, op_average,

@@ -73,8 +73,12 @@ class XlaExecutor:
     def __init__(self, devices, hier_local_size=None):
         self.devices = list(devices)
         self.num_ranks = len(self.devices)
-        self.mesh = Mesh(np.array(self.devices), (AXIS,))
-        self._sharded = NamedSharding(self.mesh, P(AXIS))
+        # The mesh and the rank-enumerating axis name are a subclass hook:
+        # MeshExecutor (horovod_tpu/sharding/mesh_executor.py) swaps in a
+        # parallel.mesh-vocabulary mesh so model-parallel axes can later
+        # share the topology.
+        self.mesh, self.axis = self._build_mesh(self.devices)
+        self._sharded = NamedSharding(self.mesh, P(self.axis))
         # Multi-process (global-mesh) support: this process only produces
         # and consumes the shards that live on its own devices; the
         # compiled program spans the full mesh (reference analog: each
@@ -89,6 +93,7 @@ class XlaExecutor:
         self._allreduce_cache = {}
         self._allgather_cache = {}
         self._alltoall_cache = {}
+        self._reduce_scatter_cache = {}
 
         # Two-level (cross, local) mesh for hierarchical collectives
         # (reference: NCCLHierarchicalAllreduce intra-node/inter-node split,
@@ -137,6 +142,11 @@ class XlaExecutor:
         self.adasum_hierarchical = False
 
     # ------------------------------------------------------------------ utils
+    def _build_mesh(self, devices):
+        """Return ``(mesh, axis_name)`` — the 1-D rank mesh and the name of
+        its rank-enumerating axis.  Subclass hook."""
+        return Mesh(np.array(devices), (AXIS,)), AXIS
+
     def commit(self, tensor, rank):
         """Pin a rank's tensor to its device (no-op if already there)."""
         dev = self.devices[rank % self.num_ranks]
@@ -261,6 +271,7 @@ class XlaExecutor:
             self._allreduce_cache[key] = fn
         if fn is None:
             num_ranks = self.num_ranks
+            axis = self.axis
             # Cast compression (bf16/fp16): the collective itself runs in
             # the narrow dtype — XLA fuses the casts into the program and
             # every leg (ICI and DCN) moves half the bytes (reference:
@@ -281,7 +292,7 @@ class XlaExecutor:
                     x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
                 if wire_dt is not None:
                     x = x.astype(wire_dt)
-                return jax.lax.psum(x, AXIS)
+                return jax.lax.psum(x, axis)
 
             def hier_body(shard):
                 # reduce-scatter on ICI -> cross allreduce on DCN ->
@@ -310,7 +321,7 @@ class XlaExecutor:
                         P(("cross", "local")), P())(g)
                 else:
                     red = _shard_map(flat_body, mesh=self.mesh,
-                                     in_specs=P(AXIS), out_specs=P())(g)
+                                     in_specs=P(axis), out_specs=P())(g)
                 flat = red.reshape(-1)
                 if wire_dt is not None:
                     flat = flat.astype(dtype)
@@ -365,11 +376,11 @@ class XlaExecutor:
         num_ranks = self.num_ranks
         hier = bool(hierarchical and self.hier_mesh is not None)
         mesh = self.hier_mesh if hier else self.mesh
-        axis = "local" if hier else AXIS
+        axis = "local" if hier else self.axis
         n_split = mesh.shape["local"] if hier else num_ranks
         chunk = -(-total // (n_split * INT8_BLOCK)) * INT8_BLOCK
         padded = chunk * n_split
-        in_spec = P(("cross", "local")) if hier else P(AXIS)
+        in_spec = P(("cross", "local")) if hier else P(self.axis)
 
         def body(shard):  # [1, total] on one rank
             x = shard.reshape(-1).astype(jnp.float32)
@@ -425,13 +436,15 @@ class XlaExecutor:
         key = (tuple(dims0), rest, np.dtype(dtype).name, hierarchical)
         fn = self._allgather_cache.get(key)
         if fn is None:
+            axis = self.axis
+
             def pad(t, n0=max0):
                 padded = jnp.zeros((1, n0) + t.shape[1:], dtype=t.dtype)
                 return jax.lax.dynamic_update_slice(
                     padded, t[None], (0,) * (t.ndim + 1))
 
             def body(shard):  # [1, max0, *rest]
-                return jax.lax.all_gather(shard[0], AXIS)  # [N, max0, *rest]
+                return jax.lax.all_gather(shard[0], axis)  # [N, max0, *rest]
 
             def hier_body(shard):
                 # gather within the fast local group first, then move the
@@ -451,7 +464,7 @@ class XlaExecutor:
                         P(("cross", "local")), P())(g)
                 else:
                     full = _shard_map_gathered(body, self.mesh,
-                                               P(AXIS), P())(g)
+                                               P(axis), P())(g)
                 parts = [jax.lax.slice_in_dim(full[i], 0, dims0[i], axis=0)
                          for i in range(self.num_ranks)]
                 return jnp.concatenate(parts, axis=0)
@@ -465,6 +478,109 @@ class XlaExecutor:
         out = gather_fn(garr)
         for rank, handle in entry.handles.items():
             handle.set_result(self._shard_for(out, rank))
+
+    # --------------------------------------------------------- reduce_scatter
+    def reduce_scatter(self, entry):
+        """Reduce + scatter row blocks of the first dimension: rank ``r``
+        receives ``reduce_scatter_split_sizes(dim0, N)[r]`` rows of the
+        reduced tensor (np.array_split partition, shared with the TCP
+        planes).  The first half of the ZeRO decomposition (PAPERS.md
+        arXiv:2004.13336) as an eager collective; int8 compression reuses
+        the quantized reduce-scatter wire format from the fused allreduce.
+        """
+        from horovod_tpu.common.ops_enum import reduce_scatter_split_sizes
+
+        shape = tuple(entry.shape)
+        rest = shape[1:]
+        total = _prod(shape)
+        dtype = entry.dtype
+        num_ranks = self.num_ranks
+        counts = reduce_scatter_split_sizes(shape[0], num_ranks)
+        offsets = [sum(counts[:r]) for r in range(num_ranks)]
+        op = entry.op
+        prescale_factor = entry.prescale_factor
+        postscale_factor = entry.postscale_factor
+        comp = self._effective_compression(entry.compression, dtype, total)
+
+        bufs = [self._fuse_in([entry.tensors[r]], [total], dtype)
+                for r in self.local_ranks]
+        garr = self._stack(bufs, (1, total), dtype)
+
+        key = ("reduce_scatter", shape, np.dtype(dtype).name, int(op),
+               float(prescale_factor), float(postscale_factor), comp)
+        fn = self._reduce_scatter_cache.get(key)
+        if fn is None:
+            axis = self.axis
+            wire_dt = {"bf16": jnp.bfloat16,
+                       "fp16": jnp.float16}.get(comp)
+            int_dtype = not np.issubdtype(np.dtype(dtype), np.floating)
+
+            if comp == "int8":
+                chunk = -(-total // (num_ranks * INT8_BLOCK)) * INT8_BLOCK
+                padded = chunk * num_ranks
+
+                def body(shard):  # [1, total] on one rank
+                    x = shard.reshape(-1).astype(jnp.float32)
+                    if prescale_factor != 1.0:
+                        x = x * prescale_factor
+                    x = jnp.pad(x, (0, padded - total))
+                    red = quantized_reduce_scatter(
+                        x.reshape(num_ranks, chunk), axis)
+                    full = quantized_all_gather(red, axis)
+                    return full[:total][None]
+            else:
+                def body(shard):
+                    x = shard
+                    if prescale_factor != 1.0 and not int_dtype:
+                        x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+                    if wire_dt is not None:
+                        x = x.astype(wire_dt)
+                    return jax.lax.psum(x, axis)
+
+            def fused(g):
+                if comp == "int8":
+                    red = _shard_map_gathered(body, self.mesh,
+                                              P(axis), P())(g)
+                else:
+                    red = _shard_map(body, mesh=self.mesh,
+                                     in_specs=P(axis), out_specs=P())(g)
+                flat = red.reshape(-1)
+                if wire_dt is not None:
+                    flat = flat.astype(dtype)
+                if comp == "int8":
+                    if op == ReduceOp.AVERAGE:
+                        flat = flat / num_ranks
+                    if postscale_factor != 1.0:
+                        flat = flat * postscale_factor
+                    flat = flat.astype(dtype)
+                elif int_dtype:
+                    factor = prescale_factor * postscale_factor
+                    if op == ReduceOp.AVERAGE:
+                        factor /= num_ranks
+                    if factor != 1.0:
+                        sdt = (jnp.float64 if jax.config.jax_enable_x64
+                               else jnp.float32)
+                        flat = (flat.astype(sdt)
+                                * factor).astype(flat.dtype)
+                else:
+                    if op == ReduceOp.AVERAGE:
+                        flat = flat / jnp.asarray(num_ranks,
+                                                  dtype=flat.dtype)
+                    if postscale_factor != 1.0:
+                        flat = flat * jnp.asarray(postscale_factor,
+                                                  dtype=flat.dtype)
+                full = flat.reshape(shape)
+                return tuple(
+                    jax.lax.slice_in_dim(full, offsets[r],
+                                         offsets[r] + counts[r], axis=0)
+                    for r in range(num_ranks))
+
+            fn = jax.jit(fused, donate_argnums=0)
+            self._reduce_scatter_cache[key] = fn
+
+        outs = fn(garr)
+        for rank, handle in entry.handles.items():
+            handle.set_result(self._shard_for(outs[rank], rank))
 
     # -------------------------------------------------------------- broadcast
     def broadcast(self, entry):
@@ -498,16 +614,18 @@ class XlaExecutor:
         key = ("broadcast", shape, np.dtype(dtype).name)
         fn = self._allreduce_cache.get(key)
         if fn is None:
+            axis = self.axis
+
             def fused(g):
                 def body(shard):
                     x = shard
                     # pred/int psum: sum of one real row + zeros is exact
                     if x.dtype == jnp.bool_:
                         x = x.astype(jnp.uint8)
-                    out = jax.lax.psum(x, AXIS)
+                    out = jax.lax.psum(x, axis)
                     return out.astype(shard.dtype)
                 red = _shard_map(body, mesh=self.mesh,
-                                 in_specs=P(AXIS), out_specs=P())(g)
+                                 in_specs=P(axis), out_specs=P())(g)
                 return red.reshape(shape)
 
             fn = jax.jit(fused, donate_argnums=0)
@@ -560,12 +678,14 @@ class XlaExecutor:
                         body, self.hier_mesh,
                         P(("cross", "local")), P())(g).reshape(shape)
             else:
+                axis = self.axis
+
                 def fused(g):
                     def body(shard):
-                        gathered = jax.lax.all_gather(shard[0], AXIS)
+                        gathered = jax.lax.all_gather(shard[0], axis)
                         return adasum_reduce_stacked(gathered)
                     return _shard_map_gathered(
-                        body, self.mesh, P(AXIS), P())(g).reshape(shape)
+                        body, self.mesh, P(axis), P())(g).reshape(shape)
 
             fn = jax.jit(fused, donate_argnums=0)
             self._allreduce_cache[key] = fn
@@ -600,6 +720,8 @@ class XlaExecutor:
         key = (splits_matrix, rest, np.dtype(dtype).name)
         fns = self._alltoall_cache.get(key)
         if fns is None:
+            axis = self.axis
+
             def make_pad(row):
                 # [sum(row), *rest] -> [1, N, max_split, *rest]
                 def pad(t):
@@ -620,9 +742,9 @@ class XlaExecutor:
             def exchange(g):  # [N, N, max_split, *rest] sharded on axis 0
                 def body(shard):
                     return jax.lax.all_to_all(
-                        shard[0], AXIS, split_axis=0, concat_axis=0)[None]
+                        shard[0], axis, split_axis=0, concat_axis=0)[None]
                 return _shard_map(body, mesh=self.mesh,
-                                  in_specs=P(AXIS), out_specs=P(AXIS))(g)
+                                  in_specs=P(axis), out_specs=P(axis))(g)
 
             def make_unpack(recv_row):
                 # [N, max_split, *rest] -> [sum(recv_row), *rest]

@@ -53,6 +53,16 @@ def make_mesh(axis_shapes=None, *, devices=None) -> Mesh:
         axis_shapes = {MeshAxes.DP: n}
     names = list(axis_shapes.keys())
     sizes = list(axis_shapes.values())
+    for name, s in zip(names, sizes):
+        if not isinstance(s, int) or isinstance(s, bool):
+            raise ValueError(
+                f"mesh axis {name!r} size must be an int, got {s!r}")
+        if s < 1 and s != -1:
+            # a 0 size would divide-by-zero in the -1 absorption below
+            # and a negative one would silently reshape garbage
+            raise ValueError(
+                f"mesh axis {name!r} size must be a positive int (or -1 "
+                f"to absorb the remaining devices), got {s}")
     if sizes.count(-1) > 1:
         raise ValueError("at most one axis may be -1")
     known = math.prod(s for s in sizes if s != -1)

@@ -54,6 +54,9 @@ _PARAMS = {
     "max_ranks": (env_util.HVD_TPU_MAX_RANKS, "elastic.max_ranks"),
     "reconfig_timeout": (env_util.HVD_TPU_RECONFIG_TIMEOUT,
                          "elastic.reconfig_timeout"),
+    "zero": (env_util.HVD_TPU_ZERO, "sharding.zero"),
+    "zero_min_size": (env_util.HVD_TPU_ZERO_MIN_SIZE, "sharding.zero_min_size"),
+    "executor": (env_util.HVD_TPU_EXECUTOR, "sharding.executor"),
     "race": (env_util.HVD_TPU_RACE, "race.enabled"),
     "race_seed": (env_util.HVD_TPU_RACE_SEED, "race.seed"),
     "race_scope": (env_util.HVD_TPU_RACE_SCOPE, "race.scope"),

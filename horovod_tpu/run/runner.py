@@ -131,6 +131,26 @@ def make_parser():
     group.add_argument("--controller", choices=["native", "python", "tcp"],
                        default=None)
 
+    shard = parser.add_argument_group("sharding")
+    shard.add_argument("--zero", action="store_true", default=None,
+                       help="Enable the ZeRO-sharded weight update "
+                            "(HVD_TPU_ZERO): gradients are reduce-scattered, "
+                            "each rank updates its 1/N parameter shard with "
+                            "optimizer state allocated for that shard only, "
+                            "and updated shards are allgathered back — see "
+                            "docs/sharding.md.")
+    shard.add_argument("--zero-min-size", type=int, default=None,
+                       help="Parameter-count threshold below which the "
+                            "sharded update falls back to the replicated "
+                            "path (HVD_TPU_ZERO_MIN_SIZE, default 1024).")
+    shard.add_argument("--executor", choices=["psum", "mesh"], default=None,
+                       help="XLA executor flavour (HVD_TPU_EXECUTOR): "
+                            "'psum' is the shard_map ring executor; 'mesh' "
+                            "builds the program over a NamedSharding mesh "
+                            "(parallel.mesh axis vocabulary) so tensor/"
+                            "pipeline parallel layers can compose on the "
+                            "same mesh.")
+
     auto = parser.add_argument_group("autotune")
     auto.add_argument("--autotune", action="store_true", default=None)
     auto.add_argument("--no-autotune", action="store_true", default=None,

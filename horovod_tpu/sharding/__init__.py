@@ -1,0 +1,31 @@
+"""ZeRO-sharded weight update + GSPMD-native executor path.
+
+Two halves of one idea — stop replicating what can be sharded:
+
+- :mod:`horovod_tpu.sharding.zero` — the ZeRO-1 weight-update
+  decomposition (arXiv:2004.13336): reduce-scatter gradients, run the
+  optimizer on this rank's 1/N shard (optimizer state allocated for
+  that shard only), allgather updated parameters.  Available on both
+  data planes: in-graph via :func:`ShardedDistributedOptimizer`
+  (shard_map/psum_scatter, compiled into the step) and eagerly via
+  :func:`ZeroDistributedOptimizer` (the named reduce_scatter/allgather
+  collectives, so the TCP ring and the coordinator star serve it too).
+- :mod:`horovod_tpu.sharding.mesh_executor` — a NamedSharding-native
+  executor over the :mod:`horovod_tpu.parallel.mesh` axis vocabulary,
+  selected with ``HVD_TPU_EXECUTOR=mesh``, so tensor/pipeline/MoE
+  parallelism can later compose on the same mesh.
+
+See docs/sharding.md.
+"""
+
+from horovod_tpu.sharding.mesh_executor import MeshExecutor  # noqa: F401
+from horovod_tpu.sharding.zero import (  # noqa: F401
+    ShardedDistributedOptimizer,
+    ZeroDistributedOptimizer,
+    gather_zero_state,
+    reshard_zero_state,
+    shard_chunk_size,
+    sharded_state_unwrap,
+    sharded_state_wrap,
+    zero_shard_layout,
+)

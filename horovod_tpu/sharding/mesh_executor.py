@@ -1,0 +1,54 @@
+"""GSPMD-native executor: the XLA data plane over a NamedSharding mesh.
+
+:class:`~horovod_tpu.ops.xla_executor.XlaExecutor` builds its own
+private 1-D ``Mesh`` over the axis name ``"hvd"``.  That is fine for a
+pure data-parallel job, but it is a topology island: the model-parallel
+modules (``horovod_tpu.parallel.{tensor_parallel,pipeline,moe}``)
+express THEIR sharding over the :class:`horovod_tpu.parallel.mesh
+.MeshAxes` vocabulary (``dp``/``fsdp``/``tp``/...), so a training step
+that wants eager collectives AND in-graph model parallelism would juggle
+two meshes over the same devices.
+
+``MeshExecutor`` closes the gap: the same compiled collective programs
+(it inherits every ``allreduce_fused``/``allgather``/``reduce_scatter``
+/... implementation unchanged) run over a ``parallel.mesh.make_mesh``
+mesh whose rank axis is ``MeshAxes.DP``, and the executor can hand out
+:class:`~jax.sharding.NamedSharding` specs on that mesh for the model's
+own arrays.  Select it with ``HVD_TPU_EXECUTOR=mesh`` (tri-surface:
+``hvdrun --executor``, YAML ``sharding.executor``); see
+docs/sharding.md.
+"""
+
+from horovod_tpu.ops.xla_executor import XlaExecutor
+from horovod_tpu.parallel.mesh import MeshAxes, make_mesh
+
+
+class MeshExecutor(XlaExecutor):
+    """XlaExecutor whose mesh speaks the ``parallel.mesh`` axis
+    vocabulary.
+
+    The rank-enumerating axis is ``MeshAxes.DP`` (``"dp"``) by default —
+    gradients psum over ``dp`` exactly like every sharding-annotated
+    model in ``horovod_tpu.parallel`` expects — so eager collectives and
+    GSPMD model code agree on one topology object
+    (:attr:`mesh`).
+    """
+
+    def __init__(self, devices, hier_local_size=None,
+                 axis_name=MeshAxes.DP):
+        self._axis_name = axis_name
+        super().__init__(devices, hier_local_size=hier_local_size)
+
+    def _build_mesh(self, devices):
+        mesh = make_mesh({self._axis_name: len(devices)}, devices=devices)
+        return mesh, self._axis_name
+
+    def named_sharding(self, *spec):
+        """A :class:`~jax.sharding.NamedSharding` over this executor's
+        mesh — the hook the parallel modules use to place model arrays
+        on the SAME topology the eager collectives run on.  ``spec``
+        elements are axis names (or ``None``) exactly as for
+        :class:`~jax.sharding.PartitionSpec`."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec(*spec))

@@ -59,6 +59,18 @@ HVD_TPU_RING_STRIPES = "HVD_TPU_RING_STRIPES"
 # instead of the coordinator star (docs/tuning.md)
 HVD_TCP_RING_THRESHOLD = "HVD_TCP_RING_THRESHOLD"
 
+# --- ZeRO sharding + executor selection (docs/sharding.md) -------------------
+# shard the weight update ZeRO-1 style: reduce-scatter gradients, run
+# the optimizer on this rank's 1/N shard, allgather updated params
+HVD_TPU_ZERO = "HVD_TPU_ZERO"
+# flat parameter count below which the sharded update falls back to the
+# replicated path (tiny models pay more in collective latency than they
+# save in state memory)
+HVD_TPU_ZERO_MIN_SIZE = "HVD_TPU_ZERO_MIN_SIZE"
+# XLA data-plane executor: "psum" (flat hvd-axis mesh) | "mesh"
+# (NamedSharding executor over the parallel.mesh dp-axis vocabulary)
+HVD_TPU_EXECUTOR = "HVD_TPU_EXECUTOR"
+
 # --- race detection (docs/race_detection.md) ---------------------------------
 # install the hvd-race shim at import: traced threading/queue
 # primitives + instrumented attribute access on the concurrency-scoped
@@ -154,6 +166,7 @@ DEFAULT_CONNECT_RETRY_SECONDS = 30.0
 DEFAULT_RECONFIG_TIMEOUT_SECONDS = 60.0
 DEFAULT_MIN_RANKS = 1
 DEFAULT_MAX_RANKS = 0  # unlimited
+DEFAULT_ZERO_MIN_SIZE = 1024  # flat params below this stay replicated
 
 
 # A malformed knob value must not silently vanish into the default

@@ -306,6 +306,96 @@ def test_elastic_survives_rank_loss_and_converges_bitwise():
     assert got[0][0] == want[0][0], (got, want)
 
 
+ZERO_ELASTIC_WORKER = r"""
+import hashlib, os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import optax
+import horovod_tpu as hvd
+
+wid = int(os.environ["HVD_RANK"])
+steps = int(os.environ.get("EL_STEPS", "6"))
+
+if wid >= int(os.environ["HVD_SIZE"]):
+    hvd.elastic.wait_for_membership(timeout=60)
+else:
+    hvd.init()
+
+N = 1000
+params = {"w": jnp.zeros((N,), dtype=jnp.float32)}
+opt = hvd.ZeroDistributedOptimizer(optax.adam(0.1), min_size=1)
+state = hvd.elastic.State(
+    params=params, optimizer_state=opt.init(params), step=0,
+    zero_n_params=N)
+
+def train(state):
+    while state.step < steps:
+        # integer-valued, rank-identical gradients: the reduce-scatter
+        # average is exact at any world size, and the adam update is
+        # elementwise, so the allgathered params are bitwise-independent
+        # of which rank owned which shard — and of membership history
+        grad = {"w": jnp.full((N,), float(state.step + 1),
+                              dtype=jnp.float32)}
+        upd, state.optimizer_state = opt.update(
+            grad, state.optimizer_state, state.params)
+        state.params = optax.apply_updates(state.params, upd)
+        state.step += 1
+        state.commit()
+
+try:
+    hvd.elastic.run(train, state)
+except hvd.HvdAbortedError as exc:
+    print(f"rank {hvd.rank()} wid {wid} ABORTED "
+          f"origin={exc.origin_rank}", flush=True)
+    print(f"rank {hvd.rank()} wid {wid} DONE", flush=True)
+    raise SystemExit(0)
+digest = hashlib.sha1(
+    np.asarray(state.params["w"]).tobytes()).hexdigest()
+shard = max((l.shape[0] for l in jax.tree.leaves(state.optimizer_state)
+             if getattr(l, "ndim", 0) == 1), default=0)
+final_rank, final_size = hvd.rank(), hvd.size()
+print(f"rank {final_rank} wid {wid} DIGEST={digest} "
+      f"size={final_size} steps={state.step} shard={shard}", flush=True)
+hvd.shutdown()
+print(f"rank {final_rank} wid {wid} DONE", flush=True)
+"""
+
+
+def test_elastic_zero_reshards_optimizer_state_and_converges_bitwise():
+    """ZeRO x elastic acceptance (docs/sharding.md): a 4-rank sharded
+    adam run loses rank 2 mid-step; survivors re-shard the committed
+    (full) optimizer state at world size 3 and finish with params
+    BITWISE-identical to an uninterrupted 3-rank sharded run.  Each
+    survivor's final state shard must be the world-3 split of the
+    1000-element flat param vector (334/333/333)."""
+    elastic = spawn_tcp_ranks(4, ZERO_ELASTIC_WORKER, timeout=180,
+                              extra_env={
+        **_EL_ENV,
+        "HVD_TPU_ELASTIC": "1",
+        "HVD_TPU_FAULT_SPEC": "rank2:reduce_scatter:3:crash",
+    })
+    assert elastic[2][0] == 1, f"injected crash: {elastic[2][1]}"
+    got = _digests(elastic, ranks=[0, 1, 3])
+    shards = {}
+    for r, (digest, size, steps) in got.items():
+        assert size == 3, f"rank {r} finished at world size {size}"
+        assert steps == 6
+        line = next(l for l in elastic[r][1].splitlines()
+                    if "DIGEST=" in l)
+        fields = dict(kv.split("=") for kv in line.split() if "=" in kv)
+        shards[r] = int(fields["shard"])
+    assert len({d for d, _, _ in got.values()}) == 1, got
+    # survivor order 0,1,3 -> new ranks 0,1,2: np.array_split(1000, 3)
+    assert [shards[0], shards[1], shards[3]] == [334, 333, 333], shards
+
+    uninterrupted = spawn_tcp_ranks(3, ZERO_ELASTIC_WORKER, timeout=180,
+                                    extra_env=_EL_ENV)
+    want = _digests(uninterrupted, ranks=[0, 1, 2])
+    assert got[0][0] == want[0][0], (got, want)
+
+
 def test_elastic_off_same_spec_raises_typed_abort_everywhere():
     """Elastic OFF (the default): the identical fault spec must keep
     the PR-2 contract — every surviving rank raises HvdAbortedError
