@@ -630,6 +630,67 @@ def _run_sharding(timeout=600):
     return json.loads(line)
 
 
+def checkpoint_bench():
+    """Durable-checkpoint overhead leg (docs/checkpoint.md): time N
+    elastic commits bare vs with the background writer attached at
+    interval 1 (the worst case), plus the resume (read + digest-verify
+    + reassemble) latency.  Single process on the CPU mesh — the writer
+    thread and the file formats are platform-independent."""
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from horovod_tpu.checkpoint import CheckpointManager
+    from horovod_tpu.elastic import State
+
+    n_params = int(os.environ.get("BENCH_CKPT_PARAMS", 1 << 20))
+    steps = int(os.environ.get("BENCH_CKPT_STEPS", 20))
+
+    def run(manager):
+        params = np.zeros((n_params,), np.float32)
+        opt = {"m": np.zeros((n_params,), np.float32),
+               "count": np.zeros((), np.int32)}
+        state = State(params=params, optimizer_state=opt)
+        if manager is not None:
+            state.attach_checkpoint(manager)
+        start = time.perf_counter()
+        for _ in range(steps):
+            state.params = state.params + 1.0
+            state.step += 1
+            state.commit()
+        elapsed = time.perf_counter() - start
+        if manager is not None:
+            manager.wait()
+        return elapsed, state
+
+    bare_s, _ = run(None)
+    with tempfile.TemporaryDirectory() as d:
+        manager = CheckpointManager(d, interval_steps=1, keep=2)
+        ckpt_s, state = run(manager)
+        manager.wait()
+        fresh = State(params=np.zeros((n_params,), np.float32),
+                      optimizer_state={"m": np.zeros((n_params,),
+                                                     np.float32),
+                                       "count": np.zeros((), np.int32)})
+        t0 = time.perf_counter()
+        resumed = manager.restore_latest(fresh)
+        resume_s = time.perf_counter() - t0
+        manager.close()
+    out = {
+        "n_params": n_params, "steps": steps,
+        "commit_steps_per_s": round(steps / bare_s, 2),
+        "ckpt_steps_per_s": round(steps / ckpt_s, 2),
+        "ckpt_overhead": round(ckpt_s / bare_s, 3),
+        "resume_s": round(resume_s, 4),
+        "resumed_step": None if resumed is None else resumed[0],
+    }
+    print(json.dumps(out))
+    return 0 if resumed is not None and fresh.step == state.step else 1
+
+
 def worker():
     # watchdog: a held/unreachable TPU can make backend init BLOCK
     # (not fail); bail out so the supervisor's retry loop stays snappy
@@ -1241,6 +1302,8 @@ if __name__ == "__main__":
         print(json.dumps(result if result is not None else
                          {"error": "sharding run failed"}))
         sys.exit(0 if result is not None else 1)
+    elif "--checkpoint" in sys.argv:
+        sys.exit(checkpoint_bench())
     elif "--pipeline" in sys.argv:
         pipeline_worker()
     elif "--scaling" in sys.argv:

@@ -58,9 +58,11 @@ from horovod_tpu.common.basics import (  # noqa: F401
 )
 from horovod_tpu.common.handles import (  # noqa: F401
     HvdAbortedError,
+    HvdDrainedError,
     HvdError,
     HvdReconfigureError,
 )
+from horovod_tpu import checkpoint  # noqa: F401
 from horovod_tpu import elastic  # noqa: F401
 from horovod_tpu.common.ops_enum import Average, Sum, Adasum  # noqa: F401
 from horovod_tpu.ops.eager import (  # noqa: F401

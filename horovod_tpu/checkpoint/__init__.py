@@ -1,0 +1,41 @@
+"""Durable sharded checkpointing with auto-resume (docs/checkpoint.md).
+
+Surface::
+
+    hvd.checkpoint.CheckpointManager(dir, interval_steps, keep)
+    hvd.checkpoint.manager_from_env()   # None when HVD_TPU_CKPT_DIR unset
+
+``elastic.run`` attaches a manager automatically when the checkpoint
+directory is configured — most jobs never touch this package directly.
+"""
+
+from horovod_tpu.checkpoint import store
+from horovod_tpu.checkpoint.manager import CheckpointManager
+
+__all__ = ["CheckpointManager", "manager_from_env", "store"]
+
+
+def manager_from_env():
+    """The process's configured :class:`CheckpointManager`, or None when
+    checkpointing is off (no ``HVD_TPU_CKPT_DIR`` / ``ckpt_dir``).
+    Reads the live runtime config when initialized (so launcher/YAML
+    overrides apply), the raw env otherwise."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.utils import env as env_util
+
+    if basics.is_initialized():
+        config = basics._get_state().config
+        directory = config.ckpt_dir
+        interval = config.ckpt_interval_steps
+        keep = config.ckpt_keep
+    else:
+        directory = env_util.get_str(env_util.HVD_TPU_CKPT_DIR)
+        interval = max(1, env_util.get_int(
+            env_util.HVD_TPU_CKPT_INTERVAL,
+            env_util.DEFAULT_CKPT_INTERVAL_STEPS))
+        keep = max(0, env_util.get_int(env_util.HVD_TPU_CKPT_KEEP,
+                                       env_util.DEFAULT_CKPT_KEEP))
+    if not directory:
+        return None
+    return CheckpointManager(directory, interval_steps=interval,
+                             keep=keep)

@@ -1,0 +1,304 @@
+"""Background sharded checkpoint writer + auto-resume (docs/checkpoint.md).
+
+Layered on :class:`horovod_tpu.elastic.State`: ``State.commit()``
+already produces a double-buffered snapshot (``_committed`` — deep
+numpy copies, and the FULL allgathered optimizer state under eager
+ZeRO).  ``maybe_save`` hands that snapshot to a dedicated writer
+thread, so training overlaps checkpoint I/O; the queue is depth-1
+latest-wins — under a slow disk, intermediate snapshots are skipped
+rather than queued (durability wants the NEWEST state, not a backlog).
+
+Per (step, epoch, world) checkpoint:
+
+- every rank writes its block of the flat parameter vector (the eager
+  ZeRO row partition — :func:`horovod_tpu.sharding.zero.flat_shard`)
+  and, when the optimizer snapshot is in FULL form, its block of every
+  length-``n_params`` optimizer leaf;
+- rank 0 additionally writes the non-sharded leaves (step counters,
+  replicated trees) and, last, the manifest.
+
+Resume (:meth:`CheckpointManager.restore_latest`, rank 0 at
+``elastic.run`` entry) walks manifests newest-first, digest-verifies
+every shard, re-assembles at whatever world size the checkpoint was
+written at, and installs the result as the State's committed snapshot
+— ``State.restore()`` + the driver's first ``sync()`` then re-shard to
+the CURRENT world size, so a 4-rank checkpoint resumes cleanly on 3
+ranks.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from horovod_tpu.checkpoint import store
+from horovod_tpu.common import busy
+from horovod_tpu.utils.logging import get_logger
+
+
+def _flatten_params(params):
+    """(flat float vector as numpy, n_params).  None -> (None, 0)."""
+    if params is None:
+        return None, 0
+    from jax.flatten_util import ravel_pytree
+
+    flat, _ = ravel_pytree(params)
+    return np.asarray(flat), int(flat.size)
+
+
+class CheckpointManager:
+    """One per process; owns the writer thread and the resume logic."""
+
+    def __init__(self, directory, interval_steps=1, keep=2,
+                 io_delay=0.0):
+        import os
+
+        self._dir = directory
+        self._interval = max(1, int(interval_steps))
+        self._keep = max(0, int(keep))   # 0: keep everything
+        # test hook (liveness-interplay regression): artificial per-
+        # write disk latency, read at write time so tests can throttle
+        self.io_delay = float(io_delay)
+        os.makedirs(directory, exist_ok=True)
+        self._log = get_logger()
+        self._cond = threading.Condition()
+        self._snapshot = None       # latest-wins slot; guarded by _cond
+        self._stop = False          # guarded by _cond
+        self._writing = False       # guarded by _cond
+        self._last_step = None      # last step handed to the writer
+        self._errors = 0            # failed writes (visible to tests)
+        # joined in close(); daemon so a worker dying mid-write never
+        # hangs process exit on a disk stall
+        self._thread = threading.Thread(target=self._writer_loop,
+                                        daemon=True,
+                                        name="hvd-ckpt-writer")
+        self._thread.start()
+
+    # ------------------------------------------------------------- write side
+    def maybe_save(self, state) -> bool:
+        """Called from ``State.commit()``: enqueue a write every
+        ``interval_steps`` committed steps."""
+        if state.step % self._interval != 0:
+            return False
+        return self.save_now(state)
+
+    def save_now(self, state) -> bool:
+        """Unconditionally enqueue the state's committed snapshot."""
+        if state._committed is None:
+            return False
+        params, opt, step, epoch = state._committed
+        if step == self._last_step:
+            return False   # commit() re-runs at a retried boundary
+        rank, world = self._topology()
+        self._last_step = step
+        snap = {"params": params, "opt": opt,
+                "opt_full": bool(state._opt_full),
+                "step": int(step), "epoch": int(epoch),
+                "rank": rank, "world": world}
+        with self._cond:
+            self._snapshot = snap   # latest wins
+            self._cond.notify()
+        return True
+
+    @staticmethod
+    def _topology():
+        from horovod_tpu.common import basics
+
+        if basics.is_initialized():
+            return basics.rank(), basics.size()
+        return 0, 1
+
+    def wait(self, timeout=30.0) -> bool:
+        """Block until the writer drained the queue (tests and drain
+        teardown use this to make durability deterministic)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._snapshot is not None or self._writing:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+        return True
+
+    def close(self, flush=True):
+        if flush:
+            self.wait()
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        self._thread.join(timeout=30)
+
+    def _writer_loop(self):
+        while True:
+            with self._cond:
+                while self._snapshot is None and not self._stop:
+                    self._cond.wait()
+                if self._stop and self._snapshot is None:
+                    return
+                snap, self._snapshot = self._snapshot, None
+                self._writing = True
+            try:
+                # busy window: a slow disk here must read as "slow, not
+                # dead" to the coordinator's liveness tracker
+                with busy.window():
+                    if self.io_delay > 0:
+                        time.sleep(self.io_delay)
+                    self._write(snap)
+            except Exception:  # noqa: BLE001 — a failed checkpoint
+                # write must never kill training; the previous complete
+                # manifest remains the recovery point
+                self._errors += 1
+                self._log.warning("checkpoint: write failed",
+                                  exc_info=True)
+            finally:
+                with self._cond:
+                    self._writing = False
+                    self._cond.notify_all()
+
+    def _write(self, snap):
+        import jax
+
+        from horovod_tpu.sharding.zero import flat_shard
+
+        step, epoch = snap["step"], snap["epoch"]
+        rank, world = snap["rank"], snap["world"]
+        flat, n_params = _flatten_params(snap["params"])
+        payload = {"params": (flat_shard(flat, world, rank)
+                              if flat is not None else
+                              np.zeros((0,), np.float32))}
+
+        opt, opt_kind, opt_num = snap["opt"], "none", 0
+        if opt is not None:
+            leaves = jax.tree_util.tree_leaves(opt)
+            opt_num = len(leaves)
+            sharded, rest = {}, {}
+            if snap["opt_full"]:
+                opt_kind = "full"
+                for i, leaf in enumerate(leaves):
+                    arr = np.asarray(leaf)
+                    if arr.ndim == 1 and arr.shape[0] == n_params:
+                        sharded[str(i)] = flat_shard(arr, world, rank)
+                    elif rank == 0:
+                        rest[str(i)] = arr
+            else:
+                opt_kind = "replicated"
+                if rank == 0:
+                    rest = {str(i): np.asarray(leaf)
+                            for i, leaf in enumerate(leaves)}
+            payload["opt_sharded"] = sharded
+            payload["opt_rest"] = rest
+
+        store.write_shard(self._dir, step, epoch, world, rank, payload)
+        if rank == 0:
+            # manifest last: readers treat its presence as "worth
+            # validating", and validation still demands all W shards
+            store.write_manifest(
+                self._dir, step, epoch, world,
+                extra={"n_params": n_params, "opt_kind": opt_kind,
+                       "opt_num_leaves": opt_num})
+        self._prune(rank, keep_key=(step, epoch))
+
+    def _prune(self, rank, keep_key):
+        if self._keep <= 0:
+            return
+        own = [k for k in store.list_own_shards(self._dir, rank)]
+        # group by (step, epoch) newest first; keep the newest N groups
+        groups = sorted({(s, e) for s, e, _w in own}, reverse=True)
+        dead = set(groups[self._keep:])
+        for s, e, w in own:
+            if (s, e) in dead:
+                store.remove_shard(self._dir, s, e, w, rank)
+                if rank == 0:
+                    store.remove_manifest(self._dir, s, e, w)
+
+    # ------------------------------------------------------------ resume side
+    def restore_latest(self, state):
+        """Install the newest COMPLETE checkpoint as ``state``'s
+        committed snapshot and roll the live state onto it.  Walks past
+        incomplete/corrupt manifests (truncated shard, bad digest,
+        shape mismatch with the current model).  Returns ``(step,
+        epoch)`` or None.  Call on ONE rank (the sync root) before the
+        driver's first ``sync()`` — the sync broadcast distributes and
+        re-shards for everyone else."""
+        for step, epoch, world in store.list_manifests(self._dir):
+            try:
+                result = self._restore_one(state, step, epoch, world)
+            except (store.CorruptShardError, OSError, ValueError,
+                    KeyError) as exc:
+                self._log.warning(
+                    "checkpoint: manifest step=%d epoch=%d world=%d "
+                    "unusable (%s); trying previous", step, epoch,
+                    world, exc)
+                continue
+            if result is not None:
+                self._last_step = step
+                self._log.warning(
+                    "checkpoint: resumed from step %d (epoch %d, "
+                    "written at world %d)", step, epoch, world)
+                return result
+        return None
+
+    def _restore_one(self, state, step, epoch, world):
+        import jax
+
+        manifest = store.read_manifest(self._dir, step, epoch, world)
+        shards = [store.read_shard(self._dir, step, epoch, world, r)
+                  for r in range(world)]
+
+        flat = np.concatenate([np.asarray(s["params"]) for s in shards])
+        n_params = int(manifest.get("n_params", flat.size))
+        if flat.size != n_params:
+            raise ValueError(
+                f"assembled {flat.size} params, manifest records "
+                f"{n_params}")
+        if state.params is not None:
+            from jax.flatten_util import ravel_pytree
+
+            live_flat, unravel = ravel_pytree(state.params)
+            if int(live_flat.size) != n_params:
+                raise ValueError(
+                    f"checkpoint holds {n_params} params but the live "
+                    f"model has {int(live_flat.size)}")
+            params = jax.tree_util.tree_map(
+                np.asarray, unravel(flat.astype(live_flat.dtype)))
+        elif n_params:
+            raise ValueError(
+                "checkpoint holds params but the live State has none")
+        else:
+            params = None
+
+        opt_kind = manifest.get("opt_kind", "none")
+        opt, opt_full = None, False
+        if opt_kind != "none":
+            if state.optimizer_state is None:
+                raise ValueError(
+                    "checkpoint holds optimizer state but the live "
+                    "State has none")
+            treedef = jax.tree_util.tree_structure(
+                state.optimizer_state)
+            num = int(manifest.get("opt_num_leaves",
+                                   treedef.num_leaves))
+            if num != treedef.num_leaves:
+                raise ValueError(
+                    f"checkpoint optimizer tree has {num} leaves, the "
+                    f"live one {treedef.num_leaves}")
+            leaves = []
+            for i in range(num):
+                key = str(i)
+                if key in shards[0].get("opt_sharded", {}):
+                    leaves.append(np.concatenate(
+                        [np.asarray(s["opt_sharded"][key])
+                         for s in shards]))
+                elif key in shards[0].get("opt_rest", {}):
+                    leaves.append(
+                        np.asarray(shards[0]["opt_rest"][key]))
+                else:
+                    raise ValueError(
+                        f"optimizer leaf {i} missing from checkpoint")
+            opt = jax.tree_util.tree_unflatten(treedef, leaves)
+            opt_full = opt_kind == "full"
+
+        state._committed = (params, opt, int(step), int(epoch))
+        state._opt_full = opt_full
+        state.restore()
+        return int(step), int(epoch)

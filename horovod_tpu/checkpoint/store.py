@@ -1,0 +1,186 @@
+"""Durable checkpoint file formats (docs/checkpoint.md).
+
+On-disk layout inside ``HVD_TPU_CKPT_DIR``::
+
+    s{step:012d}-e{epoch}-w{world}-r{rank}.shard       one per rank
+    s{step:012d}-e{epoch}-w{world}-r{rank}.meta.json   sha256 + size
+    manifest-s{step:012d}-e{epoch}-w{world}.json       rank 0, written last
+
+Every file is written tmp + ``os.replace`` (atomic on POSIX), and the
+meta sidecar lands AFTER its shard — so the digest only ever describes
+a fully-renamed shard.  Completeness is a READ-time property: a
+manifest is usable iff all ``world`` shards exist and every shard's
+bytes hash to its recorded digest.  A job killed mid-write therefore
+leaves a manifest that simply fails validation and the reader falls
+back to the previous complete one; nothing needs fsync-ordered
+bookkeeping beyond the rename barrier.
+"""
+
+import hashlib
+import json
+import os
+import re
+
+_SHARD_RE = re.compile(
+    r"^s(\d{12})-e(\d+)-w(\d+)-r(\d+)\.shard$")
+_MANIFEST_RE = re.compile(
+    r"^manifest-s(\d{12})-e(\d+)-w(\d+)\.json$")
+
+MANIFEST_FORMAT = 1
+
+
+class CorruptShardError(RuntimeError):
+    """A shard (or its meta sidecar) is missing, truncated, or fails
+    its digest — the enclosing manifest is incomplete."""
+
+
+def shard_name(step, epoch, world, rank) -> str:
+    return f"s{step:012d}-e{epoch}-w{world}-r{rank}.shard"
+
+
+def manifest_name(step, epoch, world) -> str:
+    return f"manifest-s{step:012d}-e{epoch}-w{world}.json"
+
+
+def _codec():
+    """Payload codec: flax msgpack when present (the jax toolchain
+    ships it), stdlib pickle otherwise.  Recorded per shard so a reader
+    never guesses."""
+    try:
+        import flax.serialization  # noqa: F401
+        return "msgpack"
+    except ImportError:
+        return "pickle"
+
+
+def _dumps(obj, codec):
+    if codec == "msgpack":
+        from flax.serialization import msgpack_serialize
+        return msgpack_serialize(obj)
+    import pickle
+    return pickle.dumps(obj)
+
+
+def _loads(blob, codec):
+    if codec == "msgpack":
+        from flax.serialization import msgpack_restore
+        return msgpack_restore(blob)
+    import pickle
+    # wire-safe: not wire input — a local checkpoint file this process
+    # (or a prior incarnation of this job) wrote, sha256-verified
+    # against its meta sidecar before reaching the unpickler
+    return pickle.loads(blob)
+
+
+def _atomic_write(path, data: bytes):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_shard(directory, step, epoch, world, rank, payload: dict):
+    """Serialize ``payload`` into this rank's shard + meta sidecar."""
+    codec = _codec()
+    blob = _dumps(payload, codec)
+    name = shard_name(step, epoch, world, rank)
+    path = os.path.join(directory, name)
+    _atomic_write(path, blob)
+    meta = {"sha256": hashlib.sha256(blob).hexdigest(),
+            "bytes": len(blob), "codec": codec}
+    _atomic_write(f"{path}.meta.json",
+                  json.dumps(meta).encode())
+    return name
+
+
+def read_shard(directory, step, epoch, world, rank) -> dict:
+    """Load + digest-verify one shard; :class:`CorruptShardError` on
+    any missing/torn/forged piece."""
+    name = shard_name(step, epoch, world, rank)
+    path = os.path.join(directory, name)
+    try:
+        with open(f"{path}.meta.json", "rb") as f:
+            meta = json.loads(f.read().decode())
+        with open(path, "rb") as f:
+            blob = f.read()
+    except (OSError, ValueError) as exc:
+        raise CorruptShardError(f"{name}: {exc}") from exc
+    if len(blob) != int(meta.get("bytes", -1)):
+        raise CorruptShardError(
+            f"{name}: {len(blob)} bytes on disk, meta records "
+            f"{meta.get('bytes')}")
+    if hashlib.sha256(blob).hexdigest() != meta.get("sha256"):
+        raise CorruptShardError(f"{name}: sha256 mismatch")
+    try:
+        return _loads(blob, meta.get("codec", "msgpack"))
+    except Exception as exc:  # noqa: BLE001 — a undecodable payload
+        # with a VALID digest is a writer bug, but the reader's
+        # contract is the same: fall back
+        raise CorruptShardError(f"{name}: undecodable: {exc}") from exc
+
+
+def write_manifest(directory, step, epoch, world, extra=None):
+    body = {"format": MANIFEST_FORMAT, "step": int(step),
+            "epoch": int(epoch), "world_size": int(world)}
+    body.update(extra or {})
+    _atomic_write(os.path.join(directory,
+                               manifest_name(step, epoch, world)),
+                  json.dumps(body).encode())
+
+
+def read_manifest(directory, step, epoch, world) -> dict:
+    path = os.path.join(directory, manifest_name(step, epoch, world))
+    with open(path, "rb") as f:
+        return json.loads(f.read().decode())
+
+
+def list_manifests(directory):
+    """All manifests, newest (step, epoch) first: ``[(step, epoch,
+    world), ...]``."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _MANIFEST_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), int(m.group(2)),
+                        int(m.group(3))))
+    return sorted(out, reverse=True)
+
+
+def list_own_shards(directory, rank):
+    """This rank's shard keys, newest first: ``[(step, epoch, world)]``
+    — pruning input."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _SHARD_RE.match(name)
+        if m and int(m.group(4)) == rank:
+            out.append((int(m.group(1)), int(m.group(2)),
+                        int(m.group(3))))
+    return sorted(out, reverse=True)
+
+
+def remove_shard(directory, step, epoch, world, rank):
+    path = os.path.join(directory,
+                        shard_name(step, epoch, world, rank))
+    for p in (path, f"{path}.meta.json"):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+def remove_manifest(directory, step, epoch, world):
+    try:
+        os.remove(os.path.join(directory,
+                               manifest_name(step, epoch, world)))
+    except OSError:
+        pass

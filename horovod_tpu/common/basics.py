@@ -189,6 +189,45 @@ def init(comm=None, controller=None):
 
         _state = _GlobalState(topology, devices, config, executor, impl,
                               timeline)
+        _maybe_install_drain(config)
+
+
+def _maybe_install_drain(config):
+    """Arm the SIGTERM→graceful-drain handler (docs/checkpoint.md) when
+    the runtime can actually honor it: multi-process tcp jobs with
+    ``HVD_TPU_DRAIN`` on.  Elsewhere SIGTERM keeps its default (kill)
+    disposition — a single process has nobody to announce departure to,
+    and the in-process controllers have no coordinator."""
+    if not (config.drain and config.controller == "tcp"
+            and _state is not None and _state.topology.mode == "process"
+            and _state.topology.size > 1):
+        return
+    from horovod_tpu.common import drain as drain_mod
+    # resolved at signal time: reconfiguration replaces the controller
+    drain_mod.install(
+        lambda: _state.controller if _state is not None else None)
+
+
+def _drained_teardown():
+    """Quietly dismantle this process's runtime after a granted drain:
+    the rank has already left the membership, so there are no job-end
+    barriers to run — close transports, flush the timeline, drop the
+    global state so atexit paths see an uninitialized runtime."""
+    global _state
+    with _state_lock:
+        if _state is None:
+            return
+        try:
+            _state.controller.close_for_reconfig()
+        except Exception:  # noqa: BLE001 — leaving a world that has
+            # already reconfigured past us
+            get_logger().debug("drain teardown error", exc_info=True)
+        try:
+            _state.timeline.close()
+        except Exception:  # noqa: BLE001 — best-effort flush
+            get_logger().debug("drain timeline close error",
+                               exc_info=True)
+        _state = None
 
 
 def shutdown():
@@ -285,6 +324,7 @@ def _elastic_join_init(epoch, members):
                               timeline)
         _state.worker_id = wid
         _state.epoch = epoch
+        _maybe_install_drain(config)
         get_logger().warning(
             "elastic: worker %d joined at epoch %d as rank %d/%d",
             wid, epoch, new_rank, len(members))

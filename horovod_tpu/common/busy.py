@@ -1,0 +1,35 @@
+"""Busy windows: tell the liveness tracker "slow, not dead".
+
+A rank inside a checkpoint shard write or a drain teardown can stall
+its heartbeat loop behind disk I/O for longer than the liveness window;
+without this, a clean drain or a routine snapshot converts into a
+coordinated abort (docs/checkpoint.md).  The heartbeat loop stamps
+every ``HeartbeatMsg`` with :func:`active`, and the coordinator doubles
+the liveness deadline for ranks whose last heartbeat was busy-flagged.
+
+Cheap and lock-light: a counter under a lock, nested windows allowed.
+"""
+
+import contextlib
+import threading
+
+_lock = threading.Lock()
+_depth = 0
+
+
+@contextlib.contextmanager
+def window():
+    """Mark this process busy (slow I/O expected) for the duration."""
+    global _depth
+    with _lock:
+        _depth += 1
+    try:
+        yield
+    finally:
+        with _lock:
+            _depth -= 1
+
+
+def active() -> bool:
+    with _lock:
+        return _depth > 0

@@ -66,6 +66,16 @@ class Config:
     zero: bool = False
     zero_min_size: int = env_util.DEFAULT_ZERO_MIN_SIZE
     executor: str = "psum"
+    # Preemption-aware drain + durable checkpointing
+    # (docs/checkpoint.md): ``drain`` converts a worker SIGTERM (the
+    # preemption notice) into a planned departure; ``ckpt_dir`` enables
+    # the background sharded checkpoint writer, snapshotting every
+    # ``ckpt_interval_steps`` committed steps and keeping ``ckpt_keep``
+    # complete checkpoints.
+    drain: bool = True
+    ckpt_dir: str | None = None
+    ckpt_interval_steps: int = env_util.DEFAULT_CKPT_INTERVAL_STEPS
+    ckpt_keep: int = env_util.DEFAULT_CKPT_KEEP
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -139,6 +149,14 @@ class Config:
                 env_util.DEFAULT_ZERO_MIN_SIZE),
             executor=_validated_executor(env_util.get_str(
                 env_util.HVD_TPU_EXECUTOR, "psum")),
+            drain=env_util.get_bool(env_util.HVD_TPU_DRAIN, True),
+            ckpt_dir=env_util.get_str(env_util.HVD_TPU_CKPT_DIR),
+            ckpt_interval_steps=max(1, env_util.get_int(
+                env_util.HVD_TPU_CKPT_INTERVAL,
+                env_util.DEFAULT_CKPT_INTERVAL_STEPS)),
+            ckpt_keep=_validated_nonneg(
+                env_util.HVD_TPU_CKPT_KEEP,
+                env_util.DEFAULT_CKPT_KEEP),
         )
 
 

@@ -1,0 +1,100 @@
+"""Graceful drain: turn SIGTERM (the TPU preemption notice) into a
+planned departure instead of a crash.
+
+Protocol (docs/checkpoint.md): the handler marks the process draining
+and a notifier thread announces departure to the rank-0 coordinator
+(``controller.request_drain()`` → ``DrainMsg``).  The coordinator
+excludes the rank from liveness blame, plans an elastic
+reconfiguration WITHOUT this rank, waits for the next collective
+boundary, and publishes the drain-marked directive.  Survivors re-form
+via the ordinary elastic path; the draining rank sees the directive at
+its next collective, tears down, and leaves with
+:class:`~horovod_tpu.common.handles.HvdDrainedError` — exit 0, zero
+``HvdAbortedError`` anywhere.
+
+When the coordinator refuses the drain (rank 0 itself, elastic off,
+survivors would drop below min_ranks) the preemption is not
+survivable: the process exits 143 (SIGTERM's conventional code), which
+the launcher attributes exactly like the real preemption death it
+models.
+"""
+
+import os
+import signal
+import sys
+import threading
+
+from horovod_tpu.common import busy
+
+_requested = threading.Event()
+_installed_lock = threading.Lock()
+_installed = False
+
+
+def requested() -> bool:
+    """True once this process has received its preemption notice."""
+    return _requested.is_set()
+
+
+def reset():
+    """Test hook: forget a previous drain request / installation."""
+    global _installed
+    _requested.clear()
+    with _installed_lock:
+        _installed = False
+
+
+def _notify(get_controller):
+    # Slow-by-design window: announcing + waiting for the coordinator's
+    # boundary ack can take seconds; don't let it read as death.
+    with busy.window():
+        controller = get_controller()
+        ok = False
+        if controller is not None:
+            try:
+                ok = controller.request_drain()
+            except Exception as exc:  # noqa: BLE001 — a dead
+                # coordinator while we're being preempted: nothing to
+                # drain into, fall through to the unsurvivable path
+                print(f"[hvd-drain] drain announce failed: {exc}",
+                      file=sys.stderr, flush=True)
+    if ok:
+        print("[hvd-drain] departure announced; leaving at the next "
+              "collective boundary", file=sys.stderr, flush=True)
+        return
+    print("[hvd-drain] drain refused/impossible; exiting as preempted",
+          file=sys.stderr, flush=True)
+    os._exit(143)
+
+
+def install(get_controller) -> bool:
+    """Install the drain SIGTERM handler (``hvd.init()`` calls this when
+    ``config.drain`` and the controller supports ``request_drain``).
+
+    ``get_controller`` is a zero-arg callable resolved at SIGNAL time —
+    an elastic reconfiguration replaces the controller object, and the
+    drain must talk to the current one.  Returns False when the handler
+    could not be installed (non-main thread)."""
+    global _installed
+    with _installed_lock:
+        if _installed:
+            return True
+
+        def _handler(signum, frame):
+            if _requested.is_set():
+                return  # duplicate notice: drain already in flight
+            _requested.set()
+            t = threading.Thread(target=_notify, args=(get_controller,),
+                                 name="hvd-drain", daemon=True)
+            # lifecycle: fire-and-forget by design — it either returns
+            # after a successful announce or ends the process itself
+            t.start()
+
+        try:
+            signal.signal(signal.SIGTERM, _handler)
+        except ValueError:
+            # not the main thread (embedded init): no drain handling,
+            # SIGTERM keeps its previous disposition
+            return False
+        _installed = True
+        return True

@@ -21,19 +21,23 @@ Grammar (one spec)::
               connect   (any control/data-plane TCP connection attempt)
     step    1-based hit count of that point in this process: the fault
             fires on exactly the step-th call
-    action  crash  — hard-exit the process (os._exit(1)): a dead rank
-            drop   — silently skip the operation: a silent packet/worker
-            refuse — raise ConnectionRefusedError: a transport blip
+    action  crash   — hard-exit the process (os._exit(1)): a dead rank
+            drop    — silently skip the operation: a silent packet/worker
+            refuse  — raise ConnectionRefusedError: a transport blip
+            preempt — SIGTERM to self: the TPU preemption notice; the
+                      operation itself proceeds, and the drain handler
+                      (docs/checkpoint.md) decides what happens next
 
 Counters are per-process and per-point.  The module is inert (one dict
 lookup per check) when no spec is configured.
 """
 
 import os
+import signal
 import sys
 import threading
 
-_ACTIONS = ("crash", "drop", "refuse")
+_ACTIONS = ("crash", "drop", "refuse", "preempt")
 
 
 class FaultSpec:
@@ -163,6 +167,16 @@ def check(point) -> bool:
     if action == "refuse":
         raise ConnectionRefusedError(
             f"injected connection refusal at {point} (HVD_TPU_FAULT_SPEC)")
+    if action == "preempt":
+        # Deliver the preemption notice the way the platform would:
+        # asynchronously, to this process, while the operation keeps
+        # going.  With drain enabled the installed handler turns this
+        # into a planned departure; without it, default disposition
+        # kills the process (same observable as the real thing).
+        print(f"[hvd-fault] preempting at {point} (injected SIGTERM)",
+              file=sys.stderr, flush=True)
+        os.kill(os.getpid(), signal.SIGTERM)
+        return False
     # crash: bypass every handler — this models a rank dying mid-step
     print(f"[hvd-fault] crashing at {point} (injected)",
           file=sys.stderr, flush=True)

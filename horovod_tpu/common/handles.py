@@ -40,12 +40,29 @@ class HvdReconfigureError(HvdAbortedError):
     letting the job die."""
 
     def __init__(self, origin_rank, reason, *, epoch, members, dead,
-                 cause=""):
+                 cause="", drain=False):
         super().__init__(origin_rank, reason)
         self.epoch = epoch          # new membership epoch to move to
         self.members = list(members)  # stable worker ids, new-rank order
         self.dead = list(dead)      # worker ids removed this epoch
         self.cause = cause          # the original (pre-rewrite) reason
+        self.drain = drain          # planned departure, not a failure
+
+
+class HvdDrainedError(HvdError):
+    """Raised on the DRAINING rank only, after it helped the survivors
+    reconfigure past it: this worker received a preemption notice
+    (SIGTERM), announced departure, and left at a collective boundary.
+    Deliberately NOT a subclass of :class:`HvdAbortedError` — a drain is
+    a success path, and the zero-``HvdAbortedError`` guarantee of the
+    drain protocol (docs/checkpoint.md) would be meaningless if the
+    drained rank itself raised one.  ``hvd.elastic.run`` catches it and
+    returns; bare workers can treat it as "stop training, exit 0"."""
+
+    def __init__(self, worker_id):
+        super().__init__(
+            f"worker {worker_id} drained after preemption notice")
+        self.worker_id = worker_id
 
 
 # Elastic reconfiguration directives ride the existing abort fan-out
@@ -54,11 +71,30 @@ class HvdReconfigureError(HvdAbortedError):
 RECONFIG_MARKER = "__hvd_elastic_reconfig__:"
 
 
-def encode_reconfig_reason(epoch, members, dead, cause):
-    """Serialize a membership directive into an abort ``reason``."""
-    return RECONFIG_MARKER + json.dumps(
-        {"epoch": epoch, "members": list(members), "dead": list(dead),
-         "cause": str(cause)})
+def encode_reconfig_reason(epoch, members, dead, cause, drain=False):
+    """Serialize a membership directive into an abort ``reason``.
+
+    ``drain=True`` marks a PLANNED departure: delivery skips the rank-0
+    peer fan-out (the directive reaches every rank at its next
+    collective / heartbeat anyway) and the departing worker leaves with
+    :class:`HvdDrainedError` instead of an abort."""
+    payload = {"epoch": epoch, "members": list(members),
+               "dead": list(dead), "cause": str(cause)}
+    if drain:
+        payload["drain"] = True
+    return RECONFIG_MARKER + json.dumps(payload)
+
+
+def is_drain_reason(reason) -> bool:
+    """True when ``reason`` is a drain-marked membership directive."""
+    if not (isinstance(reason, str)
+            and reason.startswith(RECONFIG_MARKER)):
+        return False
+    try:
+        return bool(json.loads(
+            reason[len(RECONFIG_MARKER):]).get("drain"))
+    except (ValueError, AttributeError):
+        return False
 
 
 def make_abort_error(origin_rank, reason):
@@ -72,7 +108,8 @@ def make_abort_error(origin_rank, reason):
             return HvdReconfigureError(
                 origin_rank, reason, epoch=d["epoch"],
                 members=d["members"], dead=d.get("dead", ()),
-                cause=d.get("cause", ""))
+                cause=d.get("cause", ""),
+                drain=bool(d.get("drain", False)))
         except (ValueError, KeyError, TypeError):
             pass  # malformed directive degrades to a plain abort
     return HvdAbortedError(origin_rank, reason)

@@ -23,6 +23,7 @@ import time
 
 from horovod_tpu.common import basics
 from horovod_tpu.common.handles import (HvdAbortedError,
+                                        HvdDrainedError,
                                         HvdReconfigureError)
 from horovod_tpu.elastic.membership import (ELASTIC_SCOPE, JOIN_SCOPE,
                                             MEMBERSHIP_KEY,
@@ -33,7 +34,22 @@ from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils.logging import get_logger
 
 __all__ = ["State", "run", "reconfigure", "wait_for_membership",
-           "worker_id", "HvdReconfigureError", "ElasticContext"]
+           "worker_id", "DRAINED", "HvdReconfigureError",
+           "HvdDrainedError", "ElasticContext"]
+
+
+class _Drained:
+    """Falsy singleton ``run`` returns when THIS rank left via a granted
+    drain — distinguishable from a train function that returns None."""
+
+    def __repr__(self):
+        return "hvd.elastic.DRAINED"
+
+    def __bool__(self):
+        return False
+
+
+DRAINED = _Drained()
 
 
 def worker_id() -> int:
@@ -46,9 +62,18 @@ def worker_id() -> int:
 def reconfigure(exc: HvdReconfigureError):
     """Apply a received reconfiguration directive: survivors re-form at
     the directive's epoch; a worker voted out of the membership raises
-    the underlying abort instead."""
+    the underlying abort instead — unless it left on PURPOSE (a granted
+    drain after a preemption notice, docs/checkpoint.md), which tears
+    down quietly and raises :class:`HvdDrainedError` so ``run`` can
+    report a clean exit instead of a failure."""
+    from horovod_tpu.common import drain as drain_mod
+
     wid = basics.worker_id()
     if wid not in exc.members:
+        if (getattr(exc, "drain", False)
+                and (wid in exc.dead or drain_mod.requested())):
+            basics._drained_teardown()
+            raise HvdDrainedError(wid) from exc
         raise HvdAbortedError(
             exc.origin_rank,
             f"worker {wid} evicted from elastic membership at epoch "
@@ -61,23 +86,49 @@ def run(fn, state, *args, **kwargs):
     every member first, then on each reconfiguration signal re-form the
     world, roll back to the last commit, re-sync, and retry ``fn``.
     Any other error (including a fatal ``HvdAbortedError``) propagates
-    unchanged — elastic never swallows a non-survivable failure."""
+    unchanged — elastic never swallows a non-survivable failure.
+
+    Durable checkpointing (docs/checkpoint.md): when ``ckpt_dir`` is
+    configured a :class:`~horovod_tpu.checkpoint.CheckpointManager` is
+    attached to ``state`` for the duration of the call, the sync root
+    auto-resumes from the newest complete checkpoint before the first
+    sync (the broadcast distributes it), and a granted drain flushes
+    pending writes before ``run`` returns :data:`DRAINED`."""
+    from horovod_tpu import checkpoint as ckpt_mod
+
     log = get_logger()
-    pending_sync = True
-    while True:
-        try:
-            if pending_sync:
-                state.sync()
-                pending_sync = False
-            return fn(state, *args, **kwargs)
-        except HvdReconfigureError as exc:
-            log.warning(
-                "elastic: reconfiguration signal at step %s (epoch %d, "
-                "members %s); re-forming", getattr(state, "step", "?"),
-                exc.epoch, exc.members)
-            reconfigure(exc)
-            state.restore()
-            pending_sync = True
+    manager = None
+    if state._ckpt is None:
+        manager = ckpt_mod.manager_from_env()
+        if manager is not None:
+            state.attach_checkpoint(manager)
+            # only the sync root reads the checkpoint; everyone else
+            # receives the resumed state through the first sync()
+            if not basics.is_initialized() or basics.rank() == 0:
+                manager.restore_latest(state)
+    try:
+        pending_sync = True
+        while True:
+            try:
+                if pending_sync:
+                    state.sync()
+                    pending_sync = False
+                return fn(state, *args, **kwargs)
+            except HvdReconfigureError as exc:
+                log.warning(
+                    "elastic: reconfiguration signal at step %s "
+                    "(epoch %d, members %s); re-forming",
+                    getattr(state, "step", "?"), exc.epoch, exc.members)
+                reconfigure(exc)
+                state.restore()
+                pending_sync = True
+    except HvdDrainedError as exc:
+        log.warning("elastic: %s; leaving run cleanly", exc)
+        return DRAINED
+    finally:
+        if manager is not None:
+            state.attach_checkpoint(None)
+            manager.close()
 
 
 def _rendezvous_contract():

@@ -71,7 +71,28 @@ class ElasticContext:
                 self._decided = True
             return self._planned
 
-    def _plan_locked(self, origin_rank, reason):  # holds: self._lock
+    def plan_drain(self, origin_rank):
+        """Plan a PLANNED departure (graceful drain after a preemption
+        notice, docs/checkpoint.md): same survivor math as :meth:`plan`
+        but the directive is drain-marked — nothing failed, nobody is
+        blamed, delivery skips the abort fan-out.  A drain racing an
+        already-decided plan is refused (None): the membership change in
+        flight wins and the preempted rank leaves as an ordinary loss."""
+        with self._lock:
+            if self._decided:
+                return None
+            wid = (self._members[origin_rank]
+                   if 0 <= origin_rank < len(self._members)
+                   else origin_rank)
+            cause = (f"worker {wid} drained after preemption notice "
+                     f"(SIGTERM)")
+            self._planned = self._plan_locked(origin_rank, cause,
+                                              drain=True)
+            self._decided = True
+            return self._planned
+
+    def _plan_locked(self, origin_rank, reason,
+                     drain=False):  # holds: self._lock
         if isinstance(reason, str) \
                 and reason.startswith(USER_ABORT_PREFIX):
             return None  # explicit kill switch: never rescued
@@ -96,12 +117,14 @@ class ElasticContext:
                                    self._max_ranks - len(survivors))]
         new_members = survivors + joiners
         new_epoch = self._epoch + 1
-        self._publish(new_epoch, new_members)
+        self._publish(new_epoch, new_members, admitted=joiners)
         self._log.warning(
-            "elastic: worker %d lost (%s); reconfiguring to epoch %d "
-            "with members %s", dead_wid, reason, new_epoch, new_members)
+            "elastic: worker %d %s (%s); reconfiguring to epoch %d "
+            "with members %s", dead_wid,
+            "draining" if drain else "lost", reason, new_epoch,
+            new_members)
         return encode_reconfig_reason(new_epoch, new_members,
-                                      [dead_wid], reason)
+                                      [dead_wid], reason, drain=drain)
 
     def _registered_joiners(self, exclude):
         """Worker ids registered under the join scope, admission order
@@ -125,10 +148,13 @@ class ElasticContext:
                 out.append(wid)
         return sorted(out)
 
-    def _publish(self, epoch, members):
+    def _publish(self, epoch, members, admitted=()):
         """Advertise the admitted membership for polling joiners.  A
         publish failure only costs this window's admissions — survivors
-        get the directive via the abort fan-out regardless."""
+        get the directive via the abort fan-out regardless.  Admitted
+        joiners' registration keys are dropped from the join scope so a
+        LATER reconfiguration can't re-admit an id that is already a
+        member (and the scope doesn't accumulate for the job's life)."""
         if self._rendezvous is None:
             return
         from horovod_tpu.run import http_client
@@ -141,3 +167,10 @@ class ElasticContext:
             self._log.warning(
                 "elastic: could not publish membership for epoch %d",
                 epoch, exc_info=True)
+        for wid in admitted:
+            try:
+                http_client.delete(addr, port, JOIN_SCOPE, str(wid),
+                                   retry_for=2.0)
+            except Exception:  # noqa: BLE001 — a stale join key is
+                # filtered by the exclude set next window anyway
+                pass

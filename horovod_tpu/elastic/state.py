@@ -51,6 +51,7 @@ class State:
                               else int(zero_n_params))
         self._committed = None
         self._opt_full = False   # committed opt tree is gathered (full)
+        self._ckpt = None        # CheckpointManager (docs/checkpoint.md)
         # the constructor snapshot is LOCAL (no collectives): a late
         # joiner builds its State while incumbents are elsewhere, so a
         # gather here could not pair; the first in-loop commit() (or the
@@ -84,6 +85,19 @@ class State:
             full = True
         self._committed = (params, opt, self.step, self.epoch)
         self._opt_full = full
+        # durable checkpointing piggybacks on the commit snapshot: the
+        # writer thread serializes the SAME double buffer the elastic
+        # rollback uses, so no extra copy and no torn reads.  Local
+        # (constructor) commits are skipped — nothing recoverable yet.
+        if self._ckpt is not None and not _local:
+            self._ckpt.maybe_save(self)
+
+    def attach_checkpoint(self, manager):
+        """Wire a :class:`horovod_tpu.checkpoint.CheckpointManager` into
+        the commit path (``elastic.run`` does this when ``ckpt_dir`` is
+        configured).  Returns the previous manager, if any."""
+        prev, self._ckpt = self._ckpt, manager
+        return prev
 
     def restore(self):
         params, opt, step, epoch = self._committed

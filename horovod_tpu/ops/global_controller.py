@@ -705,6 +705,14 @@ class GlobalMeshController(PythonController):
                 self._client_addrs, self._key, timeout=30)
         return self._client_obj
 
+    def request_drain(self) -> bool:
+        """Graceful drain is a tcp-controller capability: the gmesh data
+        plane is a single compiled XLA program over a FIXED global mesh —
+        jax.distributed cannot shrink the mesh mid-job, so a preempted
+        process cannot be drained around (docs/checkpoint.md).  Always
+        False; the launcher-side grace window still applies."""
+        return False
+
     def abort(self, origin_rank, reason):
         """Broadcast a coordinated abort: best-effort notify the
         metadata coordinator (which relays the globally-ordered abort

@@ -220,6 +220,14 @@ class PythonController:
                         "horovod_tpu has been shut down")
             self._table.clear()
 
+    def request_drain(self) -> bool:
+        """Graceful-drain announcement (docs/checkpoint.md): the
+        in-process controllers coordinate device ranks inside ONE
+        process, so there is no coordinator to notify and no survivor
+        set to re-form — a preemption notice here simply ends the
+        process.  Always False (drain impossible)."""
+        return False
+
     # ----------------------------------------------------------------- abort
     def abort(self, origin_rank, reason):
         """Coordinated abort (``hvd.abort()`` / a rank detecting an

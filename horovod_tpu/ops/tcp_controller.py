@@ -41,8 +41,9 @@ import time
 
 import numpy as np
 
-from horovod_tpu.common import faults
-from horovod_tpu.common.handles import (HvdAbortedError, HvdError,
+from horovod_tpu.common import busy, faults
+from horovod_tpu.common.handles import (RECONFIG_MARKER, HvdAbortedError,
+                                        HvdError, is_drain_reason,
                                         make_abort_error)
 from horovod_tpu.common.ops_enum import (ReduceOp, RequestType,
                                          is_float_dtype,
@@ -126,6 +127,21 @@ class ShutdownMsg:
         self.rank = rank  # deregisters the rank from liveness tracking
 
 
+class DrainMsg:
+    """A rank announces planned departure: it received the preemption
+    notice (SIGTERM) and asks the coordinator to reconfigure the job
+    without it at the next collective boundary (docs/checkpoint.md)."""
+
+    def __init__(self, rank):
+        self.rank = rank
+
+
+class DrainAck:
+    def __init__(self, ok, reason=""):
+        self.ok = ok          # False: drain not survivable, die as preempted
+        self.reason = reason
+
+
 def _wire_dtype(arr):
     """(native-endian array, wire dtype string).  Extension dtypes
     (bfloat16) have opaque ``.str`` so they travel by name; fixed-width
@@ -205,6 +221,15 @@ class CoordinatorService(network.MuxService):
         self._join_waiters = []
         # rank -> monotonic ts of last message; guarded by self._cv
         self._last_seen = {}
+        # ranks whose LAST heartbeat carried the busy flag (checkpoint
+        # write / drain teardown in progress): liveness doubles their
+        # deadline so slow disk I/O can't read as death; guarded by
+        # self._cv
+        self._busy_ranks = set()
+        # ranks that announced a graceful drain: excluded from liveness
+        # blame entirely — silence is their planned departure, not a
+        # death to abort over; guarded by self._cv
+        self._draining = set()
         # (origin_rank, reason), sticky: written once under self._cv;
         # guarded by self._cv (the lock-free reads below are annotated —
         # a stale None is at worst one poll late, never wrong)
@@ -224,10 +249,19 @@ class CoordinatorService(network.MuxService):
         if rank is not None:
             with self._cv:
                 self._last_seen[rank] = time.monotonic()
+                if isinstance(req, network.HeartbeatMsg):
+                    # getattr: a pre-busy-field peer's heartbeat simply
+                    # never widens its window
+                    if getattr(req, "busy", False):
+                        self._busy_ranks.add(rank)
+                    else:
+                        self._busy_ranks.discard(rank)
         if isinstance(req, CollectiveMsg):
             return self._handle_collective(req)
         if isinstance(req, JoinMsg):
             return self._handle_join(req)
+        if isinstance(req, DrainMsg):
+            return self._handle_drain(req)
         if isinstance(req, network.HeartbeatMsg):
             self._check_liveness()
             # sticky set-once flag: a stale None here is one heartbeat
@@ -244,6 +278,8 @@ class CoordinatorService(network.MuxService):
             if req.rank is not None:
                 with self._cv:
                     self._last_seen.pop(req.rank, None)
+                    self._busy_ranks.discard(req.rank)
+                    self._draining.discard(req.rank)
             return network.AckResponse()
         return super()._handle(req, client_address)
 
@@ -271,8 +307,12 @@ class CoordinatorService(network.MuxService):
         # plan() runs outside the lock (it talks to the rendezvous
         # server); idempotence is re-checked under the lock, and the
         # plan itself is sticky, so a racing second abort just reads
-        # the cached directive
-        if self._elastic is not None and self._abort is None:  # hvd-lint: ignore[lock-discipline]
+        # the cached directive.  A reason that already IS a directive
+        # (the drain path planned before calling here) passes through
+        # unchanged.
+        if (self._elastic is not None and self._abort is None  # hvd-lint: ignore[lock-discipline]
+                and not (isinstance(reason, str)
+                         and reason.startswith(RECONFIG_MARKER))):
             planned = self._elastic.plan(origin_rank, reason)
             if planned is not None:
                 reason = planned
@@ -297,17 +337,62 @@ class CoordinatorService(network.MuxService):
             slot[0] = None  # join handler converts to a typed error
             event.set()
 
+    def _handle_drain(self, req):
+        """Graceful drain (docs/checkpoint.md): exempt the announcing
+        rank from liveness blame, plan a reconfiguration WITHOUT it,
+        wait for the next collective boundary, then publish the
+        directive through the ordinary abort delivery (minus the peer
+        fan-out — ``is_drain_reason`` delivery is pull-only).  Runs on
+        this request's own mux thread, so blocking here blocks nobody
+        else."""
+        rank = req.rank
+        with self._cv:
+            if self._abort is not None:
+                # a failure (or another drain) beat this announcement;
+                # the rank leaves through whatever is already in flight
+                return DrainAck(False, "abort already in flight")
+            self._draining.add(rank)
+        directive = (self._elastic.plan_drain(rank)
+                     if self._elastic is not None else None)
+        if directive is None:
+            with self._cv:
+                self._draining.discard(rank)
+            return DrainAck(
+                False, "drain not survivable: elastic disabled, "
+                       "coordinator rank, or too few survivors")
+        # collective boundary: no entry mid-negotiation.  Polled OUTSIDE
+        # _cv (the wait must not starve negotiations, and
+        # _initiate_abort below re-acquires it).  Bounded: a steady
+        # stream of collectives may never leave _forming observably
+        # empty, and a late directive is still correct — it just fails
+        # one in-flight round into the reconfiguration.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._cv:
+                if self._abort is not None or not self._forming:
+                    break
+            time.sleep(0.005)
+        self._initiate_abort(rank, directive)
+        return DrainAck(True)
+
     def _check_liveness(self):
         """Convert a silently-dead peer (no message within the liveness
-        window) into a coordinated abort instead of an indefinite wait."""
+        window) into a coordinated abort instead of an indefinite wait.
+
+        A rank whose last heartbeat was busy-flagged (checkpoint write /
+        drain teardown) gets a doubled window; a rank that announced a
+        drain is never blamed at all — its silence is the planned
+        departure."""
         # sticky-flag fast path; _initiate_abort re-checks under the lock
         if self._liveness <= 0 or self._abort is not None:  # hvd-lint: ignore[lock-discipline]
             return
         now = time.monotonic()
         with self._cv:
-            dead = sorted(r for r, ts in self._last_seen.items()
-                          if now - ts > self._liveness
-                          and r not in self._joined)
+            dead = sorted(
+                r for r, ts in self._last_seen.items()
+                if now - ts > self._liveness
+                * (2.0 if r in self._busy_ranks else 1.0)
+                and r not in self._joined and r not in self._draining)
         if dead:
             self._initiate_abort(
                 dead[0],
@@ -865,6 +950,22 @@ class TcpController:
                     CONTROLLER_KEY,
                     ";".join(f"{i}={ip}:{p}"
                              for i, ip, p in tagged).encode())
+                if self._epoch > 0:
+                    # dead-epoch cleanup: the previous memberships'
+                    # suffixed scopes would otherwise accumulate on the
+                    # rendezvous server for the life of the job.  Every
+                    # epoch < ours is torn down by construction (we are
+                    # the reconfigured successor); best-effort — a
+                    # leaked scope is garbage, not a correctness hazard.
+                    for e in range(self._epoch):
+                        suffix = "" if e == 0 else f".e{e}"
+                        for base in (CONTROLLER_SCOPE, PEERS_SCOPE,
+                                     TIMELINE_SCOPE):
+                            try:
+                                http_client.delete_scope(
+                                    addr, int(port), f"{base}{suffix}")
+                            except Exception:  # noqa: BLE001
+                                pass
             self._client_addrs = self._filter_ifaces(tagged)
         else:
             if addr is None:
@@ -1009,7 +1110,8 @@ class TcpController:
             while True:
                 try:
                     reply = hb_client.send(
-                        network.HeartbeatMsg(self._rank),
+                        network.HeartbeatMsg(self._rank,
+                                             busy=busy.active()),
                         timeout=max(interval * 2, 5.0))
                 except Exception as exc:  # noqa: BLE001 — outage
                     now = time.monotonic()
@@ -1080,9 +1182,15 @@ class TcpController:
         negotiation/join response).  Only rank 0 re-pushes to peers: its
         process HOSTS the coordinator, so its exit would cut the relay
         before slower ranks hear — every other rank can rely on its own
-        heartbeat, keeping the fan-out O(N) instead of O(N^2)."""
+        heartbeat, keeping the fan-out O(N) instead of O(N^2).
+
+        A drain-marked directive skips even that push: nothing crashed,
+        every rank is alive and heartbeating, so pull delivery reaches
+        everyone within one interval without the abort storm the drain
+        protocol exists to avoid."""
         self._local_abort(origin_rank, reason,
-                          fan_out=(self._rank == 0))
+                          fan_out=(self._rank == 0
+                                   and not is_drain_reason(reason)))
 
     def _push_abort_to_peers(self, origin_rank, reason, budget=2.0):
         """Best-effort direct abort fan-out to every peer's mailbox
@@ -1135,6 +1243,27 @@ class TcpController:
         (``hvd.abort()``); all ranks raise ``HvdAbortedError`` within
         the abort deadline."""
         self._report_abort(origin_rank, reason)
+
+    def request_drain(self) -> bool:
+        """Announce this rank's planned departure (preemption notice)
+        to the coordinator and wait for its verdict.  True: a boundary
+        reconfiguration without this rank is in flight — keep running
+        until the directive arrives.  False: the drain is not
+        survivable (single process, elastic off, coordinator rank, too
+        few survivors) and the caller should treat the preemption as
+        death."""
+        if self._size <= 1 or self._client_addrs is None:
+            return False
+        try:
+            # 30s cap: the coordinator's boundary wait is bounded at 5s,
+            # the rest is headroom for a loaded control plane
+            reply = self._client().send(DrainMsg(self._rank),
+                                        timeout=30.0)
+        except Exception as exc:  # noqa: BLE001 — a dead coordinator
+            # while this rank is being preempted: nothing to drain into
+            self._log.warning("drain announce failed: %s", exc)
+            return False
+        return bool(getattr(reply, "ok", False))
 
     # ------------------------------------------------------------ producer API
     def enqueue(self, request):

@@ -54,6 +54,13 @@ _PARAMS = {
     "max_ranks": (env_util.HVD_TPU_MAX_RANKS, "elastic.max_ranks"),
     "reconfig_timeout": (env_util.HVD_TPU_RECONFIG_TIMEOUT,
                          "elastic.reconfig_timeout"),
+    "term_grace": (env_util.HVD_TPU_TERM_GRACE,
+                   "fault_tolerance.term_grace"),
+    "drain": (env_util.HVD_TPU_DRAIN, "fault_tolerance.drain"),
+    "ckpt_dir": (env_util.HVD_TPU_CKPT_DIR, "checkpoint.dir"),
+    "ckpt_interval": (env_util.HVD_TPU_CKPT_INTERVAL,
+                      "checkpoint.interval"),
+    "ckpt_keep": (env_util.HVD_TPU_CKPT_KEEP, "checkpoint.keep"),
     "zero": (env_util.HVD_TPU_ZERO, "sharding.zero"),
     "zero_min_size": (env_util.HVD_TPU_ZERO_MIN_SIZE, "sharding.zero_min_size"),
     "executor": (env_util.HVD_TPU_EXECUTOR, "sharding.executor"),
@@ -69,6 +76,8 @@ _NEGATIONS = {
     "no_hierarchical_allreduce": env_util.HVD_HIERARCHICAL_ALLREDUCE,
     "no_hierarchical_allgather": env_util.HVD_HIERARCHICAL_ALLGATHER,
     "stall_check": env_util.HVD_STALL_CHECK_DISABLE,  # enable = disable-var 0
+    # drain defaults ON; the negation is the interesting direction
+    "no_drain": env_util.HVD_TPU_DRAIN,
 }
 
 

@@ -55,6 +55,13 @@ def delete(addr, port, scope, key, retry_for=DEFAULT_RETRY_FOR):
     request("DELETE", addr, port, scope, key, retry_for=retry_for)
 
 
+def delete_scope(addr, port, scope, retry_for=DEFAULT_RETRY_FOR):
+    """Drop ``scope`` and every key in it — the server's
+    ``/__scope__/<scope>`` purge endpoint (dead-epoch rendezvous
+    cleanup, docs/elastic.md)."""
+    request("DELETE", addr, port, "__scope__", scope, retry_for=retry_for)
+
+
 def list_keys(addr, port, scope, retry_for=DEFAULT_RETRY_FOR):
     """Key names currently present in ``scope`` (may be empty) — the
     server's ``/__list__/<scope>`` enumeration endpoint."""

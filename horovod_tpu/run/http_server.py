@@ -66,7 +66,13 @@ class _KVHandler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         scope, key = self._split()
         with self.server.kv_lock:
-            self.server.kv.get(scope, {}).pop(key, None)
+            if scope == "__scope__":
+                # whole-scope purge (mirrors the __list__ enumeration
+                # spelling): elastic reconfiguration drops the dead
+                # epochs' suffixed scopes in one request per scope
+                self.server.kv.pop(key, None)
+            else:
+                self.server.kv.get(scope, {}).pop(key, None)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()

@@ -96,8 +96,12 @@ def fault_crash_ranks(extra_env):
         specs = parse_fault_spec(spec_text)
     except ValueError:
         return frozenset()  # the workers will fail loudly at init
+    # preempt counts: with drain disabled it kills the rank just like a
+    # crash, and with drain enabled the rank exits 0 and never appears
+    # in the failure list at all
     return frozenset(s.rank for s in specs
-                     if s.action == "crash" and s.rank is not None)
+                     if s.action in ("crash", "preempt")
+                     and s.rank is not None)
 
 
 def pick_culprit(failures, crash_ranks=frozenset()):
@@ -110,13 +114,17 @@ def pick_culprit(failures, crash_ranks=frozenset()):
     scheduling sit between a child dying and its failure being
     recorded).  Attribution therefore ranks by evidence, not arrival:
 
-    1. victims of the kill fan-out are never culprits (all-victims is a
+    1. a rank that exited 0 is never the culprit — a drained rank
+       leaves cleanly by design and must not be named the casualty
+       (callers only record nonzero exits, so this guard is defensive);
+    2. victims of the kill fan-out are never culprits (all-victims is a
        launcher-interrupt edge case: fall back to the full list);
-    2. a rank the job's own ``HVD_TPU_FAULT_SPEC`` armed with a crash
+    3. a rank the job's own ``HVD_TPU_FAULT_SPEC`` armed with a crash
        is the culprit by construction;
-    3. otherwise the earliest ``exit_ts`` wins — the child observed
+    4. otherwise the earliest ``exit_ts`` wins — the child observed
        dead first is the closest thing to the true first death.
     """
+    failures = [f for f in failures if f[1] != 0] or list(failures)
     candidates = [f for f in failures if not f[2]] or list(failures)
     armed = [f for f in candidates if f[0] in crash_ranks]
     pool = armed or candidates
@@ -159,9 +167,16 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
     around the survivors, so the launcher's job is to supervise them to
     completion.  The fan-out still fires when rank 0 dies (it hosts the
     coordinator — nothing can orchestrate a rescue) or when fewer than
-    ``min_ranks`` workers remain."""
+    ``min_ranks`` workers remain.
+
+    A SIGTERM delivered to the launcher itself (the platform preempting
+    the whole allocation) is forwarded once to every worker process
+    group so workers can drain (docs/checkpoint.md); an escalation
+    timer then fires the ordinary kill fan-out after the
+    HVD_TPU_TERM_GRACE window for anything still running."""
     log = get_logger()
     failure = threading.Event()
+    drain = threading.Event()
     # [(rank, code, was_victim, exit_ts)] in reap order — culprit
     # attribution re-ranks by evidence, see pick_culprit
     failures = []
@@ -204,7 +219,8 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
                     stderr = _Tee(err_f, sys.stderr)
                 code = safe_shell_exec.execute(
                     cmd, env=full_env, stdout=stdout, stderr=stderr,
-                    events=[failure], stdin_data=stdin_data, info=info)
+                    events=[failure], stdin_data=stdin_data, info=info,
+                    term_events=[drain])
             finally:
                 for f in (out_f, err_f):
                     if f is not None:
@@ -216,8 +232,12 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
             code = 1
         if code != 0:
             with failures_lock:
+                # a rank that died nonzero AFTER the launcher forwarded
+                # its drain SIGTERM is a victim of that signal, not a
+                # failure of its own
                 failures.append((slot.rank, code,
-                                 info.get("terminated_by_event", False),
+                                 info.get("terminated_by_event", False)
+                                 or info.get("drained", False),
                                  info.get("exit_ts")))
                 alive[0] -= 1
                 survivors = alive[0]
@@ -233,6 +253,27 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
         else:
             with failures_lock:
                 alive[0] -= 1
+
+    escalation = []  # [threading.Timer] so the success path can cancel
+
+    def _on_sigterm(signum, frame):
+        grace = safe_shell_exec.termination_grace_seconds()
+        log.warning("SIGTERM: forwarding to all ranks, escalating to "
+                    "the kill fan-out in %.1fs", grace)
+        drain.set()
+        timer = threading.Timer(grace, failure.set)
+        timer.daemon = True
+        timer.start()
+        escalation.append(timer)
+
+    prev_sigterm = None
+    try:
+        # signal.signal only works on the main thread; a launcher
+        # embedded somewhere else simply doesn't get drain forwarding
+        prev_sigterm = signal_mod.signal(signal_mod.SIGTERM,
+                                         _on_sigterm)
+    except ValueError:
+        pass
 
     threads = [threading.Thread(target=run_rank, args=(s,), daemon=True)
                for s in slots]
@@ -251,7 +292,17 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
         for t in threads:
             t.join(timeout=15)
         raise
+    finally:
+        for timer in escalation:
+            timer.cancel()
+        if prev_sigterm is not None:
+            try:
+                signal_mod.signal(signal_mod.SIGTERM, prev_sigterm)
+            except ValueError:
+                pass
 
+    if drain.is_set() and not failures:
+        log.warning("all ranks drained cleanly after SIGTERM")
     if failures and elastic and not failure.is_set():
         # every loss was absorbed by a reconfiguration and the
         # survivors ran to completion: the job succeeded

@@ -196,6 +196,41 @@ def make_parser():
                             "docs/fault_tolerance.md for the grammar. "
                             "bin/hvd-chaos generates seeded random "
                             "specs for soak runs.")
+    fault.add_argument("--term-grace", type=float, default=None,
+                       help="Grace window in seconds between the "
+                            "SIGTERM the launcher forwards to a worker "
+                            "process group and the SIGKILL escalation "
+                            "(HVD_TPU_TERM_GRACE, default 5; see "
+                            "docs/checkpoint.md).")
+    fault.add_argument("--drain", action="store_true", default=None,
+                       help="Workers convert SIGTERM (the preemption "
+                            "notice) into a graceful drain: announce "
+                            "departure to the coordinator, reconfigure "
+                            "at the next collective boundary, exit 0 "
+                            "(HVD_TPU_DRAIN, default on; see "
+                            "docs/checkpoint.md).")
+    fault.add_argument("--no-drain", action="store_true", default=None,
+                       help="Force the drain handler off: SIGTERM "
+                            "keeps its default kill disposition.")
+
+    ckpt = parser.add_argument_group("checkpointing")
+    ckpt.add_argument("--ckpt-dir", default=None,
+                      help="Durable checkpoint directory "
+                           "(HVD_TPU_CKPT_DIR): each rank writes its "
+                           "parameter/optimizer shard from the elastic "
+                           "commit snapshot on a background thread; "
+                           "elastic.run auto-resumes from the newest "
+                           "complete manifest, re-sharding to the "
+                           "current world size (docs/checkpoint.md). "
+                           "Unset: checkpointing off.")
+    ckpt.add_argument("--ckpt-interval", type=int, default=None,
+                      help="Checkpoint every N committed steps "
+                           "(HVD_TPU_CKPT_INTERVAL, default 10).")
+    ckpt.add_argument("--ckpt-keep", type=int, default=None,
+                      help="Retain the newest N checkpoints, pruning "
+                           "older shards/manifests after each write "
+                           "(HVD_TPU_CKPT_KEEP, default 2; 0 keeps "
+                           "everything).")
 
     elastic = parser.add_argument_group("elastic membership")
     elastic.add_argument("--elastic", action="store_true", default=None,
@@ -379,6 +414,12 @@ def run_commandline(argv=None) -> int:
             args, config_parser.load_config_file(args.config_file))
 
     extra_env = config_parser.env_from_args(args)
+    if env_util.HVD_TPU_TERM_GRACE in extra_env:
+        # the grace window is read by THIS process (the launcher's
+        # SIGTERM forwarding, run/launch.py), not by the workers —
+        # flag/YAML values must land in the launcher's own environment
+        os.environ[env_util.HVD_TPU_TERM_GRACE] = \
+            extra_env[env_util.HVD_TPU_TERM_GRACE]
     slots = build_slots(args)
     global_mesh = args.tpu or args.global_mesh
     if global_mesh:

@@ -12,7 +12,18 @@ import subprocess
 import threading
 import time
 
+from horovod_tpu.utils import env as env_util
+
 GRACEFUL_TERMINATION_TIME_S = 5
+
+
+def termination_grace_seconds() -> float:
+    """The SIGTERM->SIGKILL escalation window.  Read at escalation time
+    (not import time) so HVD_TPU_TERM_GRACE set by the runner's config
+    surface is honored; a drain needs this long to announce departure
+    and flush its checkpoint shard (docs/checkpoint.md)."""
+    return env_util.get_float(env_util.HVD_TPU_TERM_GRACE,
+                              float(GRACEFUL_TERMINATION_TIME_S))
 
 
 def _forward_stream(pipe, sink):
@@ -22,17 +33,35 @@ def _forward_stream(pipe, sink):
     pipe.close()
 
 
-def terminate_process_group(proc):
-    """SIGTERM the child's process group, escalate to SIGKILL."""
+def signal_process_group(proc, sig) -> bool:
+    """Deliver ``sig`` to the child's process group without escalation.
+
+    The launcher's drain path uses this to forward its own SIGTERM (the
+    preemption notice) to workers that are expected to exit 0 on their
+    own; returns False when the group is already gone."""
+    if proc.poll() is not None:
+        return False
+    try:
+        os.killpg(os.getpgid(proc.pid), sig)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+def terminate_process_group(proc, grace=None):
+    """SIGTERM the child's process group, escalate to SIGKILL after the
+    grace window (HVD_TPU_TERM_GRACE, default 5s)."""
     if proc.poll() is not None:
         return
     try:
         pgid = os.getpgid(proc.pid)
     except ProcessLookupError:
         return
+    if grace is None:
+        grace = termination_grace_seconds()
     try:
         os.killpg(pgid, signal.SIGTERM)
-        proc.wait(timeout=GRACEFUL_TERMINATION_TIME_S)
+        proc.wait(timeout=grace)
     except (subprocess.TimeoutExpired, ProcessLookupError):
         try:
             os.killpg(pgid, signal.SIGKILL)
@@ -41,12 +70,18 @@ def terminate_process_group(proc):
 
 
 def execute(command, env=None, stdout=None, stderr=None,
-            events=None, stdin_data=None, info=None) -> int:
+            events=None, stdin_data=None, info=None,
+            term_events=None) -> int:
     """Run ``command`` (shell string or argv list) in a new process group.
 
     ``events``: optional list of ``threading.Event``; if any fires, the
     process tree is terminated (the launcher uses this to kill all ranks
     when one fails, reference: gloo_run.py:300-308).
+    ``term_events``: like ``events`` but drain-grade — the fired event
+    forwards ONE SIGTERM to the process group and does NOT escalate:
+    the worker is trusted to drain and exit 0 on its own (the launcher's
+    escalation timer, armed with the HVD_TPU_TERM_GRACE window, is the
+    backstop).  Sets ``info["drained"]`` True when forwarded.
     ``stdin_data``: bytes written to the child's stdin then closed (used to
     ship the job secret to ssh-launched ranks without putting it on the
     remote command line).
@@ -98,6 +133,17 @@ def execute(command, env=None, stdout=None, stderr=None,
                     terminate_process_group(proc)
                     return
         t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        watchers.append(t)
+    for event in term_events or []:
+        def watch_term(event=event):
+            while not stop_watch.is_set():
+                if event.wait(timeout=0.1):
+                    if signal_process_group(proc, signal.SIGTERM) \
+                            and info is not None:
+                        info["drained"] = True
+                    return
+        t = threading.Thread(target=watch_term, daemon=True)
         t.start()
         watchers.append(t)
 

@@ -73,8 +73,12 @@ class AbortMsg:
 
 
 class HeartbeatMsg:
-    def __init__(self, rank):
+    def __init__(self, rank, busy=False):
         self.rank = rank
+        # rank is inside a known-slow-but-alive window (checkpoint
+        # write, drain teardown): the coordinator widens its liveness
+        # deadline so disk I/O can't read as death (docs/checkpoint.md)
+        self.busy = busy
 
 
 class HeartbeatReply:

@@ -255,6 +255,19 @@ def ZeroDistributedOptimizer(optimizer, op=Average, compression=None,
 
 
 # ------------------------------------------------- elastic / checkpoint glue
+def flat_shard(flat, world_size, rank):
+    """``rank``'s block of a flat vector under the eager ZeRO row
+    partition (:func:`zero_shard_layout`).  The durable checkpoint
+    writer (docs/checkpoint.md) shards every rank's param/optimizer
+    vector with THIS partition so a checkpoint written at world N and a
+    live ZeRO shard at world N agree bit-for-bit — and a resume at a
+    different world size only re-slices, never re-pads."""
+    import numpy as np
+
+    _, off, cnt = zero_shard_layout(len(flat), world_size, rank)
+    return np.asarray(flat)[off:off + cnt]
+
+
 def gather_zero_state(state, n_params, name_prefix="zero.state_gather"):
     """Assemble the FULL optimizer state from every rank's block.
 

@@ -101,9 +101,14 @@ class _Location:
         self.participants = set()  # thread names that touched it
 
 
+# distinguishes "no report yet" from the None that marks a key
+# suppressed by an ignored site
+_UNSEEN = object()
+
+
 class RaceReport:
     """One deduplicated race: a (class, attribute, kind) triple with
-    the pair of racing access sites that first exposed it."""
+    the canonical (lowest-sorting) pair of racing accesses observed."""
 
     __slots__ = ("relpath", "cls_name", "attr", "kind", "access_a",
                  "access_b", "first_writer", "participants", "guarded_by")
@@ -471,12 +476,14 @@ class Detector:
             else "read-write"
         cls_name, relpath = self._info_for(loc.cls)
         key = (relpath, cls_name, loc.attr, kind)
-        if key in self._reports:
-            return
+        prev = self._reports.get(key, _UNSEEN)
+        if prev is None:
+            return  # an ignored pair pinned this key as suppressed
         if self._site_ignored(access.site) \
                 or self._site_ignored(other.site):
-            self._suppressed += 1
-            self._reports[key] = None  # don't re-evaluate per access
+            if prev is _UNSEEN:
+                self._suppressed += 1
+                self._reports[key] = None  # don't re-evaluate per access
             return
         sides = sorted([
             (role, access.site, access.thread_name,
@@ -484,6 +491,15 @@ class Detector:
             (other_role, other.site, other.thread_name,
              self._lock_label(other.lockset)),
         ], key=lambda s: (s[1], s[2], s[0]))
+        # Keep the LOWEST-sorting racing pair seen for this key, not
+        # the first-detected one: which symmetric pair fires first is
+        # an OS-interleaving accident (it even shifts with the
+        # interpreter's hash seed), while the minimum over the pairs a
+        # run observes is stable — so the same-seed determinism
+        # contract doesn't ride on detection order.
+        if prev is not _UNSEEN \
+                and (prev.access_a, prev.access_b) <= (sides[0], sides[1]):
+            return
         guarded = self._guarded.get((relpath, cls_name), {})
         self._reports[key] = RaceReport(
             relpath, cls_name, loc.attr, kind, sides[0], sides[1],

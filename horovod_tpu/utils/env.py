@@ -101,6 +101,14 @@ HVD_TPU_LIVENESS_TIMEOUT = "HVD_TPU_LIVENESS_TIMEOUT"
 HVD_TPU_CONNECT_RETRY_SECONDS = "HVD_TPU_CONNECT_RETRY_SECONDS"
 # deterministic fault injection spec (common/faults.py grammar)
 HVD_TPU_FAULT_SPEC = "HVD_TPU_FAULT_SPEC"
+# launcher escalation grace window: seconds between the SIGTERM it
+# forwards to a worker process group and the SIGKILL follow-up — long
+# enough for a drain + final checkpoint flush (docs/checkpoint.md)
+HVD_TPU_TERM_GRACE = "HVD_TPU_TERM_GRACE"
+# graceful drain: workers convert a SIGTERM (the preemption notice)
+# into a planned departure instead of dying as a crash (default on;
+# docs/checkpoint.md)
+HVD_TPU_DRAIN = "HVD_TPU_DRAIN"
 
 # --- elastic membership (docs/elastic.md) ------------------------------------
 # survive rank loss: reconfigure membership instead of raising on abort
@@ -112,6 +120,15 @@ HVD_TPU_RECONFIG_TIMEOUT = "HVD_TPU_RECONFIG_TIMEOUT"
 HVD_TPU_MIN_RANKS = "HVD_TPU_MIN_RANKS"
 # cap on admitted membership after rejoins (0 = unlimited)
 HVD_TPU_MAX_RANKS = "HVD_TPU_MAX_RANKS"
+
+# --- durable sharded checkpointing (docs/checkpoint.md) ----------------------
+# checkpoint directory (empty/unset = durable checkpointing off): each
+# rank writes its param/optimizer shard there from the commit snapshot
+HVD_TPU_CKPT_DIR = "HVD_TPU_CKPT_DIR"
+# commit-steps between checkpoint snapshots (default 10)
+HVD_TPU_CKPT_INTERVAL = "HVD_TPU_CKPT_INTERVAL"
+# complete checkpoints retained before pruning (default 2; 0 = keep all)
+HVD_TPU_CKPT_KEEP = "HVD_TPU_CKPT_KEEP"
 
 # --- launcher -> worker contract (reference: gloo_run.py:152-157,261-273) ----
 HVD_RANK = "HVD_RANK"
@@ -167,6 +184,9 @@ DEFAULT_RECONFIG_TIMEOUT_SECONDS = 60.0
 DEFAULT_MIN_RANKS = 1
 DEFAULT_MAX_RANKS = 0  # unlimited
 DEFAULT_ZERO_MIN_SIZE = 1024  # flat params below this stay replicated
+DEFAULT_TERM_GRACE_SECONDS = 5.0
+DEFAULT_CKPT_INTERVAL_STEPS = 10
+DEFAULT_CKPT_KEEP = 2
 
 
 # A malformed knob value must not silently vanish into the default

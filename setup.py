@@ -47,8 +47,10 @@ setup(
     packages=[
         "horovod",        # drop-in import alias (horovod.* paths)
         "horovod_tpu",
+        "horovod_tpu.checkpoint",
         "horovod_tpu.common",
         "horovod_tpu.cluster",
+        "horovod_tpu.elastic",
         "horovod_tpu.keras",
         "horovod_tpu.models",
         "horovod_tpu.mxnet",
@@ -57,6 +59,7 @@ setup(
         "horovod_tpu.parallel",
         "horovod_tpu.run",
         "horovod_tpu.run.service",
+        "horovod_tpu.sharding",
         "horovod_tpu.spark",
         "horovod_tpu.tensorflow",
         "horovod_tpu.tools",

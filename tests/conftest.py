@@ -51,8 +51,9 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
-        "slow: long-running benchmarks excluded from tier-1 "
-        "(-m 'not slow')")
+        "slow: long-running benchmarks and multi-rank scenario jobs "
+        "excluded from tier-1 (-m 'not slow'); dedicated CI jobs run "
+        "them unfiltered")
 
 
 @pytest.fixture(scope="session")
