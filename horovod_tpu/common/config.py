@@ -50,6 +50,14 @@ class Config:
     liveness_timeout_seconds: float = \
         env_util.DEFAULT_LIVENESS_TIMEOUT_SECONDS
     fault_spec: str | None = None
+    # Degraded-network tolerance (docs/fault_tolerance.md): RTT EWMA
+    # smoothing, the k x median straggler verdict (k = factor, m =
+    # windows), and whether a confirmed straggler is proposed for
+    # drain-style exclusion under elastic.
+    rtt_alpha: float = env_util.DEFAULT_RTT_ALPHA
+    straggler_factor: float = env_util.DEFAULT_STRAGGLER_FACTOR
+    straggler_windows: int = env_util.DEFAULT_STRAGGLER_WINDOWS
+    straggler_exclude: bool = False
     # Elastic membership (docs/elastic.md): survive rank loss by
     # reconfiguring instead of raising; bounds on the reconfiguration
     # window and on how small/large membership may become.
@@ -133,6 +141,17 @@ class Config:
                 env_util.DEFAULT_LIVENESS_TIMEOUT_SECONDS),
             fault_spec=_validated_fault_spec(env_util.get_str(
                 env_util.HVD_TPU_FAULT_SPEC)),
+            rtt_alpha=env_util.get_float(
+                env_util.HVD_TPU_RTT_ALPHA,
+                env_util.DEFAULT_RTT_ALPHA),
+            straggler_factor=env_util.get_float(
+                env_util.HVD_TPU_STRAGGLER_FACTOR,
+                env_util.DEFAULT_STRAGGLER_FACTOR),
+            straggler_windows=max(1, env_util.get_int(
+                env_util.HVD_TPU_STRAGGLER_WINDOWS,
+                env_util.DEFAULT_STRAGGLER_WINDOWS)),
+            straggler_exclude=env_util.get_bool(
+                env_util.HVD_TPU_STRAGGLER_EXCLUDE),
             elastic=env_util.get_bool(env_util.HVD_TPU_ELASTIC),
             reconfig_timeout_seconds=env_util.get_float(
                 env_util.HVD_TPU_RECONFIG_TIMEOUT,

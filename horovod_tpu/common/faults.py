@@ -9,7 +9,7 @@ bit-for-bit instead of waiting for it to happen in production:
 
 Grammar (one spec)::
 
-    <target>:<point>:<step>:<action>
+    <target>:<point>:<step>:<action>[:<param>[:<duration_s>]]
 
     target  rank<N> — only rank N trips the fault; * — any rank
     point   an instrumented site name.  Shipping points:
@@ -19,6 +19,8 @@ Grammar (one spec)::
                          — i.e. mid-collective)
               send / recv   (ring chunk transport)
               connect   (any control/data-plane TCP connection attempt)
+              link      (framing layer: one hit per client-side frame
+                         write — control, bulk-stripe, and mailbox paths)
     step    1-based hit count of that point in this process: the fault
             fires on exactly the step-th call
     action  crash   — hard-exit the process (os._exit(1)): a dead rank
@@ -28,30 +30,105 @@ Grammar (one spec)::
                       operation itself proceeds, and the drain handler
                       (docs/checkpoint.md) decides what happens next
 
+Degraded-network actions (docs/fault_tolerance.md "degraded networks"):
+unlike the binary actions above these do not fire once — they ARM at the
+step-th hit of their point and then degrade every client-side frame
+write for ``duration_s`` seconds (omitted: the rest of the run):
+
+    delay:<ms>        add a fixed sleep before every frame write
+    jitter:<ms>       add a uniform [0, ms) sleep before every write
+    throttle:<MBps>   pace writes to at most MBps megabytes/second
+    flaky:<p>         drop each write with probability p (the transport
+                      raises BEFORE any bytes leave, so the ordinary
+                      idempotent-send retry machinery absorbs it)
+    partition:<lo-hi> cut every link that crosses the rank-range
+                      boundary [lo, hi] (a simulated host group): writes
+                      and connects between an in-group and an out-group
+                      rank fail as if the hosts were partitioned
+
+    HVD_TPU_FAULT_SPEC="rank1:link:1:delay:200:30,*:allreduce:3:flaky:0.2"
+
+Degradations are deterministic under the existing seed contract: the
+flaky/jitter RNG is seeded from the spec text and the rank, so the same
+spec on the same rank rolls the same sequence.
+
 Counters are per-process and per-point.  The module is inert (one dict
-lookup per check) when no spec is configured.
+lookup per check, one attribute read per frame write) when no spec is
+configured.
 """
 
 import os
+import random
 import signal
 import sys
 import threading
+import time
+import zlib
 
 _ACTIONS = ("crash", "drop", "refuse", "preempt")
+# parameterized, duration-scoped degradations (arm-and-stay, not
+# fire-once); applied at the framing layer via link()
+_DEGRADE_ACTIONS = ("delay", "jitter", "throttle", "flaky", "partition")
 
 
 class FaultSpec:
-    __slots__ = ("rank", "point", "step", "action")
+    __slots__ = ("rank", "point", "step", "action", "param", "duration")
 
-    def __init__(self, rank, point, step, action):
+    def __init__(self, rank, point, step, action, param=None,
+                 duration=None):
         self.rank = rank        # int, or None for "*"
         self.point = point
         self.step = step
         self.action = action
+        self.param = param      # float, or (lo, hi) for partition
+        self.duration = duration  # seconds the degradation stays armed
 
     def __repr__(self):
         target = "*" if self.rank is None else f"rank{self.rank}"
-        return f"{target}:{self.point}:{self.step}:{self.action}"
+        base = f"{target}:{self.point}:{self.step}:{self.action}"
+        if self.action in _DEGRADE_ACTIONS:
+            if self.action == "partition":
+                base += f":{self.param[0]}-{self.param[1]}"
+            else:
+                base += f":{self.param:g}"
+            if self.duration is not None:
+                base += f":{self.duration:g}"
+        return base
+
+
+def _parse_degrade_param(part, action, text):
+    if action == "partition":
+        lo, sep, hi = text.partition("-")
+        try:
+            lo_i, hi_i = int(lo), int(hi)
+        except ValueError:
+            raise ValueError(
+                f"fault spec {part!r}: partition wants <lo>-<hi> rank "
+                f"range, got {text!r}") from None
+        if not sep or lo_i < 0 or hi_i < lo_i:
+            raise ValueError(
+                f"fault spec {part!r}: partition wants <lo>-<hi> with "
+                f"0 <= lo <= hi")
+        return (lo_i, hi_i)
+    try:
+        value = float(text)
+    except ValueError:
+        raise ValueError(
+            f"fault spec {part!r}: {action} wants a numeric parameter, "
+            f"got {text!r}") from None
+    if action == "flaky":
+        if not 0.0 <= value <= 1.0:
+            raise ValueError(
+                f"fault spec {part!r}: flaky probability must be in "
+                f"[0, 1], got {value:g}")
+    elif action == "throttle":
+        if value <= 0:
+            raise ValueError(
+                f"fault spec {part!r}: throttle rate must be > 0 MBps")
+    elif value < 0:
+        raise ValueError(
+            f"fault spec {part!r}: {action} must be >= 0 ms")
+    return value
 
 
 def parse_fault_spec(text):
@@ -63,11 +140,12 @@ def parse_fault_spec(text):
         if not part:
             continue
         fields = part.split(":")
-        if len(fields) != 4:
+        if len(fields) < 4:
             raise ValueError(
                 f"fault spec {part!r}: expected "
-                f"<target>:<point>:<step>:<action>")
-        target, point, step_s, action = fields
+                f"<target>:<point>:<step>:<action>[:<param>"
+                f"[:<duration_s>]]")
+        target, point, step_s, action = fields[:4]
         if target == "*":
             rank = None
         elif target.startswith("rank"):
@@ -86,33 +164,157 @@ def parse_fault_spec(text):
                 f"fault spec {part!r}: step must be an integer") from None
         if step < 1:
             raise ValueError(f"fault spec {part!r}: step is 1-based")
-        if action not in _ACTIONS:
-            raise ValueError(
-                f"fault spec {part!r}: action must be one of {_ACTIONS}")
         if not point:
             raise ValueError(f"fault spec {part!r}: empty point")
-        specs.append(FaultSpec(rank, point, step, action))
+        param = duration = None
+        if action in _DEGRADE_ACTIONS:
+            if len(fields) not in (5, 6):
+                raise ValueError(
+                    f"fault spec {part!r}: {action} wants "
+                    f"<target>:<point>:<step>:{action}:<param>"
+                    f"[:<duration_s>]")
+            param = _parse_degrade_param(part, action, fields[4])
+            if len(fields) == 6:
+                try:
+                    duration = float(fields[5])
+                except ValueError:
+                    raise ValueError(
+                        f"fault spec {part!r}: duration must be "
+                        f"seconds") from None
+                if duration <= 0:
+                    raise ValueError(
+                        f"fault spec {part!r}: duration must be > 0")
+        elif action in _ACTIONS:
+            if len(fields) != 4:
+                raise ValueError(
+                    f"fault spec {part!r}: {action} takes no parameter")
+        else:
+            raise ValueError(
+                f"fault spec {part!r}: action must be one of "
+                f"{_ACTIONS + _DEGRADE_ACTIONS}")
+        specs.append(FaultSpec(rank, point, step, action, param=param,
+                               duration=duration))
     return specs
 
 
-class FaultInjector:
-    """Counts hits per point and returns the matching action, if any."""
+class LinkState:
+    """Per-frame-write verdict aggregated over the armed degradations.
 
-    def __init__(self, specs, rank=0):
+    ``delay_s`` is the resolved sleep for THIS write (fixed delays plus
+    the jitter roll); ``throttle_bps`` is the tightest armed pacing rate
+    in bytes/second (0: unthrottled); ``drop`` is the flaky roll for
+    this write; ``partitioned`` means the (rank, peer) link crosses an
+    armed partition boundary and the write must fail outright."""
+
+    __slots__ = ("delay_s", "throttle_bps", "drop", "partitioned")
+
+    def __init__(self, delay_s=0.0, throttle_bps=0.0, drop=False,
+                 partitioned=False):
+        self.delay_s = delay_s
+        self.throttle_bps = throttle_bps
+        self.drop = drop
+        self.partitioned = partitioned
+
+    def __bool__(self):
+        return bool(self.delay_s or self.throttle_bps or self.drop
+                    or self.partitioned)
+
+
+class FaultInjector:
+    """Counts hits per point and returns the matching action, if any.
+
+    Degradation specs never return an action from :meth:`fire` — the
+    step-th hit of their point ARMS them (stamping the activation time)
+    and :meth:`link` aggregates whatever is currently active."""
+
+    def __init__(self, specs, rank=0, seed_text=""):
         self._specs = list(specs)
         self._rank = rank
         self._counts = {}
+        # spec -> monotonic arm time; guarded by self._lock
+        self._armed = {}
         self._lock = threading.Lock()
+        self._degrade = [s for s in self._specs
+                         if s.action in _DEGRADE_ACTIONS
+                         and s.rank in (None, rank)]
+        # hits of "link" only matter when a spec watches that point —
+        # keeps the per-frame-write hot path to one attribute read when
+        # faults are armed for other points only
+        self.link_live = bool(self._degrade) or any(
+            s.point == "link" for s in self._specs)
+        # deterministic under the seed contract: same spec text + rank
+        # -> same flaky/jitter roll sequence; guarded by self._lock
+        self._rng = random.Random(
+            zlib.crc32(seed_text.encode()) ^ (rank * 0x9E3779B1))
 
     def fire(self, point):
+        now = time.monotonic()
         with self._lock:
             n = self._counts.get(point, 0) + 1
             self._counts[point] = n
+            for spec in self._degrade:
+                if (spec.point == point and spec.step == n
+                        and spec not in self._armed):
+                    self._armed[spec] = now
         for spec in self._specs:
             if (spec.point == point and spec.step == n
-                    and spec.rank in (None, self._rank)):
+                    and spec.rank in (None, self._rank)
+                    and spec.action in _ACTIONS):
                 return spec.action
         return None
+
+    def _active_locked(self, now):  # holds: self._lock
+        for spec, armed_at in self._armed.items():
+            if spec.duration is None or now - armed_at <= spec.duration:
+                yield spec
+
+    def link(self, peer=None):
+        """One client-side frame write toward ``peer`` (None: unknown).
+        Counts a hit of the "link" point (which may arm link-stepped
+        specs or trip a binary action) and returns the aggregated
+        LinkState, or None when nothing is active."""
+        action = self.fire("link")
+        if not self._degrade:
+            return _binary_link_state(action)
+        delay = jitter = 0.0
+        throttle = 0.0
+        flaky = 0.0
+        partitioned = False
+        now = time.monotonic()
+        with self._lock:
+            for spec in self._active_locked(now):
+                if spec.action == "delay":
+                    delay = max(delay, spec.param / 1000.0)
+                elif spec.action == "jitter":
+                    jitter = max(jitter, spec.param / 1000.0)
+                elif spec.action == "throttle":
+                    bps = spec.param * 1e6
+                    throttle = bps if throttle == 0 \
+                        else min(throttle, bps)
+                elif spec.action == "flaky":
+                    flaky = max(flaky, spec.param)
+                elif spec.action == "partition" and peer is not None:
+                    lo, hi = spec.param
+                    if (lo <= self._rank <= hi) != (lo <= peer <= hi):
+                        partitioned = True
+            if jitter > 0:
+                delay += self._rng.uniform(0.0, jitter)
+            drop = flaky > 0 and self._rng.random() < flaky
+        state = LinkState(delay_s=delay, throttle_bps=throttle,
+                          drop=drop, partitioned=partitioned)
+        if action is not None:
+            state.drop = state.drop or action == "drop"
+            _trip_binary(action, "link")
+        return state if state else None
+
+
+def _binary_link_state(action):
+    if action is None:
+        return None
+    if action == "drop":
+        return LinkState(drop=True)
+    _trip_binary(action, "link")
+    return None
 
 
 _injector = None
@@ -126,7 +328,9 @@ def configure(spec_text, rank=0):
     global _injector, _configured
     with _config_lock:
         specs = parse_fault_spec(spec_text) if spec_text else []
-        _injector = FaultInjector(specs, rank=rank) if specs else None
+        _injector = (FaultInjector(specs, rank=rank,
+                                   seed_text=spec_text or "")
+                     if specs else None)
         _configured = True
 
 
@@ -144,6 +348,29 @@ def _auto_configure():
     else:
         configure(env_util.get_str(env_util.HVD_TPU_FAULT_SPEC),
                   rank=env_util.get_int(env_util.HVD_RANK, 0))
+
+
+def _trip_binary(action, point):
+    """Apply a fired binary action; shared by check() and link()."""
+    if action == "refuse":
+        raise ConnectionRefusedError(
+            f"injected connection refusal at {point} (HVD_TPU_FAULT_SPEC)")
+    if action == "preempt":
+        # Deliver the preemption notice the way the platform would:
+        # asynchronously, to this process, while the operation keeps
+        # going.  With drain enabled the installed handler turns this
+        # into a planned departure; without it, default disposition
+        # kills the process (same observable as the real thing).
+        print(f"[hvd-fault] preempting at {point} (injected SIGTERM)",
+              file=sys.stderr, flush=True)
+        os.kill(os.getpid(), signal.SIGTERM)
+        return
+    if action == "crash":
+        # crash: bypass every handler — this models a rank dying mid-step
+        print(f"[hvd-fault] crashing at {point} (injected)",
+              file=sys.stderr, flush=True)
+        sys.stderr.flush()
+        os._exit(1)
 
 
 def check(point) -> bool:
@@ -164,21 +391,21 @@ def check(point) -> bool:
         print(f"[hvd-fault] dropping {point} (injected)",
               file=sys.stderr, flush=True)
         return True
-    if action == "refuse":
-        raise ConnectionRefusedError(
-            f"injected connection refusal at {point} (HVD_TPU_FAULT_SPEC)")
-    if action == "preempt":
-        # Deliver the preemption notice the way the platform would:
-        # asynchronously, to this process, while the operation keeps
-        # going.  With drain enabled the installed handler turns this
-        # into a planned departure; without it, default disposition
-        # kills the process (same observable as the real thing).
-        print(f"[hvd-fault] preempting at {point} (injected SIGTERM)",
-              file=sys.stderr, flush=True)
-        os.kill(os.getpid(), signal.SIGTERM)
-        return False
-    # crash: bypass every handler — this models a rank dying mid-step
-    print(f"[hvd-fault] crashing at {point} (injected)",
-          file=sys.stderr, flush=True)
-    sys.stderr.flush()
-    os._exit(1)
+    _trip_binary(action, point)
+    return False
+
+
+def link(peer=None):
+    """Degraded-network verdict for one client-side frame write toward
+    ``peer`` (a rank, or None when the peer's rank is unknown).  Returns
+    a :class:`LinkState` to apply, or None on the (fast) healthy path.
+
+    The transport applies it BEFORE any bytes leave the socket: delay/
+    jitter/throttle sleep, flaky raises so the idempotent-send retry
+    absorbs it, partition fails the write like an unreachable host."""
+    if not _configured:
+        _auto_configure()
+    injector = _injector
+    if injector is None or not injector.link_live:
+        return None
+    return injector.link(peer)

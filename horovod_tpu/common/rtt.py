@@ -1,0 +1,102 @@
+"""Per-peer round-trip-time EWMAs for adaptive deadlines
+(docs/fault_tolerance.md "degraded networks").
+
+Fixed liveness windows misfire under degradation: a slow-but-alive
+peer (congested NIC, throttled link) misses a fixed deadline and gets
+aborted as dead — the exact failure mode the MLPerf TPU-pod work calls
+the first-order production problem at scale.  The fix is measurement:
+every worker samples the RTT of its own control-plane round trips
+(heartbeats) and its ring chunk sends ("ring acks"), folds them into
+per-key EWMAs, and reports the worst to the coordinator with each
+heartbeat; the coordinator widens that rank's liveness window by an
+RTT-proportional slack, so slow and dead become distinguishable.
+
+One process-wide tracker (:func:`tracker`) is shared by the heartbeat
+loop and the ring data plane so a degradation on either path widens the
+reported figure.
+"""
+
+import threading
+
+from horovod_tpu.utils import env as env_util
+
+# keys of the process-wide tracker
+COORD_KEY = "coordinator"
+
+
+class RttTracker:
+    """Thread-safe per-key EWMA of duration samples (seconds).
+
+    ``alpha`` is the EWMA smoothing factor (HVD_TPU_RTT_ALPHA): the
+    weight of the newest sample.  Higher alpha adapts faster to a link
+    that just degraded; lower alpha resists one-off spikes."""
+
+    def __init__(self, alpha=None):
+        if alpha is None:
+            alpha = env_util.get_float(env_util.HVD_TPU_RTT_ALPHA,
+                                       env_util.DEFAULT_RTT_ALPHA)
+        self.alpha = min(max(float(alpha), 0.01), 1.0)
+        self._ewma = {}                 # key -> seconds; guarded by self._lock
+        self._lock = threading.Lock()
+
+    def sample(self, key, seconds):
+        if seconds < 0:
+            return
+        with self._lock:
+            prev = self._ewma.get(key)
+            self._ewma[key] = (seconds if prev is None
+                               else prev + self.alpha * (seconds - prev))
+
+    def get(self, key, default=0.0):
+        with self._lock:
+            return self._ewma.get(key, default)
+
+    def worst(self) -> float:
+        """The largest EWMA across keys — the figure a worker reports:
+        its slowest observed link bounds how late its own beats and
+        chunk sends may legitimately run."""
+        with self._lock:
+            return max(self._ewma.values(), default=0.0)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._ewma)
+
+    def clear(self):
+        with self._lock:
+            self._ewma.clear()
+
+
+def median(values):
+    """Median of a value sequence (0.0 when empty) — the straggler
+    baseline: a rank is only a straggler relative to its peers, never
+    in absolute terms (the whole pod may be slow on purpose)."""
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    mid = len(vals) // 2
+    if len(vals) % 2:
+        return vals[mid]
+    return (vals[mid - 1] + vals[mid]) / 2.0
+
+
+_tracker = None
+_tracker_lock = threading.Lock()
+
+
+def tracker() -> RttTracker:
+    """The process-wide tracker shared by the heartbeat loop and the
+    ring data plane (lazy: alpha resolves from the env on first use)."""
+    global _tracker
+    with _tracker_lock:
+        if _tracker is None:
+            _tracker = RttTracker()
+        return _tracker
+
+
+def reset():
+    """Drop all samples AND the cached alpha (tests; elastic reinit
+    keeps samples on purpose — the links did not change)."""
+    global _tracker
+    with _tracker_lock:
+        _tracker = None

@@ -71,21 +71,25 @@ class ElasticContext:
                 self._decided = True
             return self._planned
 
-    def plan_drain(self, origin_rank):
+    def plan_drain(self, origin_rank, cause=None):
         """Plan a PLANNED departure (graceful drain after a preemption
-        notice, docs/checkpoint.md): same survivor math as :meth:`plan`
+        notice, docs/checkpoint.md — or a straggler exclusion,
+        docs/fault_tolerance.md): same survivor math as :meth:`plan`
         but the directive is drain-marked — nothing failed, nobody is
-        blamed, delivery skips the abort fan-out.  A drain racing an
-        already-decided plan is refused (None): the membership change in
-        flight wins and the preempted rank leaves as an ordinary loss."""
+        blamed, delivery skips the abort fan-out.  ``cause`` overrides
+        the recorded reason (default: the preemption-notice wording).
+        A drain racing an already-decided plan is refused (None): the
+        membership change in flight wins and the preempted rank leaves
+        as an ordinary loss."""
         with self._lock:
             if self._decided:
                 return None
             wid = (self._members[origin_rank]
                    if 0 <= origin_rank < len(self._members)
                    else origin_rank)
-            cause = (f"worker {wid} drained after preemption notice "
-                     f"(SIGTERM)")
+            if cause is None:
+                cause = (f"worker {wid} drained after preemption "
+                         f"notice (SIGTERM)")
             self._planned = self._plan_locked(origin_rank, cause,
                                               drain=True)
             self._decided = True

@@ -42,6 +42,7 @@ import time
 import numpy as np
 
 from horovod_tpu.common import busy, faults
+from horovod_tpu.common import rtt as rtt_mod
 from horovod_tpu.common.handles import (RECONFIG_MARKER, HvdAbortedError,
                                         HvdError, is_drain_reason,
                                         make_abort_error)
@@ -60,6 +61,12 @@ CONTROLLER_SCOPE = "controller"
 CONTROLLER_KEY = "addr"
 PEERS_SCOPE = "peers"
 TIMELINE_SCOPE = "timeline"
+# dead-epoch GC watermark (rendezvous): highest epoch whose suffixed
+# scopes have already been torn down, so a reconfiguration at epoch k
+# purges only the epochs since the last purge instead of rescanning
+# 0..k-1 every time (O(k^2) cumulative rendezvous calls at soak scale)
+GC_SCOPE = "gc"
+GC_PURGED_KEY = "purged-epoch"
 
 
 # ------------------------------------------------------------------ messages
@@ -201,7 +208,8 @@ class CoordinatorService(network.MuxService):
     def __init__(self, size, key, stall_warning_sec=60.0,
                  stall_shutdown_sec=0.0, cache_capacity=1024,
                  autotune=None, liveness_timeout_sec=0.0, epoch=0,
-                 elastic=None):
+                 elastic=None, straggler_factor=None,
+                 straggler_windows=None, straggler_exclude=False):
         self._size = size
         # membership epoch this coordinator serves; a CollectiveMsg
         # stamped with a different epoch is refused (stale negotiation
@@ -230,6 +238,29 @@ class CoordinatorService(network.MuxService):
         # blame entirely — silence is their planned departure, not a
         # death to abort over; guarded by self._cv
         self._draining = set()
+        # degraded-network tolerance (docs/fault_tolerance.md): each
+        # rank's self-reported worst link RTT EWMA widens its liveness
+        # window by an ADDITIVE slack (composing with — never
+        # double-doubling — the multiplicative busy factor), and a rank
+        # whose RTT stays over factor x median for ``windows``
+        # consecutive scans earns a straggler verdict
+        self._straggler_factor = (
+            env_util.get_float(env_util.HVD_TPU_STRAGGLER_FACTOR,
+                               env_util.DEFAULT_STRAGGLER_FACTOR)
+            if straggler_factor is None else straggler_factor)
+        self._straggler_windows = (
+            env_util.get_int(env_util.HVD_TPU_STRAGGLER_WINDOWS,
+                             env_util.DEFAULT_STRAGGLER_WINDOWS)
+            if straggler_windows is None else straggler_windows)
+        self._straggler_exclude = straggler_exclude
+        self._peer_rtt = {}        # rank -> seconds; guarded by self._cv
+        # rank -> consecutive over-threshold scans; guarded by self._cv
+        self._straggler_hits = {}
+        # rank -> verdict dict, sticky; guarded by self._cv
+        self._straggler_verdicts = {}
+        # monotonic ts of the last O(N) liveness scan (the scan is
+        # time-gated, not per-heartbeat); guarded by self._cv
+        self._last_liveness_scan = 0.0
         # (origin_rank, reason), sticky: written once under self._cv;
         # guarded by self._cv (the lock-free reads below are annotated —
         # a stale None is at worst one poll late, never wrong)
@@ -256,6 +287,9 @@ class CoordinatorService(network.MuxService):
                         self._busy_ranks.add(rank)
                     else:
                         self._busy_ranks.discard(rank)
+                    rtt = getattr(req, "rtt", None)
+                    if rtt is not None:
+                        self._peer_rtt[rank] = float(rtt)
         if isinstance(req, CollectiveMsg):
             return self._handle_collective(req)
         if isinstance(req, JoinMsg):
@@ -280,6 +314,8 @@ class CoordinatorService(network.MuxService):
                     self._last_seen.pop(req.rank, None)
                     self._busy_ranks.discard(req.rank)
                     self._draining.discard(req.rank)
+                    self._peer_rtt.pop(req.rank, None)
+                    self._straggler_hits.pop(req.rank, None)
             return network.AckResponse()
         return super()._handle(req, client_address)
 
@@ -375,29 +411,128 @@ class CoordinatorService(network.MuxService):
         self._initiate_abort(rank, directive)
         return DrainAck(True)
 
+    def _deadline_for_locked(self, r):  # holds: self._cv
+        """Effective liveness window for rank ``r``: the busy factor
+        MULTIPLIES the base window (slow local I/O scales everything),
+        the RTT slack ADDS to it (a slow link delays delivery by a
+        bounded absolute amount) — composed, never double-doubled."""
+        base = self._liveness * (2.0 if r in self._busy_ranks else 1.0)
+        return base + self._rtt_slack_locked(r)
+
+    def _rtt_slack_locked(self, r):  # holds: self._cv
+        """Additive deadline slack from the rank's self-reported RTT
+        EWMA, capped at factor x the base window so a pathological
+        report cannot make the rank effectively unkillable."""
+        return min(self._peer_rtt.get(r, 0.0) * self._straggler_factor,
+                   self._liveness * self._straggler_factor)
+
     def _check_liveness(self):
-        """Convert a silently-dead peer (no message within the liveness
-        window) into a coordinated abort instead of an indefinite wait.
+        """Convert a silently-dead peer (no message within its adaptive
+        liveness window) into a coordinated abort instead of an
+        indefinite wait.
 
         A rank whose last heartbeat was busy-flagged (checkpoint write /
-        drain teardown) gets a doubled window; a rank that announced a
-        drain is never blamed at all — its silence is the planned
-        departure."""
+        drain teardown) gets a doubled window; a rank reporting a high
+        link RTT gets an additive slack (slow is not dead,
+        docs/fault_tolerance.md "degraded networks"); a rank that
+        announced a drain is never blamed at all — its silence is the
+        planned departure."""
         # sticky-flag fast path; _initiate_abort re-checks under the lock
         if self._liveness <= 0 or self._abort is not None:  # hvd-lint: ignore[lock-discipline]
             return
         now = time.monotonic()
         with self._cv:
+            # the O(N) table scan runs at most ~10x per window — on
+            # every heartbeat it would be O(N^2) per window at 64
+            # ranks, a measured rank-0 hot spot in the soak rig
+            if now - self._last_liveness_scan < self._liveness / 10.0:
+                return
+            self._last_liveness_scan = now
             dead = sorted(
                 r for r, ts in self._last_seen.items()
-                if now - ts > self._liveness
-                * (2.0 if r in self._busy_ranks else 1.0)
+                if now - ts > self._deadline_for_locked(r)
                 and r not in self._joined and r not in self._draining)
+            window = self._deadline_for_locked(dead[0]) if dead else 0.0
+            straggler = None if dead else self._straggler_scan_locked()
         if dead:
             self._initiate_abort(
                 dead[0],
                 f"rank {dead[0]} sent no heartbeat for more than "
-                f"{self._liveness:g}s (presumed dead)")
+                f"{window:g}s (presumed dead)")
+        elif straggler is not None:
+            # boundary-wait + plan_drain can block; never on a
+            # heartbeat handler thread.  lifecycle: daemon, one-shot
+            threading.Thread(
+                target=self._propose_straggler_exclusion,
+                args=(straggler,), daemon=True,
+                name="hvd-straggler-drain").start()
+
+    def _straggler_scan_locked(self):  # holds: self._cv
+        """k x median straggler verdict: a rank whose reported RTT EWMA
+        exceeds ``straggler_factor`` x the median of all reports for
+        ``straggler_windows`` consecutive scans is recorded (and
+        logged) as a straggler.  Returns a rank to propose for
+        drain-style exclusion, or None (exclusion is opt-in and
+        elastic-only — the default verdict is a report, not an
+        eviction)."""
+        if len(self._peer_rtt) < 3:
+            return None  # no meaningful median from fewer peers
+        med = rtt_mod.median(self._peer_rtt.values())
+        exclude = None
+        for r, value in self._peer_rtt.items():
+            if not (med > 0 and value > self._straggler_factor * med):
+                self._straggler_hits.pop(r, None)
+                continue
+            self._straggler_hits[r] = self._straggler_hits.get(r, 0) + 1
+            if (self._straggler_hits[r] >= self._straggler_windows
+                    and r not in self._straggler_verdicts):
+                self._straggler_verdicts[r] = {
+                    "rank": r, "rtt": value, "median": med,
+                    "factor": self._straggler_factor}
+                self._log.warning(
+                    "straggler verdict: rank %d RTT %.3fs > %g x "
+                    "median %.3fs for %d consecutive windows", r,
+                    value, self._straggler_factor, med,
+                    self._straggler_hits[r])
+                if exclude is None:
+                    exclude = r
+        if (exclude is not None and self._straggler_exclude
+                and self._elastic is not None):
+            return exclude
+        return None
+
+    def straggler_verdicts(self):
+        """Recorded straggler verdicts (rank -> verdict dict) — the
+        soak rig's regression artifact reads these off the logs; tests
+        read them here."""
+        with self._cv:
+            return {r: dict(v)
+                    for r, v in self._straggler_verdicts.items()}
+
+    def _propose_straggler_exclusion(self, rank):
+        """Drain-style exclusion of a confirmed straggler
+        (HVD_TPU_STRAGGLER_EXCLUDE, elastic only): same protocol as a
+        granted drain — plan a membership without the rank, wait for a
+        collective boundary, deliver the drain-marked directive
+        pull-only.  Nothing crashed, so nothing aborts: survivors
+        reconfigure, the straggler exits cleanly."""
+        with self._cv:
+            if self._abort is not None or rank in self._draining:
+                return
+            self._draining.add(rank)
+        directive = self._elastic.plan_drain(
+            rank, cause=f"rank {rank} excluded as confirmed straggler")
+        if directive is None:
+            with self._cv:
+                self._draining.discard(rank)
+            return
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._cv:
+                if self._abort is not None or not self._forming:
+                    break
+            time.sleep(0.005)
+        self._initiate_abort(rank, directive)
 
     def _ready(self, entry):  # holds: self._cv
         """Ready once every live (non-joined) rank has contributed — a
@@ -939,7 +1074,10 @@ class TcpController:
                 cache_capacity=self._config.cache_capacity,
                 autotune=self._autotune,
                 liveness_timeout_sec=self._config.liveness_timeout_seconds,
-                epoch=self._epoch, elastic=elastic_ctx)
+                epoch=self._epoch, elastic=elastic_ctx,
+                straggler_factor=self._config.straggler_factor,
+                straggler_windows=self._config.straggler_windows,
+                straggler_exclude=self._config.straggler_exclude)
             tagged = [(iface, ip, self._coordinator.port)
                       for iface, ip in network.local_interfaces().items()]
             tagged.append(("lo", "127.0.0.1", self._coordinator.port))
@@ -957,7 +1095,19 @@ class TcpController:
                     # epoch < ours is torn down by construction (we are
                     # the reconfigured successor); best-effort — a
                     # leaked scope is garbage, not a correctness hazard.
-                    for e in range(self._epoch):
+                    # A GC watermark bounds the sweep to the epochs
+                    # since the LAST purge: rescanning 0..k-1 on every
+                    # reconfiguration is O(k^2) cumulative rendezvous
+                    # calls — a rank-0 hot spot under elastic churn at
+                    # soak scale.
+                    purge_from = 0
+                    try:
+                        purge_from = int(http_client.get(
+                            addr, int(port), GC_SCOPE, GC_PURGED_KEY,
+                            timeout=2.0, retry_for=0).decode()) + 1
+                    except Exception:  # noqa: BLE001 — first purge
+                        pass
+                    for e in range(purge_from, self._epoch):
                         suffix = "" if e == 0 else f".e{e}"
                         for base in (CONTROLLER_SCOPE, PEERS_SCOPE,
                                      TIMELINE_SCOPE):
@@ -966,6 +1116,14 @@ class TcpController:
                                     addr, int(port), f"{base}{suffix}")
                             except Exception:  # noqa: BLE001
                                 pass
+                    try:
+                        http_client.put(
+                            addr, int(port), GC_SCOPE, GC_PURGED_KEY,
+                            str(self._epoch - 1).encode(),
+                            retry_for=2.0)
+                    except Exception:  # noqa: BLE001 — next purge
+                        # just rescans from the stale watermark
+                        pass
             self._client_addrs = self._filter_ifaces(tagged)
         else:
             if addr is None:
@@ -1023,8 +1181,14 @@ class TcpController:
             # the unbounded-hang window for the peers.  The mux client's
             # own connect retry already absorbed transient blips.
             try:
+                t0 = time.monotonic()
                 self._client().send(network.HeartbeatMsg(self._rank),
                                     timeout=30.0)
+                # seed the control-plane RTT EWMA with the very first
+                # round-trip so the adaptive deadline starts from a
+                # measured baseline, not from zero slack
+                rtt_mod.tracker().sample(rtt_mod.COORD_KEY,
+                                         time.monotonic() - t0)
             except Exception as exc:
                 raise RuntimeError(
                     f"rank {self._rank} could not register with the "
@@ -1054,7 +1218,7 @@ class TcpController:
         return network.MuxClient(
             self._peer_addrs(rank, env_util.get_float(
                 env_util.HVD_START_TIMEOUT, 120.0)),
-            self._key, timeout=30)
+            self._key, timeout=30, peer=rank)
 
     def _resolve_stripe(self, rank):
         """One dedicated bulk-data connection to ``rank``'s mailbox —
@@ -1064,7 +1228,7 @@ class TcpController:
         return network.StripeClient(
             self._peer_addrs(rank, env_util.get_float(
                 env_util.HVD_START_TIMEOUT, 120.0)),
-            self._key, timeout=30)
+            self._key, timeout=30, peer=rank)
 
     @staticmethod
     def _filter_ifaces(tagged):
@@ -1084,7 +1248,8 @@ class TcpController:
         with self._mux_lock:
             if self._mux is None:
                 self._mux = network.MuxClient(self._client_addrs,
-                                              self._key, timeout=30)
+                                              self._key, timeout=30,
+                                              peer=0)
             return self._mux
 
     def _spawn(self, target, *args):
@@ -1104,24 +1269,40 @@ class TcpController:
         # failed heartbeat must be cheap to observe
         hb_client = network.MuxClient(self._client_addrs, self._key,
                                       timeout=max(interval, 2.0),
-                                      retry_for=0)
+                                      retry_for=0, peer=0)
+        tracker = rtt_mod.tracker()
         fail_since = None
         try:
             while True:
                 try:
+                    t0 = time.monotonic()
+                    # each beat carries the worst smoothed RTT this rank
+                    # observes (control plane or ring acks): the
+                    # coordinator widens this rank's liveness deadline
+                    # by that slack, telling slow-but-alive from dead
                     reply = hb_client.send(
                         network.HeartbeatMsg(self._rank,
-                                             busy=busy.active()),
+                                             busy=busy.active(),
+                                             rtt=tracker.worst() or None),
                         timeout=max(interval * 2, 5.0))
+                    tracker.sample(rtt_mod.COORD_KEY,
+                                   time.monotonic() - t0)
                 except Exception as exc:  # noqa: BLE001 — outage
                     now = time.monotonic()
                     fail_since = (fail_since if fail_since is not None
                                   else now)
                     # the abort deadline, not the liveness window,
                     # bounds how long this rank may spin against a dead
-                    # coordinator
+                    # coordinator; a measured-slow network widens the
+                    # budget by the same capped slack the coordinator
+                    # grants us, so both sides give up symmetrically
                     budget = (self._config.abort_timeout_seconds
                               or self._config.liveness_timeout_seconds)
+                    if budget > 0:
+                        budget += min(
+                            tracker.worst()
+                            * self._config.straggler_factor,
+                            budget)
                     if budget > 0 and now - fail_since > budget:
                         # a dead coordinator must fail the job, not
                         # hang it: self-abort naming the coordinator
@@ -1197,9 +1378,20 @@ class TcpController:
         service (bounded: dead peers refuse the connect instantly,
         unreachable ones are cut off by the join budget).  Reuses the
         ring's live peer connections where they exist; otherwise one
-        short-budget resolve + connect per peer."""
+        short-budget resolve + connect per peer.
+
+        Pushes ride a BOUNDED worker pool, not a thread per peer: at
+        soak scale (64 ranks) a per-peer burst is 63 simultaneous
+        thread spawns + rendezvous resolves on the failing rank — an
+        O(N) hot spot exactly when the process is dying.  Each pool
+        worker walks a strided slice of the peer list, so a stuck peer
+        delays only its own slice and the deadline still bounds the
+        whole fan-out; heartbeats remain the backstop for peers the
+        pool never reached."""
         if self._ring is None:
             return
+
+        deadline = time.monotonic() + budget
 
         def push_one(rank):
             try:
@@ -1210,7 +1402,7 @@ class TcpController:
                 client = network.MuxClient(
                     self._peer_addrs(rank, resolve_timeout=2.0,
                                      retry_for=0),
-                    self._key, timeout=2, retry_for=0)
+                    self._key, timeout=2, retry_for=0, peer=rank)
                 try:
                     client.post(network.AbortMsg(origin_rank, reason))
                 finally:
@@ -1218,12 +1410,22 @@ class TcpController:
             except Exception:  # noqa: BLE001 — heartbeat backstop
                 pass
 
-        threads = [threading.Thread(target=push_one, args=(r,),
+        def push_slice(ranks):
+            for rank in ranks:
+                if time.monotonic() >= deadline:
+                    return
+                push_one(rank)
+
+        peers = [r for r in range(self._size) if r != self._rank]
+        if not peers:
+            return
+        width = min(8, len(peers))
+        threads = [threading.Thread(target=push_slice,
+                                    args=(peers[i::width],),
                                     daemon=True, name="hvd-abort-push")
-                   for r in range(self._size) if r != self._rank]
+                   for i in range(width)]
         for t in threads:
             t.start()
-        deadline = time.monotonic() + budget
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
 

@@ -51,10 +51,12 @@ last ulps.
 import collections
 import queue
 import threading
+import time
 
 import numpy as np
 
 from horovod_tpu.common import faults
+from horovod_tpu.common import rtt as rtt_mod
 from horovod_tpu.common.handles import make_abort_error
 from horovod_tpu.common.ops_enum import (INT8_BLOCK, is_float_dtype,
                                          reduce_scatter_split_sizes)
@@ -474,11 +476,18 @@ class RingPlane:
                 return
             dst, stripe_i, msg, payload = item
             try:
+                t0 = time.monotonic()
                 stripe = self._stripe(dst, stripe_i)
                 if stripe is not None:
                     stripe.post_bulk(msg, payload)
                 else:
                     self._peer(dst).post_bulk(msg, payload)
+                # per-peer write latency feeds the adaptive-deadline
+                # EWMA: a bulk write blocking on socket backpressure (or
+                # an injected delay/throttle) is exactly the slow-link
+                # evidence the next heartbeat should carry upstream
+                rtt_mod.tracker().sample(("peer", dst),
+                                         time.monotonic() - t0)
             except Exception as exc:  # noqa: BLE001 — surface on the
                 # compute thread: its next send/recv of any round fails
                 # fast instead of waiting out the recv timeout

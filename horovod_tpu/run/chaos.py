@@ -1,0 +1,75 @@
+"""Seeded chaos-spec generation (the engine behind ``bin/hvd-chaos``).
+
+Generates a random-but-reproducible HVD_TPU_FAULT_SPEC (grammar:
+docs/fault_tolerance.md) from a fixed seed.  The replay contract is the
+whole point: same seed -> same spec -> same failure step, so a failing
+soak run is replayed exactly.  That contract extends ACROSS versions —
+every new draw (the elastic ``preempt`` cell, the degraded-network
+cells) is taken from the RNG stream strictly AFTER all pre-existing
+draws, so a seed that produced a given spec in an older tree produces a
+byte-identical spec today unless the new feature is explicitly
+requested.
+"""
+
+import random
+
+# the knobs a chaos spec draws from; "connect" exercises the transport
+# retry path, the op/ring points exercise coordinated abort + liveness
+_POINTS = ("allreduce", "broadcast", "allgather", "ring", "send",
+           "connect")
+_ACTIONS = ("crash", "drop", "refuse", "preempt")
+
+# degraded-network cells (docs/fault_tolerance.md "degraded networks"):
+# all injected at the link point, duration-scoped.  Parameter menus are
+# coarse on purpose — the rig wants qualitatively distinct regimes
+# (mild / nasty), not a smooth sweep that no single soak could cover.
+# ``partition`` is deliberately NOT in the random pool: a random rank
+# range can isolate the coordinator, turning a soak whose success
+# criterion is "no false-positive abort" into a guaranteed real abort.
+# Partitions are injected explicitly (tests, bin/hvd-soak's scripted
+# legs) where the expected outcome is pinned.
+_DEGRADE_ACTIONS = ("delay", "jitter", "throttle", "flaky")
+_DELAY_MS = (5, 20, 50)
+_THROTTLE_MBPS = (4, 16, 64)
+_FLAKY_P = (0.05, 0.2)
+
+
+def generate_spec(seed, num_ranks, num_faults, elastic=False,
+                  degrade=0):
+    rng = random.Random(seed)
+    specs = []
+    for _ in range(num_faults):
+        point = rng.choice(_POINTS)
+        # refuse only makes sense at the transport; crash/drop at the
+        # collective layer.  preempt (SIGTERM-to-self -> graceful drain,
+        # docs/checkpoint.md) only joins the pool for elastic soaks:
+        # without elastic the drain is refused and the cell degenerates
+        # into a crash with extra steps.  NOTE: adding the elastic-only
+        # draw AFTER the common ones keeps non-elastic specs identical
+        # for a given seed across versions (the replay contract).
+        if point == "connect":
+            action = "refuse"
+        else:
+            action = rng.choice(("crash", "drop"))
+            if elastic and rng.random() < 0.5:
+                action = "preempt"
+        rank = rng.randrange(num_ranks)
+        step = rng.randint(1, 5)
+        specs.append(f"rank{rank}:{point}:{step}:{action}")
+    # degraded-network cells draw AFTER every binary-fault draw (same
+    # cross-version contract as the elastic cell above): a seed's
+    # binary cells are byte-identical whether or not --degrade is used
+    for _ in range(degrade):
+        action = rng.choice(_DEGRADE_ACTIONS)
+        rank = rng.randrange(num_ranks)
+        step = rng.randint(1, 5)
+        if action in ("delay", "jitter"):
+            param = str(rng.choice(_DELAY_MS))
+        elif action == "throttle":
+            param = str(rng.choice(_THROTTLE_MBPS))
+        else:
+            param = str(rng.choice(_FLAKY_P))
+        duration = rng.randint(2, 8)
+        specs.append(f"rank{rank}:link:{step}:{action}:{param}:"
+                     f"{duration}")
+    return ",".join(specs)

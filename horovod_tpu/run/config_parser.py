@@ -49,6 +49,20 @@ _PARAMS = {
     "connect_retry_seconds": (env_util.HVD_TPU_CONNECT_RETRY_SECONDS,
                               "fault_tolerance.connect_retry_seconds"),
     "fault_spec": (env_util.HVD_TPU_FAULT_SPEC, "fault_tolerance.spec"),
+    "rtt_alpha": (env_util.HVD_TPU_RTT_ALPHA,
+                  "fault_tolerance.rtt_alpha"),
+    "straggler_factor": (env_util.HVD_TPU_STRAGGLER_FACTOR,
+                         "fault_tolerance.straggler_factor"),
+    "straggler_windows": (env_util.HVD_TPU_STRAGGLER_WINDOWS,
+                          "fault_tolerance.straggler_windows"),
+    "straggler_exclude": (env_util.HVD_TPU_STRAGGLER_EXCLUDE,
+                          "fault_tolerance.straggler_exclude"),
+    "soak_ranks": (env_util.HVD_TPU_SOAK_RANKS, "soak.ranks"),
+    "soak_steps": (env_util.HVD_TPU_SOAK_STEPS, "soak.steps"),
+    "soak_seed": (env_util.HVD_TPU_SOAK_SEED, "soak.seed"),
+    "soak_report": (env_util.HVD_TPU_SOAK_REPORT, "soak.report_prefix"),
+    "soak_reconfig_bound": (env_util.HVD_TPU_SOAK_RECONFIG_BOUND,
+                            "soak.reconfig_bound"),
     "elastic": (env_util.HVD_TPU_ELASTIC, "elastic.enabled"),
     "min_ranks": (env_util.HVD_TPU_MIN_RANKS, "elastic.min_ranks"),
     "max_ranks": (env_util.HVD_TPU_MAX_RANKS, "elastic.max_ranks"),
@@ -78,6 +92,7 @@ _NEGATIONS = {
     "stall_check": env_util.HVD_STALL_CHECK_DISABLE,  # enable = disable-var 0
     # drain defaults ON; the negation is the interesting direction
     "no_drain": env_util.HVD_TPU_DRAIN,
+    "no_straggler_exclude": env_util.HVD_TPU_STRAGGLER_EXCLUDE,
 }
 
 

@@ -47,26 +47,45 @@ def request(method, addr, port, scope, key, data=None,
             attempt += 1
 
 
-def put(addr, port, scope, key, value: bytes, retry_for=DEFAULT_RETRY_FOR):
-    request("PUT", addr, port, scope, key, data=value, retry_for=retry_for)
+def _clip(retry_for, deadline):
+    """Clip a static retry budget to the caller's dynamic deadline (an
+    absolute ``time.monotonic()`` timestamp).  The adaptive-deadline
+    layer (docs/fault_tolerance.md "degraded networks") made caller
+    budgets dynamic: a reconfiguration window bounded by the reconfig
+    budget must not overshoot it by up to DEFAULT_RETRY_FOR just
+    because one rendezvous verb hit a transport blip."""
+    if deadline is None:
+        return retry_for
+    return max(0.0, min(retry_for, deadline - time.monotonic()))
 
 
-def delete(addr, port, scope, key, retry_for=DEFAULT_RETRY_FOR):
-    request("DELETE", addr, port, scope, key, retry_for=retry_for)
+def put(addr, port, scope, key, value: bytes, retry_for=DEFAULT_RETRY_FOR,
+        deadline=None):
+    request("PUT", addr, port, scope, key, data=value,
+            retry_for=_clip(retry_for, deadline))
 
 
-def delete_scope(addr, port, scope, retry_for=DEFAULT_RETRY_FOR):
+def delete(addr, port, scope, key, retry_for=DEFAULT_RETRY_FOR,
+           deadline=None):
+    request("DELETE", addr, port, scope, key,
+            retry_for=_clip(retry_for, deadline))
+
+
+def delete_scope(addr, port, scope, retry_for=DEFAULT_RETRY_FOR,
+                 deadline=None):
     """Drop ``scope`` and every key in it — the server's
     ``/__scope__/<scope>`` purge endpoint (dead-epoch rendezvous
     cleanup, docs/elastic.md)."""
-    request("DELETE", addr, port, "__scope__", scope, retry_for=retry_for)
+    request("DELETE", addr, port, "__scope__", scope,
+            retry_for=_clip(retry_for, deadline))
 
 
-def list_keys(addr, port, scope, retry_for=DEFAULT_RETRY_FOR):
+def list_keys(addr, port, scope, retry_for=DEFAULT_RETRY_FOR,
+              deadline=None):
     """Key names currently present in ``scope`` (may be empty) — the
     server's ``/__list__/<scope>`` enumeration endpoint."""
     body = request("GET", addr, port, "__list__", scope,
-                   retry_for=retry_for)
+                   retry_for=_clip(retry_for, deadline))
     return [name for name in body.decode().split("\n") if name]
 
 

@@ -212,6 +212,56 @@ def make_parser():
     fault.add_argument("--no-drain", action="store_true", default=None,
                        help="Force the drain handler off: SIGTERM "
                             "keeps its default kill disposition.")
+    fault.add_argument("--rtt-alpha", type=float, default=None,
+                       help="EWMA smoothing factor for the per-peer "
+                            "RTT estimates behind the adaptive "
+                            "liveness deadlines (HVD_TPU_RTT_ALPHA, "
+                            "default 0.25; see docs/fault_tolerance.md "
+                            "'degraded networks').")
+    fault.add_argument("--straggler-factor", type=float, default=None,
+                       help="A rank is a straggler when its reported "
+                            "RTT exceeds this multiple of the median "
+                            "across reporting ranks "
+                            "(HVD_TPU_STRAGGLER_FACTOR, default 4). "
+                            "The same factor caps the extra deadline "
+                            "slack a slow rank may earn.")
+    fault.add_argument("--straggler-windows", type=int, default=None,
+                       help="Consecutive liveness-scan windows a rank "
+                            "must exceed the straggler threshold "
+                            "before the verdict is recorded "
+                            "(HVD_TPU_STRAGGLER_WINDOWS, default 3).")
+    fault.add_argument("--straggler-exclude", action="store_true",
+                       default=None,
+                       help="Under --elastic, propose a confirmed "
+                            "straggler for drain-style exclusion at "
+                            "the next collective boundary "
+                            "(HVD_TPU_STRAGGLER_EXCLUDE, default "
+                            "off: verdicts are log-only).")
+    fault.add_argument("--no-straggler-exclude", action="store_true",
+                       default=None,
+                       help="Force straggler exclusion off (verdicts "
+                            "stay log-only).")
+
+    soak = parser.add_argument_group("soak rig")
+    soak.add_argument("--soak-ranks", type=int, default=None,
+                      help="World size for bin/hvd-soak "
+                           "(HVD_TPU_SOAK_RANKS, default 16; see "
+                           "docs/soak.md).")
+    soak.add_argument("--soak-steps", type=int, default=None,
+                      help="Training steps per soak leg "
+                           "(HVD_TPU_SOAK_STEPS, default 20).")
+    soak.add_argument("--soak-seed", type=int, default=None,
+                      help="Chaos-schedule seed for the soak rig "
+                           "(HVD_TPU_SOAK_SEED, default 11).")
+    soak.add_argument("--soak-report", default=None,
+                      help="Path prefix for the per-run SOAK_r*.json "
+                           "gate artifacts (HVD_TPU_SOAK_REPORT).")
+    soak.add_argument("--soak-reconfig-bound", type=float, default=None,
+                      help="Regression gate: every elastic "
+                           "reconfiguration observed during the soak "
+                           "must complete within this many seconds "
+                           "(HVD_TPU_SOAK_RECONFIG_BOUND, default "
+                           "45).")
 
     ckpt = parser.add_argument_group("checkpointing")
     ckpt.add_argument("--ckpt-dir", default=None,

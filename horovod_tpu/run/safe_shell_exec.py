@@ -123,6 +123,14 @@ def execute(command, env=None, stdout=None, stderr=None,
         forwarders.append(t)
 
     stop_watch = threading.Event()
+    # monotonic time a drain SIGTERM was forwarded (None: never).  The
+    # escalation watcher CLIPS its grace to what is left of the window
+    # that started at this instant: without the clip, drain-then-
+    # escalate granted the tree TWO full grace windows (one armed by
+    # the launcher's timer after the forward, then a fresh one inside
+    # terminate_process_group) — a preempted-but-wedged worker held the
+    # whole job for 2x HVD_TPU_TERM_GRACE.
+    term_state = {"ts": None}
     watchers = []
     for event in events or []:
         def watch(event=event):
@@ -130,7 +138,12 @@ def execute(command, env=None, stdout=None, stderr=None,
                 if event.wait(timeout=0.1):
                     if info is not None and proc.poll() is None:
                         info["terminated_by_event"] = True
-                    terminate_process_group(proc)
+                    grace = None
+                    if term_state["ts"] is not None:
+                        grace = max(0.0, term_state["ts"]
+                                    + termination_grace_seconds()
+                                    - time.monotonic())
+                    terminate_process_group(proc, grace=grace)
                     return
         t = threading.Thread(target=watch, daemon=True)
         t.start()
@@ -139,9 +152,11 @@ def execute(command, env=None, stdout=None, stderr=None,
         def watch_term(event=event):
             while not stop_watch.is_set():
                 if event.wait(timeout=0.1):
-                    if signal_process_group(proc, signal.SIGTERM) \
-                            and info is not None:
-                        info["drained"] = True
+                    if signal_process_group(proc, signal.SIGTERM):
+                        term_state["ts"] = time.monotonic()
+                        if info is not None:
+                            info["drained"] = True
+                            info["term_ts"] = term_state["ts"]
                     return
         t = threading.Thread(target=watch_term, daemon=True)
         t.start()

@@ -22,6 +22,7 @@ import secrets as _secrets
 import socket
 import socketserver
 import struct
+import sys
 import threading
 import time
 
@@ -73,12 +74,18 @@ class AbortMsg:
 
 
 class HeartbeatMsg:
-    def __init__(self, rank, busy=False):
+    def __init__(self, rank, busy=False, rtt=None):
         self.rank = rank
         # rank is inside a known-slow-but-alive window (checkpoint
         # write, drain teardown): the coordinator widens its liveness
         # deadline so disk I/O can't read as death (docs/checkpoint.md)
         self.busy = busy
+        # sender's worst observed link RTT EWMA in seconds (heartbeat
+        # round trips + ring chunk sends): the coordinator adds an
+        # RTT-proportional slack to this rank's liveness window so a
+        # slow-but-alive link never reads as death
+        # (docs/fault_tolerance.md "degraded networks")
+        self.rtt = rtt
 
 
 class HeartbeatReply:
@@ -117,6 +124,64 @@ def connect(addr, timeout):
 class _RetryableSendError(ConnectionError):
     """Internal marker: the request may be safely retried in full
     (nothing reached the service, or the request is idempotent)."""
+
+
+# ------------------------------------------------- degraded-link injection
+# bound on one injected sleep: a chaos cell must slow the job, never
+# wedge it past its own deadlines' ability to tell slow from dead
+_MAX_DEGRADE_SLEEP = 5.0
+_flaky_noted = set()    # peers already logged; guarded by _flaky_note_lock
+_flaky_note_lock = threading.Lock()
+
+
+def _note_flaky(peer):
+    with _flaky_note_lock:
+        if peer in _flaky_noted:
+            return
+        _flaky_noted.add(peer)
+    print(f"[hvd-fault] flaky link toward peer {peer}: dropping writes, "
+          f"transport resends (injected)", file=sys.stderr, flush=True)
+
+
+def _apply_link_faults(peer, nbytes=None):
+    """Client-side framing-layer chaos (docs/fault_tolerance.md
+    "degraded networks"): every client frame write — control mux,
+    bulk-stripe, mailbox — funnels through here, so an armed
+    degradation is felt by all three paths.  ``peer`` is the remote's
+    rank (None: unknown, e.g. rendezvous); ``nbytes`` sizes the
+    throttle pacing for bulk payloads.
+
+    A flaky drop loses the write BEFORE any byte leaves the socket, so
+    the resend here is always safe — the peer never saw a partial
+    frame (the TCP-retransmit analog, surfaced once per peer for the
+    chaos log).  A partition fails the write outright, exactly like an
+    unreachable host."""
+    from horovod_tpu.common import faults
+
+    state = faults.link(peer)
+    if state is None:
+        return
+    attempts = 0
+    while state is not None and state.drop:
+        _note_flaky(peer)
+        attempts += 1
+        if attempts >= 1000:
+            raise ConnectionResetError(
+                f"injected flaky link toward peer {peer} dropped "
+                f"{attempts} consecutive writes (HVD_TPU_FAULT_SPEC)")
+        time.sleep(0.002)
+        state = faults.link(peer)
+    if state is None:
+        return
+    if state.partitioned:
+        raise ConnectionResetError(
+            f"injected network partition toward peer {peer} "
+            f"(HVD_TPU_FAULT_SPEC)")
+    sleep_s = state.delay_s
+    if state.throttle_bps > 0 and nbytes:
+        sleep_s += nbytes / state.throttle_bps
+    if sleep_s > 0:
+        time.sleep(min(sleep_s, _MAX_DEGRADE_SLEEP))
 
 
 # ---------------------------------------------------------------- wire codec
@@ -340,7 +405,7 @@ class BasicClient:
     answers, remembers the winner."""
 
     def __init__(self, addresses, key, timeout=10, read_timeout="same",
-                 retry_for=None):
+                 retry_for=None, peer=None):
         # addresses: {iface: [(ip, port)]} or flat [(ip, port)].
         # ``timeout`` bounds connection establishment; ``read_timeout``
         # bounds the response wait (None = wait forever — collectives
@@ -348,7 +413,8 @@ class BasicClient:
         # coordinator owns stall detection).  ``retry_for`` is the
         # deadline budget for connect-phase retries with backoff+jitter
         # (None = HVD_TPU_CONNECT_RETRY_SECONDS; 0 = a single sweep) —
-        # one RST during rendezvous must not kill the job.
+        # one RST during rendezvous must not kill the job.  ``peer`` is
+        # the remote's rank when known, for link-level fault targeting.
         if isinstance(addresses, dict):
             flat = [a for addrs in addresses.values() for a in addrs]
         else:
@@ -359,6 +425,7 @@ class BasicClient:
         self._good = None
         self._key = key
         self._timeout = timeout
+        self._peer = peer
         self._read_timeout = timeout if read_timeout == "same" \
             else read_timeout
         self._retry_for = (default_connect_retry() if retry_for is None
@@ -367,6 +434,7 @@ class BasicClient:
     def _send_one(self, addr, req):
         with connect(addr, self._timeout) as sock:
             sock.settimeout(self._read_timeout)
+            _apply_link_faults(self._peer)
             write_message(sock, self._key, req, "q")
             resp = read_message(sock, self._key, "r")
         if isinstance(resp, Exception):
@@ -416,6 +484,7 @@ class BasicClient:
             try:
                 with sock:
                     sock.settimeout(self._read_timeout)
+                    _apply_link_faults(self._peer)
                     write_message(sock, self._key, req, "q")
                     resp = read_message(sock, self._key, "r")
             except OSError as exc:
@@ -592,7 +661,8 @@ class MuxClient:
     """Client for :class:`MuxService`: ONE persistent socket, concurrent
     in-flight requests demultiplexed by id.  Thread-safe."""
 
-    def __init__(self, addresses, key, timeout=10, retry_for=None):
+    def __init__(self, addresses, key, timeout=10, retry_for=None,
+                 peer=None):
         if isinstance(addresses, dict):
             flat = [a for addrs in addresses.values() for a in addrs]
         else:
@@ -602,6 +672,10 @@ class MuxClient:
         self._addresses = flat
         self._key = key
         self._timeout = timeout
+        # remote's rank when known (coordinator: 0, ring mailboxes:
+        # the peer rank) — link-level fault targeting needs the
+        # identity, the transport itself never does
+        self._peer = peer
         self._retry_for = (default_connect_retry() if retry_for is None
                            else retry_for)
         self._sock = None     # guarded by self._state_lock
@@ -686,6 +760,7 @@ class MuxClient:
             self._pending[req_id] = (event, slot)
         try:
             with self._send_lock:
+                _apply_link_faults(self._peer)
                 self._bytes_sent += write_message(
                     sock, self._key, (req_id, req), "q")
         except Exception:  # OSError, PicklingError, oversize ValueError…
@@ -708,6 +783,7 @@ class MuxClient:
         with self._state_lock:
             sock = self._ensure_connected_locked()
         with self._send_lock:
+            _apply_link_faults(self._peer)
             self._bytes_sent += write_message(sock, self._key,
                                               (None, req), "q")
 
@@ -736,7 +812,7 @@ class MuxClient:
             if self._bulk is None:
                 self._bulk = StripeClient(
                     self._addresses, self._key, timeout=self._timeout,
-                    retry_for=self._retry_for)
+                    retry_for=self._retry_for, peer=self._peer)
             bulk = self._bulk
         bulk.post_bulk(obj, payload)
 
@@ -763,7 +839,8 @@ class StripeClient:
     queue behind multi-MB chunk writes and high-BDP links get
     multi-stream throughput.  Thread-safe."""
 
-    def __init__(self, addresses, key, timeout=10, retry_for=None):
+    def __init__(self, addresses, key, timeout=10, retry_for=None,
+                 peer=None):
         if isinstance(addresses, dict):
             flat = [a for addrs in addresses.values() for a in addrs]
         else:
@@ -773,6 +850,7 @@ class StripeClient:
         self._addresses = flat
         self._key = key
         self._timeout = timeout
+        self._peer = peer    # remote's rank when known (fault targeting)
         self._retry_for = (default_connect_retry() if retry_for is None
                            else retry_for)
         self._lock = threading.Lock()
@@ -789,6 +867,8 @@ class StripeClient:
                 self._sock = _connect_any(self._addresses, self._timeout,
                                           self._retry_for)
             try:
+                _apply_link_faults(self._peer,
+                                   memoryview(payload).nbytes)
                 self.bytes_sent += write_bulk_message(
                     self._sock, self._key, (None, obj), payload, "q")
             except OSError:

@@ -110,6 +110,21 @@ HVD_TPU_TERM_GRACE = "HVD_TPU_TERM_GRACE"
 # docs/checkpoint.md)
 HVD_TPU_DRAIN = "HVD_TPU_DRAIN"
 
+# --- degraded-network tolerance (docs/fault_tolerance.md) --------------------
+# EWMA smoothing factor for per-peer RTT tracking (weight of the newest
+# sample); the liveness window widens by an RTT-proportional slack so a
+# slow-but-alive peer is not aborted as dead
+HVD_TPU_RTT_ALPHA = "HVD_TPU_RTT_ALPHA"
+# k of the straggler verdict (rank RTT > k x median for m windows) AND
+# the multiplier of the RTT slack added to the liveness window
+HVD_TPU_STRAGGLER_FACTOR = "HVD_TPU_STRAGGLER_FACTOR"
+# m of the straggler verdict: consecutive liveness-scan windows a rank
+# must exceed k x median before the verdict is recorded
+HVD_TPU_STRAGGLER_WINDOWS = "HVD_TPU_STRAGGLER_WINDOWS"
+# under elastic, a confirmed straggler is proposed for drain-style
+# exclusion (boundary reconfiguration, no abort) instead of only logged
+HVD_TPU_STRAGGLER_EXCLUDE = "HVD_TPU_STRAGGLER_EXCLUDE"
+
 # --- elastic membership (docs/elastic.md) ------------------------------------
 # survive rank loss: reconfigure membership instead of raising on abort
 HVD_TPU_ELASTIC = "HVD_TPU_ELASTIC"
@@ -129,6 +144,20 @@ HVD_TPU_CKPT_DIR = "HVD_TPU_CKPT_DIR"
 HVD_TPU_CKPT_INTERVAL = "HVD_TPU_CKPT_INTERVAL"
 # complete checkpoints retained before pruning (default 2; 0 = keep all)
 HVD_TPU_CKPT_KEEP = "HVD_TPU_CKPT_KEEP"
+
+# --- soak rig (bin/hvd-soak, docs/soak.md) -----------------------------------
+# world size of the chaos soak (oversubscribed CPU mesh, multi-host
+# simulated via per-rank host-hash salts)
+HVD_TPU_SOAK_RANKS = "HVD_TPU_SOAK_RANKS"
+# training steps each soak worker drives through elastic run()
+HVD_TPU_SOAK_STEPS = "HVD_TPU_SOAK_STEPS"
+# chaos seed for the soak's fault/degradation draw (bin/hvd-chaos)
+HVD_TPU_SOAK_SEED = "HVD_TPU_SOAK_SEED"
+# directory the SOAK_r*.json regression artifact is written to
+# (empty/unset: repo root)
+HVD_TPU_SOAK_REPORT = "HVD_TPU_SOAK_REPORT"
+# gate: a reconfiguration slower than this many seconds fails the soak
+HVD_TPU_SOAK_RECONFIG_BOUND = "HVD_TPU_SOAK_RECONFIG_BOUND"
 
 # --- launcher -> worker contract (reference: gloo_run.py:152-157,261-273) ----
 HVD_RANK = "HVD_RANK"
@@ -187,6 +216,13 @@ DEFAULT_ZERO_MIN_SIZE = 1024  # flat params below this stay replicated
 DEFAULT_TERM_GRACE_SECONDS = 5.0
 DEFAULT_CKPT_INTERVAL_STEPS = 10
 DEFAULT_CKPT_KEEP = 2
+DEFAULT_RTT_ALPHA = 0.25
+DEFAULT_STRAGGLER_FACTOR = 4.0
+DEFAULT_STRAGGLER_WINDOWS = 3
+DEFAULT_SOAK_RANKS = 16
+DEFAULT_SOAK_STEPS = 20
+DEFAULT_SOAK_SEED = 11
+DEFAULT_SOAK_RECONFIG_BOUND = 45.0
 
 
 # A malformed knob value must not silently vanish into the default

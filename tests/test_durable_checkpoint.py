@@ -489,10 +489,12 @@ def test_busy_rank_gets_doubled_liveness_window():
         with svc._cv:
             svc._last_seen[0] = time.monotonic()
             svc._last_seen[1] = time.monotonic() - 15.0   # 1.5x window
+            svc._last_liveness_scan = 0.0   # open the scan time-gate
         svc._check_liveness()
         assert svc._abort is None        # busy: the deadline doubled
         with svc._cv:
             svc._last_seen[1] = time.monotonic() - 25.0   # past 2x
+            svc._last_liveness_scan = 0.0
         svc._check_liveness()
         assert svc._abort is not None and svc._abort[0] == 1
     finally:
@@ -508,6 +510,7 @@ def test_non_busy_rank_keeps_plain_window():
         with svc._cv:
             svc._last_seen[0] = time.monotonic()
             svc._last_seen[1] = time.monotonic() - 15.0
+            svc._last_liveness_scan = 0.0   # open the scan time-gate
         svc._check_liveness()
         assert svc._abort is not None and svc._abort[0] == 1
     finally:

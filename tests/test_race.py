@@ -448,3 +448,48 @@ def test_write_baseline_refuses_partial_run(tmp_path):
     assert out.returncode == 3, out.stdout + out.stderr
     assert "baseline NOT rewritten" in out.stderr
     assert base.read_text() == original
+
+
+ADAPTIVE_COORD_HARNESS = r"""
+import threading
+import time
+import horovod_tpu  # installs the shim
+from horovod_tpu.common import rtt
+from horovod_tpu.ops.tcp_controller import CoordinatorService
+from horovod_tpu.run.service import network, secret
+
+svc = CoordinatorService(4, secret.make_secret_key(),
+                         liveness_timeout_sec=30.0,
+                         straggler_factor=4.0, straggler_windows=2)
+errs = []
+def worker(rank):
+    # the production shape: per-connection handler threads feed
+    # heartbeats (busy flags + RTT reports) while the liveness scan,
+    # the straggler scan and verdict reads run concurrently
+    try:
+        tr = rtt.RttTracker(alpha=0.5)
+        for i in range(40):
+            tr.sample(rtt.COORD_KEY, 0.01 * rank + 0.001 * i)
+            svc._handle(network.HeartbeatMsg(
+                rank, busy=(i % 3 == 0), rtt=tr.worst() or None), None)
+            svc.straggler_verdicts()
+    except BaseException as e:
+        errs.append(e)
+ts = [threading.Thread(target=worker, args=(r,)) for r in range(1, 4)]
+for t in ts: t.start()
+for t in ts: t.join()
+assert not errs, errs
+svc.shutdown()
+print("ADAPTIVE-OK")
+"""
+
+
+def test_adaptive_coordinator_path_clean_under_shim(tmp_path):
+    """The soak rig's coordinator hot path (docs/soak.md): concurrent
+    heartbeats carrying busy flags + RTT reports through the adaptive
+    liveness deadline, the straggler scan and verdict reads, with
+    RttTracker EWMAs updating alongside — shim on, zero non-baselined
+    findings."""
+    active = _run_inline_under_shim(ADAPTIVE_COORD_HARNESS, "adaptive",
+                                    tmp_path)
+    assert not active, "\n".join(f["message"] for f in active)
