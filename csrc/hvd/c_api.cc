@@ -169,7 +169,7 @@ void* hvd_pm_create(int warmup, int steady_state, int bayes_max,
                     int cache_enabled, int compression,
                     int compression_available,
                     int64_t ring_segment_bytes, int ring_stripes,
-                    int ring_tunable) {
+                    int ring_tunable, int schedule, int schedule_tunable) {
   hvd::ParameterManager::Options o;
   o.active = true;
   o.warmup_samples = warmup;
@@ -192,6 +192,8 @@ void* hvd_pm_create(int warmup, int steady_state, int bayes_max,
   o.ring_segment_bytes = ring_segment_bytes;
   o.ring_stripes = ring_stripes;
   o.ring_tunable = ring_tunable != 0;
+  o.schedule = schedule;
+  o.schedule_tunable = schedule_tunable != 0;
   return new hvd::ParameterManager(o);
 }
 
@@ -242,6 +244,10 @@ int64_t hvd_pm_ring_segment_bytes(void* pm) {
 
 int hvd_pm_ring_stripes(void* pm) {
   return static_cast<hvd::ParameterManager*>(pm)->ring_stripes();
+}
+
+int hvd_pm_schedule(void* pm) {
+  return static_cast<hvd::ParameterManager*>(pm)->schedule();
 }
 
 int hvd_pm_tuning(void* pm) {

@@ -28,7 +28,7 @@ ParameterManager::ParameterManager(const Options& opts)
       best_cycle_ms_(opts.cycle_time_ms),
       best_cat_{opts.hierarchical_allreduce, opts.hierarchical_allgather,
                 opts.cache_enabled, opts.compression,
-                opts.ring_segment_bytes, opts.ring_stripes},
+                opts.ring_segment_bytes, opts.ring_stripes, opts.schedule},
       fusion_bytes_(opts.fusion_threshold_bytes),
       cycle_ms_(opts.cycle_time_ms),
       hier_allreduce_(opts.hierarchical_allreduce),
@@ -37,6 +37,7 @@ ParameterManager::ParameterManager(const Options& opts)
       compression_(opts.compression),
       ring_segment_bytes_(opts.ring_segment_bytes),
       ring_stripes_(opts.ring_stripes),
+      schedule_(opts.schedule),
       tuning_(opts.active),
       best_score_(0.0) {
   if (!opts.active) return;
@@ -46,18 +47,30 @@ ParameterManager::ParameterManager(const Options& opts)
   const bool comp = opts.compression;
   const int64_t seg = opts.ring_segment_bytes;
   const int str = opts.ring_stripes;
+  const int sch = opts.schedule;
   walk_ = {
-      {false, false, true, comp, seg, str},
-      {true, false, true, comp, seg, str},
-      {false, true, true, comp, seg, str},
-      {true, true, true, comp, seg, str},
-      {false, false, false, comp, seg, str},
+      {false, false, true, comp, seg, str, sch},
+      {true, false, true, comp, seg, str, sch},
+      {false, true, true, comp, seg, str, sch},
+      {true, true, true, comp, seg, str, sch},
+      {false, false, false, comp, seg, str, sch},
   };
   if (opts.compression_available) {
     // one probe of the opposite compression state at the default
     // schedule configuration — enough for the score to decide whether
     // the quantize overhead pays for the wire savings on this job
-    walk_.push_back({false, false, true, !comp, seg, str});
+    walk_.push_back({false, false, true, !comp, seg, str, sch});
+  }
+  if (opts.schedule_tunable) {
+    // collective-schedule probes for the tcp plane, tuned jointly with
+    // segment/stripe/compression: explicitly measure the flat ring (1)
+    // and the two-level hierarchical schedule (2) so the score decides
+    // whether the topology-aware plan pays on this job (indices into
+    // the SCHEDULES tuple shared with ops/tcp_dataplane.py; rhd/star
+    // are latency-regime choices the auto resolver owns per tensor
+    // size, so probing them against a bytes/sec score would be noise)
+    if (sch != 1) walk_.push_back({false, false, true, comp, seg, str, 1});
+    if (sch != 2) walk_.push_back({false, false, true, comp, seg, str, 2});
   }
   if (opts.ring_tunable) {
     // ring transfer-engine probes around the configured values at the
@@ -74,13 +87,13 @@ ParameterManager::ParameterManager(const Options& opts)
       const int64_t seg_lo = std::max<int64_t>(seg / 2, 1 << 16);
       const int64_t seg_hi = std::min<int64_t>(seg * 2, 1 << 26);
       if (seg_lo != seg)
-        walk_.push_back({false, false, true, comp, seg_lo, str});
+        walk_.push_back({false, false, true, comp, seg_lo, str, sch});
       if (seg_hi != seg)
-        walk_.push_back({false, false, true, comp, seg_hi, str});
+        walk_.push_back({false, false, true, comp, seg_hi, str, sch});
     }
     const int str_hi = std::min(str * 2, 8);
     if (str_hi != str)
-      walk_.push_back({false, false, true, comp, seg, str_hi});
+      walk_.push_back({false, false, true, comp, seg, str_hi, sch});
   }
   // The walk starts at the CONFIGURED categorical so the first tuning
   // samples — and everything published before the walk advances —
@@ -89,14 +102,15 @@ ParameterManager::ParameterManager(const Options& opts)
   // manager from the configured values before tuning).
   const Categorical seed{opts.hierarchical_allreduce,
                          opts.hierarchical_allgather, opts.cache_enabled,
-                         opts.compression, seg, str};
+                         opts.compression, seg, str, sch};
   auto same = [&seed](const Categorical& c) {
     return c.hier_allreduce == seed.hier_allreduce &&
            c.hier_allgather == seed.hier_allgather &&
            c.cache_enabled == seed.cache_enabled &&
            c.compression == seed.compression &&
            c.ring_segment_bytes == seed.ring_segment_bytes &&
-           c.ring_stripes == seed.ring_stripes;
+           c.ring_stripes == seed.ring_stripes &&
+           c.schedule == seed.schedule;
   };
   walk_.erase(std::remove_if(walk_.begin(), walk_.end(), same), walk_.end());
   walk_.insert(walk_.begin(), seed);
@@ -107,7 +121,7 @@ ParameterManager::ParameterManager(const Options& opts)
                    "score_bytes_per_sec,fusion_threshold_mb,cycle_time_ms,"
                    "hierarchical_allreduce,hierarchical_allgather,"
                    "cache_enabled,compression,ring_segment_bytes,"
-                   "ring_stripes\n");
+                   "ring_stripes,schedule\n");
     }
   }
   bayes_ = std::make_unique<optim::BayesianOptimizer>(
@@ -137,6 +151,7 @@ void ParameterManager::ApplyPoint(const std::vector<double>& point) {
   compression_.store(cat.compression);
   ring_segment_bytes_.store(cat.ring_segment_bytes);
   ring_stripes_.store(cat.ring_stripes);
+  schedule_.store(cat.schedule);
   discard_left_ = opts_.warmup_samples;
   window_scores_.clear();
   window_bytes_ = 0;
@@ -152,6 +167,7 @@ void ParameterManager::ApplyBest() {
   compression_.store(best_cat_.compression);
   ring_segment_bytes_.store(best_cat_.ring_segment_bytes);
   ring_stripes_.store(best_cat_.ring_stripes);
+  schedule_.store(best_cat_.schedule);
   tuning_.store(false);
   if (log_) {
     std::fflush(log_);
@@ -173,13 +189,13 @@ void ParameterManager::NextCategorical() {
 
 void ParameterManager::LogRow(double score) {
   if (!log_) return;
-  std::fprintf(log_, "%.1f,%.2f,%.2f,%d,%d,%d,%d,%lld,%d\n", score,
+  std::fprintf(log_, "%.1f,%.2f,%.2f,%d,%d,%d,%d,%lld,%d,%d\n", score,
                static_cast<double>(fusion_bytes_.load()) / (1024.0 * 1024.0),
                cycle_ms_.load(), hier_allreduce_.load() ? 1 : 0,
                hier_allgather_.load() ? 1 : 0, cache_enabled_.load() ? 1 : 0,
                compression_.load() ? 1 : 0,
                static_cast<long long>(ring_segment_bytes_.load()),
-               ring_stripes_.load());
+               ring_stripes_.load(), schedule_.load());
 }
 
 bool ParameterManager::Update(double now_seconds) {

@@ -64,6 +64,15 @@ class ParameterManager {
     int64_t ring_segment_bytes = 1 << 20;
     int ring_stripes = 2;
     bool ring_tunable = false;
+    // Collective schedule for the tcp plane (HVD_TPU_SCHEDULE), encoded
+    // as the index into the canonical name tuple
+    // ("auto","flat_ring","hierarchical","rhd","star") shared with
+    // ops/tcp_dataplane.py SCHEDULES.  Joined to the categorical walk
+    // only when `schedule_tunable` (tcp-controller jobs): explicit
+    // flat-ring and hierarchical probes let the score decide whether
+    // the two-level schedule pays on this job's topology.
+    int schedule = 0;
+    bool schedule_tunable = false;
   };
 
   explicit ParameterManager(const Options& opts);
@@ -87,6 +96,7 @@ class ParameterManager {
   bool compression_enabled() const { return compression_.load(); }
   int64_t ring_segment_bytes() const { return ring_segment_bytes_.load(); }
   int ring_stripes() const { return ring_stripes_.load(); }
+  int schedule() const { return schedule_.load(); }
 
   bool tuning() const { return tuning_.load(); }
   double best_score() const { return best_score_.load(); }  // bytes/sec
@@ -96,6 +106,7 @@ class ParameterManager {
     bool hier_allreduce, hier_allgather, cache_enabled, compression;
     int64_t ring_segment_bytes;
     int ring_stripes;
+    int schedule;
   };
 
   void ApplyPoint(const std::vector<double>& point);
@@ -129,6 +140,7 @@ class ParameterManager {
   std::atomic<bool> compression_;
   std::atomic<int64_t> ring_segment_bytes_;
   std::atomic<int> ring_stripes_;
+  std::atomic<int> schedule_;
   std::atomic<bool> tuning_;
   std::atomic<double> best_score_;
 

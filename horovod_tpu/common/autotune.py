@@ -127,7 +127,7 @@ class ParameterManager:
                  cache_enabled=True, compression=False,
                  compression_available=False,
                  ring_segment_bytes=1 << 20, ring_stripes=2,
-                 ring_tunable=False):
+                 ring_tunable=False, schedule=0, schedule_tunable=False):
         self._lib = _lib()
         self._h = self._lib.hvd_pm_create(
             warmup_samples, steady_state_samples, bayes_opt_max_samples,
@@ -139,7 +139,8 @@ class ParameterManager:
             1 if compression else 0,
             1 if compression_available else 0,
             int(ring_segment_bytes), int(ring_stripes),
-            1 if ring_tunable else 0)
+            1 if ring_tunable else 0, int(schedule),
+            1 if schedule_tunable else 0)
 
     def record(self, nbytes):
         self._lib.hvd_pm_record(self._h, int(nbytes))
@@ -178,6 +179,12 @@ class ParameterManager:
     @property
     def ring_stripes(self):
         return int(self._lib.hvd_pm_ring_stripes(self._h))
+
+    @property
+    def schedule(self):
+        """Tuned collective schedule as the index into the canonical
+        name tuple (``ops/tcp_dataplane.py`` ``SCHEDULES``)."""
+        return int(self._lib.hvd_pm_schedule(self._h))
 
     @property
     def tuning(self):

@@ -41,6 +41,11 @@ class Config:
     # peer.  Both join the autotune walk in tcp mode.
     ring_segment_bytes: int = env_util.DEFAULT_RING_SEGMENT_BYTES
     ring_stripes: int = env_util.DEFAULT_RING_STRIPES
+    # Collective schedule for the TCP data plane (docs/tuning.md):
+    # "auto" lets the coordinator pick per tensor size and topology,
+    # the rest force one plan (flat_ring | hierarchical | rhd | star).
+    # Joins the autotune walk in tcp mode.
+    schedule: str = "auto"
     # Fault-tolerant runtime knobs (docs/fault_tolerance.md): bound on
     # abort propagation, heartbeat period, missed-heartbeat window
     # (0 disables liveness tracking), and the deterministic fault spec.
@@ -130,6 +135,8 @@ class Config:
             ring_stripes=max(1, env_util.get_int(
                 env_util.HVD_TPU_RING_STRIPES,
                 env_util.DEFAULT_RING_STRIPES)),
+            schedule=_validated_schedule(env_util.get_str(
+                env_util.HVD_TPU_SCHEDULE, "auto")),
             abort_timeout_seconds=env_util.get_float(
                 env_util.HVD_TPU_ABORT_TIMEOUT,
                 env_util.DEFAULT_ABORT_TIMEOUT_SECONDS),
@@ -217,6 +224,17 @@ def _validated_executor(name: str) -> str:
     if name not in ("psum", "mesh"):
         raise ValueError(
             f"HVD_TPU_EXECUTOR must be 'psum' or 'mesh', got {name!r}")
+    return name
+
+
+def _validated_schedule(name: str) -> str:
+    """Same fail-at-init rule: an HVD_TPU_SCHEDULE typo must not
+    silently fall back to the auto resolver."""
+    from horovod_tpu.ops.tcp_dataplane import SCHEDULES
+
+    if name not in SCHEDULES:
+        raise ValueError(
+            f"HVD_TPU_SCHEDULE must be one of {SCHEDULES}, got {name!r}")
     return name
 
 

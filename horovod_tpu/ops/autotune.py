@@ -49,6 +49,7 @@ def default_params(config):
             env_util.DEFAULT_RING_SEGMENT_BYTES),
         "ring_stripes": getattr(config, "ring_stripes",
                                 env_util.DEFAULT_RING_STRIPES),
+        "schedule": getattr(config, "schedule", "auto"),
         "tuning": False,
         "best_score_bytes_per_sec": 0.0,
     }
@@ -83,8 +84,13 @@ class AutotuneManager:
         # The ring transfer-engine knobs only steer the tcp data plane;
         # tuning them on the in-process controllers would burn walk
         # budget on inert parameters.
+        from horovod_tpu.ops.tcp_dataplane import SCHEDULES
         from horovod_tpu.utils import env as env_util
         ring_tunable = getattr(config, "controller", "native") == "tcp"
+        # the schedule knob is likewise tcp-plane-only; the int encoding
+        # is the index into the canonical SCHEDULES tuple
+        sched_name = str(getattr(config, "schedule", "auto"))
+        self._schedules = SCHEDULES
         self._pm = ParameterManager(
             ring_segment_bytes=int(getattr(
                 config, "ring_segment_bytes",
@@ -92,6 +98,9 @@ class AutotuneManager:
             ring_stripes=int(getattr(config, "ring_stripes",
                                      env_util.DEFAULT_RING_STRIPES)),
             ring_tunable=ring_tunable,
+            schedule=(SCHEDULES.index(sched_name)
+                      if sched_name in SCHEDULES else 0),
+            schedule_tunable=ring_tunable,
             warmup_samples=int(
                 getattr(config, "autotune_warmup_samples", 3)),
             steady_state_samples=int(
@@ -154,6 +163,7 @@ class AutotuneManager:
                             else "none"),
             "ring_segment_bytes": pm.ring_segment_bytes,
             "ring_stripes": pm.ring_stripes,
+            "schedule": self._schedules[pm.schedule],
             "tuning": pm.tuning,
             "best_score_bytes_per_sec": pm.best_score,
         }

@@ -95,7 +95,8 @@ def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
         rank=basics.rank(), req_type=req_type, name=name, tensor=committed,
         handle=handle, op=op, root_rank=root_rank,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        splits=splits, compression=compression))
+        splits=splits, compression=compression,
+        schedule=getattr(state.config, "schedule", "auto")))
     return handle
 
 

@@ -58,11 +58,11 @@ class MetaReq:
 
     __slots__ = ("name", "req_type", "op", "dtype", "shape", "dims0",
                  "splits", "root_rank", "prescale", "postscale", "ranks",
-                 "error", "compression")
+                 "error", "compression", "schedule")
 
     def __init__(self, name, req_type, op, dtype, shape, dims0, splits,
                  root_rank, prescale, postscale, ranks, error=None,
-                 compression="none"):
+                 compression="none", schedule="auto"):
         self.error = error  # intra-process validation failure, if any
         self.name = name
         self.req_type = int(req_type)
@@ -76,6 +76,7 @@ class MetaReq:
         self.postscale = postscale
         self.ranks = tuple(ranks)     # local ranks that submitted
         self.compression = compression  # process-resolved wire compression
+        self.schedule = schedule      # process-resolved collective schedule
 
 
 class CycleMsg:
@@ -97,13 +98,13 @@ class LogEntry:
     __slots__ = ("seq", "kind", "req_type", "names", "shapes", "dtype",
                  "op", "prescale", "postscale", "root_rank", "all_dims0",
                  "splits_matrix", "error", "last_rank", "joined", "params",
-                 "compression", "origin")
+                 "compression", "schedule", "origin")
 
     def __init__(self, seq, kind, req_type=None, names=(), shapes=(),
                  dtype=None, op=0, prescale=1.0, postscale=1.0,
                  root_rank=-1, all_dims0=None, splits_matrix=None,
                  error=None, last_rank=-1, joined=(), params=None,
-                 compression="none", origin=-1):
+                 compression="none", schedule="auto", origin=-1):
         self.seq = seq
         self.kind = kind  # "group" | "error" | "join_done" | "params"
         #                   | "abort"
@@ -122,6 +123,7 @@ class LogEntry:
         self.joined = tuple(joined)   # global joined snapshot at emit time
         self.params = params          # tuned knob dict ("params" entries)
         self.compression = compression  # coordinator-resolved wire format
+        self.schedule = schedule      # coordinator-resolved schedule
         self.origin = origin          # abort origin rank ("abort" entries)
 
 
@@ -325,7 +327,8 @@ class MetaCoordinatorService(network.MuxService):
                 return ("single", item[0])
             return PythonController.allreduce_bucket_key(
                 meta["dtype"], meta["op"], meta["prescale"],
-                meta["postscale"], meta.get("compression", "none"))
+                meta["postscale"], meta.get("compression", "none"),
+                meta.get("schedule", "auto"))
 
         def nbytes(item):
             _, meta = item
@@ -361,6 +364,7 @@ class MetaCoordinatorService(network.MuxService):
                     prescale=first_meta["prescale"],
                     postscale=first_meta["postscale"],
                     compression=first_meta.get("compression", "none"),
+                    schedule=first_meta.get("schedule", "auto"),
                     joined=sorted(self._joined)))
             else:
                 name, meta = bucket[0]
@@ -443,7 +447,13 @@ class MetaCoordinatorService(network.MuxService):
                 # cross-process wire-format resolution, same rule as the
                 # in-process controllers: unanimous wins, else exact
                 "compression": PythonController.resolve_group_compression(
-                    getattr(r, "compression", "none") for r in reqs)}
+                    getattr(r, "compression", "none") for r in reqs),
+                # cross-process schedule resolution: unanimous wins,
+                # else auto — and it joins the bucket key above, so
+                # requests negotiated for different schedules can never
+                # fuse into one program
+                "schedule": PythonController.resolve_group_schedule(
+                    getattr(r, "schedule", "auto") for r in reqs)}
 
         if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM):
             if any(r.shape != first.shape for r in reqs):
@@ -856,7 +866,9 @@ class GlobalMeshController(PythonController):
             postscale=first.postscale_factor, ranks=sorted(reqs.keys()),
             error=error,
             compression=self.resolve_group_compression(
-                r.compression for r in reqs.values()))
+                r.compression for r in reqs.values()),
+            schedule=self.resolve_group_schedule(
+                getattr(r, "schedule", "auto") for r in reqs.values()))
 
     # ------------------------------------------------------------- execution
     def _apply(self, entry):
@@ -915,7 +927,8 @@ class GlobalMeshController(PythonController):
                 op=ReduceOp(entry.op), prescale_factor=entry.prescale,
                 postscale_factor=entry.postscale,
                 all_dims0=entry.all_dims0,
-                compression=getattr(entry, "compression", "none")))
+                compression=getattr(entry, "compression", "none"),
+                schedule=getattr(entry, "schedule", "auto")))
             self._timeline.end(name)
 
         # execution + error surfacing shared with the in-process

@@ -153,7 +153,7 @@ def _load_lib():
                                   ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                   ctypes.c_int, ctypes.c_int,
                                   ctypes.c_int64, ctypes.c_int,
-                                  ctypes.c_int]
+                                  ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.hvd_pm_destroy.argtypes = [ctypes.c_void_p]
     lib.hvd_pm_record.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.hvd_pm_update.restype = ctypes.c_int
@@ -165,7 +165,7 @@ def _load_lib():
     for fn in ("hvd_pm_hierarchical_allreduce",
                "hvd_pm_hierarchical_allgather", "hvd_pm_cache_enabled",
                "hvd_pm_compression_enabled", "hvd_pm_tuning",
-               "hvd_pm_ring_stripes"):
+               "hvd_pm_ring_stripes", "hvd_pm_schedule"):
         getattr(lib, fn).restype = ctypes.c_int
         getattr(lib, fn).argtypes = [ctypes.c_void_p]
     lib.hvd_pm_ring_segment_bytes.restype = ctypes.c_int64

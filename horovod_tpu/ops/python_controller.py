@@ -50,6 +50,7 @@ class EagerRequest:
     postscale_factor: float = 1.0
     splits: list | None = None
     compression: str = "none"
+    schedule: str = "auto"
 
     def signature(self):
         """Everything validation checks, flattened into a hashable key
@@ -61,7 +62,7 @@ class EagerRequest:
         return (self.req_type, dtype, shape, self.op, self.root_rank,
                 self.prescale_factor, self.postscale_factor,
                 tuple(self.splits) if self.splits is not None else None,
-                self.compression)
+                self.compression, self.schedule)
 
 
 class _NameEntry:
@@ -80,11 +81,12 @@ class GroupEntry:
 
     __slots__ = ("name", "shape", "dtype", "tensors", "handles", "root_rank",
                  "splits", "op", "prescale_factor", "postscale_factor",
-                 "all_dims0", "compression")
+                 "all_dims0", "compression", "schedule")
 
     def __init__(self, name, shape, dtype, tensors, handles, root_rank=-1,
                  splits=None, op=ReduceOp.SUM, prescale_factor=1.0,
-                 postscale_factor=1.0, all_dims0=None, compression="none"):
+                 postscale_factor=1.0, all_dims0=None, compression="none",
+                 schedule="auto"):
         self.name = name
         self.shape = shape
         self.dtype = dtype
@@ -97,6 +99,7 @@ class GroupEntry:
         self.postscale_factor = postscale_factor
         self.all_dims0 = all_dims0
         self.compression = compression
+        self.schedule = schedule
 
 
 class PythonController:
@@ -183,6 +186,11 @@ class PythonController:
                 int(params["ring_segment_bytes"])
         if "ring_stripes" in params:
             self._config.ring_stripes = int(params["ring_stripes"])
+        if "schedule" in params:
+            # the DEFAULT collective schedule stamped on subsequent
+            # requests (tcp plane: ring-vs-star choice + coordinator
+            # negotiation input); in-flight requests keep theirs
+            self._config.schedule = str(params["schedule"])
 
     def enqueue(self, request: EagerRequest):
         with self._lock:
@@ -393,6 +401,16 @@ class PythonController:
         comps = set(compressions)
         return comps.pop() if len(comps) == 1 else "none"
 
+    @staticmethod
+    def resolve_group_schedule(schedules):
+        """Cross-rank collective-schedule resolution, same contract as
+        the compression resolver: unanimous choice wins, disagreement —
+        e.g. a tuned schedule applying at slightly different times on
+        different ranks — resolves to "auto" (the coordinator then
+        picks) rather than erroring."""
+        scheds = set(schedules)
+        return scheds.pop() if len(scheds) == 1 else "auto"
+
     def _build_group(self, name, entry):
         """Build the executor GroupEntry from an already-validated (or
         cache-hit) table entry."""
@@ -410,7 +428,10 @@ class PythonController:
             op=any_req.op, prescale_factor=any_req.prescale_factor,
             postscale_factor=any_req.postscale_factor,
             compression=self.resolve_group_compression(
-                r.compression for r in requests.values()))
+                r.compression for r in requests.values()),
+            schedule=self.resolve_group_schedule(
+                getattr(r, "schedule", "auto")
+                for r in requests.values()))
 
     # ------------------------------------------------------------- validation
     @staticmethod
@@ -511,14 +532,17 @@ class PythonController:
     # ----------------------------------------------------------------- fusion
     @staticmethod
     def allreduce_bucket_key(dtype, op, prescale, postscale,
-                             compression="none"):
+                             compression="none", schedule="auto"):
         """Bucket-compatibility key shared with the gmesh coordinator
         (reference: FuseResponses fuses dtype/op/scale-homogeneous runs).
         Compression is part of the key: a compressed and an uncompressed
         request must never fuse into one program — they have different
-        wire formats and different numerics."""
+        wire formats and different numerics.  The collective schedule
+        likewise: requests negotiated for different schedules must never
+        fuse into one bucket (a hierarchical and a flat-ring tensor take
+        different data paths with different round structures)."""
         return (np.dtype(dtype).name, int(op), prescale, postscale,
-                compression)
+                compression, schedule)
 
     def _dispatch(self, responses):
         """Fuse compatible allreduces into <= fusion_threshold buckets
@@ -538,7 +562,8 @@ class PythonController:
                 return ("single", id(group))  # never fuses
             return self.allreduce_bucket_key(
                 group.dtype, group.op, group.prescale_factor,
-                group.postscale_factor, group.compression)
+                group.postscale_factor, group.compression,
+                getattr(group, "schedule", "auto"))
 
         def nbytes(item):
             _, group = item

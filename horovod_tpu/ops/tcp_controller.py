@@ -50,7 +50,9 @@ from horovod_tpu.common.ops_enum import (ReduceOp, RequestType,
                                          is_float_dtype,
                                          reduce_scatter_split_sizes)
 from horovod_tpu.common.response_cache import SignatureCache
-from horovod_tpu.ops.tcp_dataplane import (DEFAULT_RING_THRESHOLD,
+from horovod_tpu.ops.tcp_dataplane import (DEFAULT_RHD_MAX_BYTES,
+                                           DEFAULT_RHD_MIN_BYTES,
+                                           DEFAULT_RING_THRESHOLD,
                                            PeerService, RingPlane,
                                            RingSendError)
 from horovod_tpu.run.service import network
@@ -73,7 +75,8 @@ GC_PURGED_KEY = "purged-epoch"
 class CollectiveMsg:
     def __init__(self, name, rank, req_type, op, payload, shape, dtype,
                  root_rank=-1, splits=None, prescale=1.0, postscale=1.0,
-                 ring=False, sig=None, compression="none", epoch=0):
+                 ring=False, sig=None, compression="none", epoch=0,
+                 schedule="auto"):
         self.name = name
         self.epoch = epoch              # sender's membership epoch
         self.rank = rank
@@ -89,6 +92,7 @@ class CollectiveMsg:
         self.ring = ring
         self.sig = sig                  # signature digest (response cache)
         self.compression = compression  # requested wire compression
+        self.schedule = schedule        # requested collective schedule
 
 
 class ResultMsg:
@@ -96,7 +100,7 @@ class ResultMsg:
                  recv_splits=None, ring_go=False, participants=None,
                  dims0=None, ring_id=None, params_seq=0, params=None,
                  resend=False, compression="none", aborted=None,
-                 ring_segment_bytes=None):
+                 ring_segment_bytes=None, schedule=None, groups=None):
         self.payload = payload
         self.shape = shape
         self.dtype = dtype
@@ -116,6 +120,15 @@ class ResultMsg:
         # ring endpoints must derive the same segment plan even while a
         # tuned value propagates
         self.ring_segment_bytes = ring_segment_bytes
+        # coordinator-resolved collective schedule for THIS round,
+        # stamped like the segment size so endpoints can't desync:
+        # "flat_ring" | "hierarchical" | "rhd" (None: flat ring, the
+        # pre-schedule wire default)
+        self.schedule = schedule
+        # hierarchical only: the group plan (list of sorted rank lists)
+        # every participant executes — stamped so re-grouping after an
+        # elastic reconfiguration is digest-identical by construction
+        self.groups = groups
 
 
 class JoinMsg:
@@ -182,7 +195,8 @@ def _signature(msg) -> bytes:
     parts = (msg.req_type, msg.op, msg.dtype, tuple(msg.shape),
              msg.root_rank, tuple(msg.splits or ()), msg.prescale,
              msg.postscale, bool(msg.ring),
-             getattr(msg, "compression", "none"))
+             getattr(msg, "compression", "none"),
+             getattr(msg, "schedule", "auto"))
     return hashlib.sha1(repr(parts).encode()).digest()
 
 
@@ -254,6 +268,9 @@ class CoordinatorService(network.MuxService):
             if straggler_windows is None else straggler_windows)
         self._straggler_exclude = straggler_exclude
         self._peer_rtt = {}        # rank -> seconds; guarded by self._cv
+        # rank -> launcher host hash carried on heartbeats: the raw
+        # material for hierarchical group planning; guarded by self._cv
+        self._host_of = {}
         # rank -> consecutive over-threshold scans; guarded by self._cv
         self._straggler_hits = {}
         # rank -> verdict dict, sticky; guarded by self._cv
@@ -290,6 +307,9 @@ class CoordinatorService(network.MuxService):
                     rtt = getattr(req, "rtt", None)
                     if rtt is not None:
                         self._peer_rtt[rank] = float(rtt)
+                    host = getattr(req, "host", None)
+                    if host is not None:
+                        self._host_of[rank] = host
         if isinstance(req, CollectiveMsg):
             return self._handle_collective(req)
         if isinstance(req, JoinMsg):
@@ -316,6 +336,7 @@ class CoordinatorService(network.MuxService):
                     self._draining.discard(req.rank)
                     self._peer_rtt.pop(req.rank, None)
                     self._straggler_hits.pop(req.rank, None)
+                    self._host_of.pop(req.rank, None)
             return network.AckResponse()
         return super()._handle(req, client_address)
 
@@ -737,6 +758,81 @@ class CoordinatorService(network.MuxService):
             return int(published[1]["ring_segment_bytes"])
         return None
 
+    def _sched(self):
+        """Latest published tuned schedule (autotune walk probing the
+        schedule knob), or None when unpublished / left on auto."""
+        # latest-wins advisory read (see _complete)
+        published = self._published  # hvd-lint: ignore[lock-discipline]
+        if published is not None and "schedule" in published[1]:
+            val = published[1]["schedule"]
+            if val and val != "auto":
+                return str(val)
+        return None
+
+    def _plan_groups(self, participants):  # holds: self._cv
+        """Partition ``participants`` into co-located groups for the
+        hierarchical schedule.  Precedence: an explicit
+        ``HVD_HIER_LOCAL_SIZE`` (> 0) chunks the sorted membership (the
+        deterministic override, and the only grouping available on a
+        single host); otherwise the launcher host hashes carried on
+        heartbeats.  Returns None when no two-level plan exists (every
+        rank on one host, or one rank per host, or unknown topology).
+        Planned per collective from live membership, so an elastic
+        reconfiguration that breaks a host group re-plans automatically
+        — and because the plan is stamped on the ring_go, every
+        survivor executes the identical (digest-identical) grouping."""
+        ranks = sorted(participants)
+        local = env_util.get_int(env_util.HVD_HIER_LOCAL_SIZE, 0)
+        if local > 0:
+            groups = [ranks[i:i + local]
+                      for i in range(0, len(ranks), local)]
+        else:
+            by_host = {}
+            for r in ranks:
+                host = self._host_of.get(r)
+                if host is None:
+                    return None     # unknown topology: stay flat
+                by_host.setdefault(host, []).append(r)
+            groups = sorted(by_host.values(), key=lambda g: g[0])
+        if len(groups) < 2 or all(len(g) == 1 for g in groups):
+            return None
+        return groups
+
+    def _resolve_schedule(self, reqs, participants, nbytes):
+        """Resolve the collective schedule for one ring round (same
+        role as the compression resolution: unanimous request wins,
+        disagreement falls back to auto).  Auto picks rhd in the
+        latency-bound small-tensor regime, hierarchical when the
+        topology offers co-located groups, flat ring otherwise.  A
+        forced-but-infeasible hierarchical degrades to the flat ring;
+        "star" reaching a ring round (possible mid-propagation of a
+        tuned value) likewise runs flat — the star IS the payload
+        path, decided worker-side before the ring_go."""
+        from horovod_tpu.ops.python_controller import PythonController
+
+        sched = PythonController.resolve_group_schedule(
+            getattr(r, "schedule", "auto") for r in reqs.values())
+        if sched == "auto":
+            sched = self._sched() or "auto"
+        groups = None
+        if sched in ("auto", "hierarchical"):
+            groups = self._plan_groups(participants)
+        if sched == "auto":
+            if (DEFAULT_RHD_MIN_BYTES <= nbytes <= DEFAULT_RHD_MAX_BYTES
+                    and len(participants) > 1):
+                sched = "rhd"
+            elif groups is not None:
+                sched = "hierarchical"
+            else:
+                sched = "flat_ring"
+        if sched == "hierarchical" and groups is None:
+            sched = "flat_ring"
+        if sched != "hierarchical":
+            groups = None
+        if sched == "star":
+            sched = "flat_ring"
+        return sched, groups
+
     def _execute(self, name, entry):  # holds: self._cv
         reqs = entry.requests
         first = next(iter(reqs.values()))
@@ -794,11 +890,21 @@ class CoordinatorService(network.MuxService):
                 comp = PythonController.resolve_group_compression(
                     getattr(r, "compression", "none")
                     for r in reqs.values())
+                count = 1
+                for d in first.shape:
+                    count *= int(d)
+                try:
+                    nbytes = count * np.dtype(first.dtype).itemsize
+                except TypeError:
+                    nbytes = count * 2      # extension dtype (bf16)
+                sched, groups = self._resolve_schedule(
+                    reqs, participants, nbytes)
                 return {r: ResultMsg(ring_go=True,
                                      participants=participants,
                                      ring_id=self._ring_seq,
                                      compression=comp,
-                                     ring_segment_bytes=self._ring_seg())
+                                     ring_segment_bytes=self._ring_seg(),
+                                     schedule=sched, groups=groups)
                         for r in reqs}
             if ring and rtype == RequestType.ADASUM:
                 participants = sorted(reqs.keys())
@@ -1023,6 +1129,7 @@ class TcpController:
         self._inflight = {}
         self._hb_stop = threading.Event()
         self._hb_thread = None
+        self._host_hash_val = None      # cached launcher host identity
         self._log = get_logger()
 
     def _scope(self, base):
@@ -1182,8 +1289,14 @@ class TcpController:
             # own connect retry already absorbed transient blips.
             try:
                 t0 = time.monotonic()
-                self._client().send(network.HeartbeatMsg(self._rank),
-                                    timeout=30.0)
+                # the registration beat carries this rank's launcher
+                # host hash: the coordinator needs the full topology
+                # BEFORE the first collective so the hierarchical
+                # schedule is plannable from round one
+                self._client().send(
+                    network.HeartbeatMsg(self._rank,
+                                         host=self._host_hash()),
+                    timeout=30.0)
                 # seed the control-plane RTT EWMA with the very first
                 # round-trip so the adaptive deadline starts from a
                 # measured baseline, not from zero slack
@@ -1262,6 +1375,15 @@ class TcpController:
                          name="hvd-tcp-req").start()
 
     # ------------------------------------------------------- fault tolerance
+    def _host_hash(self):
+        """This rank's launcher host identity (run/host_hash.py),
+        computed once: heartbeats carry it so the coordinator can group
+        co-located ranks when planning the hierarchical schedule."""
+        if self._host_hash_val is None:
+            from horovod_tpu.run.host_hash import host_hash
+            self._host_hash_val = host_hash()
+        return self._host_hash_val
+
     def _heartbeat_loop(self, interval):
         # a DEDICATED no-retry client: the shared mux's connect retry
         # (HVD_TPU_CONNECT_RETRY_SECONDS per attempt) would stretch the
@@ -1283,7 +1405,8 @@ class TcpController:
                     reply = hb_client.send(
                         network.HeartbeatMsg(self._rank,
                                              busy=busy.active(),
-                                             rtt=tracker.worst() or None),
+                                             rtt=tracker.worst() or None,
+                                             host=self._host_hash()),
                         timeout=max(interval * 2, 5.0))
                     tracker.sample(rtt_mod.COORD_KEY,
                                    time.monotonic() - t0)
@@ -1493,9 +1616,24 @@ class TcpController:
             # payload path via resend)
             return (nbytes >= self._ring_threshold
                     and self._size & (self._size - 1) == 0)
+        if rtype == RequestType.ALLREDUCE:
+            # the schedule knob owns the ring-vs-star choice for
+            # allreduce: a forced ring schedule always negotiates
+            # ring_go, "star" always rides coordinator payloads, and
+            # auto keeps the threshold split — sub-threshold tensors
+            # stay on the star (its single fused round-trip plus the
+            # fusion/caching machinery beat per-tensor ring
+            # negotiation there); WHICH peer pattern a ring-bound
+            # tensor runs is the coordinator's pick (_resolve_schedule:
+            # rhd in the latency band, hierarchical over groups)
+            sched = getattr(self._config, "schedule", "auto")
+            if sched == "star":
+                return False
+            if sched in ("flat_ring", "hierarchical", "rhd"):
+                return True
+            return nbytes >= self._ring_threshold
         return (nbytes >= self._ring_threshold
-                and rtype in (RequestType.ALLREDUCE,
-                              RequestType.BROADCAST,
+                and rtype in (RequestType.BROADCAST,
                               RequestType.REDUCE_SCATTER))
 
     def _run_one(self, request, force_payload=False):
@@ -1522,7 +1660,8 @@ class TcpController:
                 prescale=request.prescale_factor,
                 postscale=request.postscale_factor, ring=ring,
                 compression=getattr(request, "compression", "none"),
-                epoch=self._epoch)
+                epoch=self._epoch,
+                schedule=getattr(self._config, "schedule", "auto"))
             msg.sig = _signature(msg)
             self._timeline.begin(request.name,
                                  f"NEGOTIATE_{rtype.name}")
@@ -1615,16 +1754,29 @@ class TcpController:
         # a tuned value is published): both endpoints of every ring hop
         # must slice identically, whatever this rank last applied
         seg = getattr(resp, "ring_segment_bytes", None)
+        # coordinator-resolved schedule for this round, stamped like the
+        # segment size so every participant runs the identical plan
+        sched = getattr(resp, "schedule", None)
+        groups = getattr(resp, "groups", None)
         try:
             if rtype == RequestType.ALLREDUCE:
-                out = self._ring.allreduce(
-                    resp.ring_id, arr, resp.participants,
+                kwargs = dict(
                     op_average=(ReduceOp(request.op) == ReduceOp.AVERAGE),
                     world_size=self._size,
                     prescale=request.prescale_factor,
                     postscale=request.postscale_factor, timeout=timeout,
                     compression=getattr(resp, "compression", "none"),
                     segment_bytes=seg)
+                if sched == "hierarchical" and groups:
+                    out = self._ring.allreduce_hierarchical(
+                        resp.ring_id, arr, resp.participants, groups,
+                        **kwargs)
+                elif sched == "rhd":
+                    out = self._ring.allreduce_rhd(
+                        resp.ring_id, arr, resp.participants, **kwargs)
+                else:
+                    out = self._ring.allreduce(
+                        resp.ring_id, arr, resp.participants, **kwargs)
             elif rtype == RequestType.REDUCE_SCATTER:
                 out = self._ring.reduce_scatter(
                     resp.ring_id, arr, resp.participants,
@@ -1756,6 +1908,12 @@ class TcpController:
                 self._config.ring_stripes = int(params["ring_stripes"])
                 if self._ring is not None:
                     self._ring.stripes = int(params["ring_stripes"])
+            if "schedule" in params:
+                # worker-side effect is the ring-vs-star choice in
+                # _use_ring; the per-round plan itself always comes
+                # stamped on the ring_go, so a transiently-stale value
+                # here can never desync a round
+                self._config.schedule = str(params["schedule"])
 
     def tuned_params(self):
         """Same surface as the native controller (reference:

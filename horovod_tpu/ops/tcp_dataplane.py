@@ -67,6 +67,22 @@ from horovod_tpu.utils import env as env_util
 # payloads at or above this ride the ring; below it the coordinator star
 # round-trip is latency-optimal (one RTT, no rendezvous fan-out)
 DEFAULT_RING_THRESHOLD = 1 << 20
+# the collective schedules the coordinator can stamp on a ring_go
+# (docs/tuning.md "Choosing a collective schedule"); order is the wire
+# encoding the C++ ParameterManager autotune walk uses (index = int id)
+SCHEDULES = ("auto", "flat_ring", "hierarchical", "rhd", "star")
+# the latency-bound regime: among RING-BOUND tensors (past
+# HVD_TCP_RING_THRESHOLD, or schedule-forced onto the ring), the
+# coordinator resolves auto to recursive halving/doubling (O(log N)
+# serialized rounds vs the flat ring's O(N)) inside [MIN, MAX].
+# Below MIN the coordinator star's single fused round-trip wins —
+# log2(P) serialized peer hops cost more than one coordinator
+# exchange for control-plane-sized tensors — and forcing tiny tensors
+# onto the ring would also bypass the star's fusion/caching machinery,
+# so the band never widens ring ENTRY: sub-threshold traffic keeps
+# the star unless a ring schedule is forced
+DEFAULT_RHD_MAX_BYTES = 1 << 18
+DEFAULT_RHD_MIN_BYTES = 1 << 13
 # broadcast pipeline chunk when segmenting is disabled
 BCAST_CHUNK = 1 << 22
 # pipeline segment size / bulk connections per peer (tunable:
@@ -786,6 +802,268 @@ class RingPlane:
             carry = recv_owner
         self._flush_sends(timeout)
         return np.concatenate([dec(blobs[i], sizes[i]) for i in range(p)])
+
+    # -------------------------------------------- hierarchical allreduce
+    def allreduce_hierarchical(self, ring_id, arr, participants, groups,
+                               *, op_average, world_size, prescale=1.0,
+                               postscale=1.0, timeout=None,
+                               compression="none", segment_bytes=None):
+        """Two-level (topology-aware) allreduce (the MLPerf TPU-pod
+        schedule, arXiv:1909.09756, mapped onto the TCP plane).
+
+        ``groups`` partitions ``participants`` into co-located sets (the
+        coordinator stamps them from launcher host hashes or
+        ``HVD_HIER_LOCAL_SIZE``).  Four phases:
+
+        1. intra-group reduce-scatter — every member ships its
+           contribution to each group slice straight to the slice's
+           owner (one serialized round, same (g-1)/g bytes as a ring
+           reduce-scatter);
+        2. slice gather — members hand their reduced slice to the
+           group's delegate (its min rank), which assembles the full
+           group sum;
+        3. delegates run the existing striped/pipelined ring
+           (:meth:`_allreduce_exact` / :meth:`_allreduce_compressed`)
+           across groups — rank-consistency and compression compose
+           unchanged;
+        4. each delegate encodes the global result ONCE and every group
+           member (the delegate included) decodes the same blob, so the
+           result is bitwise identical on all ranks.
+
+        The flat ring serializes 2·(P−1) rounds; this schedule runs
+        3 + 2·(G−1) rounds for G groups — the latency term the scaling
+        curve collapses under (docs/tuning.md)."""
+        participants = sorted(participants)
+        out_dtype = arr.dtype
+        float_in = is_float_dtype(arr.dtype)
+        wire_dt, acc_dtype = _wire_spec(
+            arr.dtype, prescale, widen=op_average or postscale != 1.0)
+        flat = arr.reshape(-1).astype(acc_dtype)
+        if prescale != 1.0:
+            flat = flat * prescale
+        codec = (_codecs().get(compression)
+                 if float_in and compression not in (None, "none") else None)
+        enc, dec, enc_nbytes = codec if codec else (None, None, None)
+        seg = (self.segment_bytes if segment_bytes is None
+               else int(segment_bytes))
+        item = wire_dt.itemsize
+
+        groups = [sorted(g) for g in groups]
+        groups.sort(key=lambda g: g[0])
+        group = next(g for g in groups if self.rank in g)
+        g = len(group)
+        gidx = group.index(self.rank)
+        delegate = group[0]
+        delegates = [gr[0] for gr in groups]
+
+        # phase 1: intra-group owner-targeted reduce-scatter.  Slice d
+        # of the flat vector belongs to group member d; contributions
+        # are wire-encoded once at the source and accumulated wide at
+        # the owner in group order (deterministic, like the star).
+        chunks = np.array_split(flat, g)
+        sizes = [c.size for c in chunks]
+        if g > 1:
+            own = chunks[gidx].astype(
+                np.float64 if codec else acc_dtype, copy=True)
+            for d in range(g):
+                if d == gidx:
+                    continue
+                if codec is None:
+                    out = chunks[d].astype(wire_dt)
+                    self.send_chunk(group[d], (ring_id, "h1", d),
+                                    _as_bytes_view(out), seg_bytes=seg,
+                                    align=item)
+                else:
+                    self.send_chunk(group[d], (ring_id, "h1", d),
+                                    enc(np.ascontiguousarray(chunks[d])),
+                                    seg_bytes=seg)
+            for src_i, src in enumerate(group):
+                if src_i == gidx:
+                    continue
+                if codec is None:
+                    blob = self.recv_chunk(
+                        (ring_id, "h1", gidx), src, sizes[gidx] * item,
+                        timeout=timeout, seg_bytes=seg, align=item)
+                    own += np.frombuffer(blob, wire_dt).astype(
+                        acc_dtype, copy=False)
+                else:
+                    blob = self.recv_chunk(
+                        (ring_id, "h1", gidx), src,
+                        enc_nbytes(sizes[gidx]), timeout=timeout,
+                        seg_bytes=seg)
+                    own += dec(blob, sizes[gidx])
+
+            # phase 2: gather the reduced slices at the delegate
+            if gidx != 0:
+                if codec is None:
+                    out = own.astype(wire_dt)
+                    self.send_chunk(delegate, (ring_id, "h2", gidx),
+                                    _as_bytes_view(out), seg_bytes=seg,
+                                    align=item)
+                else:
+                    self.send_chunk(delegate, (ring_id, "h2", gidx),
+                                    enc(np.ascontiguousarray(own)),
+                                    seg_bytes=seg)
+                total = None
+            else:
+                parts = [own]
+                for i in range(1, g):
+                    if codec is None:
+                        blob = self.recv_chunk(
+                            (ring_id, "h2", i), group[i],
+                            sizes[i] * item, timeout=timeout,
+                            seg_bytes=seg, align=item)
+                        parts.append(np.frombuffer(blob, wire_dt).astype(
+                            acc_dtype, copy=False))
+                    else:
+                        blob = self.recv_chunk(
+                            (ring_id, "h2", i), group[i],
+                            enc_nbytes(sizes[i]), timeout=timeout,
+                            seg_bytes=seg)
+                        parts.append(dec(blob, sizes[i]))
+                total = np.concatenate(parts)
+        else:
+            total = flat if gidx == 0 else None
+
+        if gidx == 0:
+            # phase 3: the existing cross-group ring among delegates
+            # ("rs"/"ag"/"qrs"/"qag" tags — disjoint from the "h*"
+            # intra-group tags, all under this ring_id so purge() still
+            # clears everything)
+            if len(delegates) > 1:
+                didx = delegates.index(self.rank)
+                if codec is None:
+                    total = self._allreduce_exact(
+                        ring_id, total.astype(acc_dtype, copy=False),
+                        delegates, didx, wire_dt, acc_dtype, timeout, seg)
+                else:
+                    total = self._allreduce_compressed(
+                        ring_id, total, delegates, didx, codec, timeout,
+                        seg)
+            # phase 4: encode the global result ONCE; every rank in the
+            # group (this delegate included) decodes the same blob, so
+            # the result is bitwise identical everywhere
+            if codec is None:
+                wire = np.ascontiguousarray(total.astype(wire_dt))
+                blob = _as_bytes_view(wire)
+                for peer in group[1:]:
+                    self.send_chunk(peer, (ring_id, "h3"), blob,
+                                    seg_bytes=seg, align=item)
+                total = wire.astype(acc_dtype)
+            else:
+                blob = enc(np.ascontiguousarray(total))
+                for peer in group[1:]:
+                    self.send_chunk(peer, (ring_id, "h3"), blob,
+                                    seg_bytes=seg)
+                total = dec(blob, flat.size).astype(np.float64)
+        else:
+            if codec is None:
+                blob = self.recv_chunk(
+                    (ring_id, "h3"), delegate, flat.size * item,
+                    timeout=timeout, seg_bytes=seg, align=item)
+                total = np.frombuffer(blob, wire_dt).astype(acc_dtype)
+            else:
+                blob = self.recv_chunk(
+                    (ring_id, "h3"), delegate, enc_nbytes(flat.size),
+                    timeout=timeout, seg_bytes=seg)
+                total = dec(blob, flat.size).astype(np.float64)
+        self._flush_sends(timeout)
+        if op_average:
+            total = total / world_size
+        if postscale != 1.0:
+            total = total * postscale
+        return total.astype(out_dtype).reshape(arr.shape)
+
+    # --------------------------------- recursive halving/doubling (rhd)
+    def allreduce_rhd(self, ring_id, arr, participants, *, op_average,
+                      world_size, prescale=1.0, postscale=1.0,
+                      timeout=None, compression="none",
+                      segment_bytes=None):
+        """Latency-optimal small-tensor allreduce: recursive doubling
+        with a fold-in step for non-power-of-two rings — O(log P)
+        serialized rounds against the flat ring's 2·(P−1) and the
+        coordinator star's O(P·bytes) hot spot.
+
+        Extras (the P − 2^m highest positions) fold their vector into a
+        power-of-two partner, the 2^m survivors run log2 rounds of
+        pairwise full-vector exchange, and partners hand the finished
+        vector back verbatim.  Every level re-encodes the local partial
+        to the wire dtype and accumulates ``decode(mine) +
+        decode(theirs)`` — both partners add the SAME two wire values
+        (IEEE addition is commutative and deterministic), so by
+        induction every rank finishes with bitwise-identical bytes.
+
+        ``compression`` is accepted for signature parity but the wire
+        stays in the native dtype: this schedule serves the
+        latency-bound ≤``DEFAULT_RHD_MAX_BYTES`` regime where a
+        quantization pass costs more than the bytes it saves."""
+        participants = sorted(participants)
+        p = len(participants)
+        idx = participants.index(self.rank)
+        out_dtype = arr.dtype
+        wire_dt, acc_dtype = _wire_spec(
+            arr.dtype, prescale, widen=op_average or postscale != 1.0)
+        flat = arr.reshape(-1).astype(acc_dtype)
+        if prescale != 1.0:
+            flat = flat * prescale
+        seg = (self.segment_bytes if segment_bytes is None
+               else int(segment_bytes))
+        item = wire_dt.itemsize
+        nbytes = flat.size * item
+
+        if p > 1:
+            m = p.bit_length() - 1        # floor(log2(p))
+            pow2 = 1 << m
+            if idx >= pow2:
+                # extra: fold into the partner, receive the result back
+                partner = participants[idx - pow2]
+                out = np.ascontiguousarray(flat.astype(wire_dt))
+                self.send_chunk(partner, (ring_id, "rdf"),
+                                _as_bytes_view(out), seg_bytes=seg,
+                                align=item)
+                blob = self.recv_chunk((ring_id, "rdb"), partner, nbytes,
+                                       timeout=timeout, seg_bytes=seg,
+                                       align=item)
+                flat = np.frombuffer(blob, wire_dt).astype(acc_dtype)
+            else:
+                if idx + pow2 < p:
+                    blob = self.recv_chunk(
+                        (ring_id, "rdf"), participants[idx + pow2],
+                        nbytes, timeout=timeout, seg_bytes=seg,
+                        align=item)
+                    flat = flat + np.frombuffer(blob, wire_dt).astype(
+                        acc_dtype, copy=False)
+                for k in range(m):
+                    partner = participants[idx ^ (1 << k)]
+                    mine = np.ascontiguousarray(flat.astype(wire_dt))
+                    self.send_chunk(partner, (ring_id, "rd", k),
+                                    _as_bytes_view(mine), seg_bytes=seg,
+                                    align=item)
+                    blob = self.recv_chunk(
+                        (ring_id, "rd", k), partner, nbytes,
+                        timeout=timeout, seg_bytes=seg, align=item)
+                    # decode(mine) + decode(theirs): both partners sum
+                    # the same wire values -> bitwise-equal partials
+                    flat = (mine.astype(acc_dtype) +
+                            np.frombuffer(blob, wire_dt).astype(
+                                acc_dtype, copy=False))
+                # adopt the final wire encoding on EVERY survivor (not
+                # just partners of extras) so extras' decoded copies and
+                # survivors' accumulators agree bitwise before any
+                # average/postscale math
+                wfin = np.ascontiguousarray(flat.astype(wire_dt))
+                if idx + pow2 < p:
+                    self.send_chunk(participants[idx + pow2],
+                                    (ring_id, "rdb"),
+                                    _as_bytes_view(wfin), seg_bytes=seg,
+                                    align=item)
+                flat = wfin.astype(acc_dtype)
+            self._flush_sends(timeout)
+        if op_average:
+            flat = flat / world_size
+        if postscale != 1.0:
+            flat = flat * postscale
+        return flat.astype(out_dtype).reshape(arr.shape)
 
     # -------------------------------------------------------- reduce_scatter
     def reduce_scatter(self, ring_id, arr, participants, *, op_average,

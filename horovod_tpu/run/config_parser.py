@@ -24,6 +24,7 @@ _PARAMS = {
                      "params.ring_stripes"),
     "tcp_ring_threshold": (env_util.HVD_TCP_RING_THRESHOLD,
                            "params.tcp_ring_threshold"),
+    "schedule": (env_util.HVD_TPU_SCHEDULE, "params.schedule"),
     "autotune": (env_util.HVD_AUTOTUNE, "autotune.enabled"),
     "autotune_log_file": (env_util.HVD_AUTOTUNE_LOG, "autotune.log_file"),
     "autotune_warmup_samples": (env_util.HVD_AUTOTUNE_WARMUP_SAMPLES, "autotune.warmup_samples"),

@@ -128,6 +128,16 @@ def make_parser():
                             "collectives ride the p2p ring instead of "
                             "the coordinator star "
                             "(HVD_TCP_RING_THRESHOLD, default 1 MB).")
+    group.add_argument("--schedule",
+                       choices=["auto", "flat_ring", "hierarchical",
+                                "rhd", "star"],
+                       default=None,
+                       help="Collective schedule for the tcp data plane "
+                            "(HVD_TPU_SCHEDULE): 'auto' picks per tensor "
+                            "size/topology; 'hierarchical' is the "
+                            "two-level intra-group + delegate-ring plan; "
+                            "'rhd' is recursive halving/doubling for the "
+                            "latency-bound regime — see docs/tuning.md.")
     group.add_argument("--controller", choices=["native", "python", "tcp"],
                        default=None)
 

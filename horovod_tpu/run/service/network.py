@@ -74,8 +74,12 @@ class AbortMsg:
 
 
 class HeartbeatMsg:
-    def __init__(self, rank, busy=False, rtt=None):
+    def __init__(self, rank, busy=False, rtt=None, host=None):
         self.rank = rank
+        # sender's launcher host hash (run/host_hash.py): the
+        # coordinator groups co-located ranks from these when planning
+        # the hierarchical collective schedule (docs/tuning.md)
+        self.host = host
         # rank is inside a known-slow-but-alive window (checkpoint
         # write, drain teardown): the coordinator widens its liveness
         # deadline so disk I/O can't read as death (docs/checkpoint.md)

@@ -58,6 +58,10 @@ HVD_TPU_RING_STRIPES = "HVD_TPU_RING_STRIPES"
 # payload size at/above which tcp-mode collectives ride the p2p ring
 # instead of the coordinator star (docs/tuning.md)
 HVD_TCP_RING_THRESHOLD = "HVD_TCP_RING_THRESHOLD"
+# tcp-plane collective schedule: auto | flat_ring | hierarchical | rhd
+# | star — "auto" lets the coordinator pick per tensor size/topology
+# (docs/tuning.md)
+HVD_TPU_SCHEDULE = "HVD_TPU_SCHEDULE"
 
 # --- ZeRO sharding + executor selection (docs/sharding.md) -------------------
 # shard the weight update ZeRO-1 style: reduce-scatter gradients, run

@@ -137,6 +137,27 @@ def test_sharded_step_keeps_replicated_throughput_at_4_ranks():
 
 
 @pytest.mark.slow
+def test_hierarchical_beats_flat_ring_efficiency_at_4_ranks():
+    """ISSUE 12 gate: the tcp-plane scaling probe's 4-rank cell must
+    show the hierarchical schedule at >= the flat ring's efficiency —
+    the two-level plan moves 12 mailbox messages per bucket against the
+    flat ring's 24, so in the loopback regime where per-message cost
+    dominates a 16 KB payload it can only lose to noise.  Best-of-3 to
+    keep CI noise from flipping a real pass."""
+    import bench
+
+    cells = []
+    for _ in range(3):
+        out = bench._bench_tcp_scaling(ranks=(1, 4))
+        hier = out["efficiency"]["hierarchical"]["4"]
+        flat = out["efficiency"]["flat_ring"]["4"]
+        cells.append((hier, flat))
+        if hier >= flat:
+            break
+    assert any(h >= f for h, f in cells), cells
+
+
+@pytest.mark.slow
 def test_pipelined_ring_moves_at_least_seed_gbs_at_4mb():
     """ISSUE 3 acceptance smoke: on localhost, the pipelined exact ring
     (native fp32 wire + segment overlap + stripes) moves at least the

@@ -493,3 +493,58 @@ def test_adaptive_coordinator_path_clean_under_shim(tmp_path):
     active = _run_inline_under_shim(ADAPTIVE_COORD_HARNESS, "adaptive",
                                     tmp_path)
     assert not active, "\n".join(f["message"] for f in active)
+
+
+HIER_HARNESS = r"""
+import numpy as np
+import threading
+import horovod_tpu  # installs the shim
+import bench
+
+services, planes = bench._ring_harness(4, 4096, 2)
+def run_all(fn):
+    errs = []
+    def run(r):
+        try:
+            fn(r)
+        except BaseException as e:
+            errs.append(e)
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(4)]
+    for t in ts: t.start()
+    for t in ts: t.join()
+    assert not errs, errs
+
+arrs = [np.arange(5000, dtype=np.float32) * (r + 1) for r in range(4)]
+groups = [[0, 1], [2, 3]]
+out = [None] * 4
+def hier(r):
+    out[r] = planes[r].allreduce_hierarchical(
+        1, arrs[r], [0, 1, 2, 3], groups, op_average=False,
+        world_size=4)
+run_all(hier)
+assert all(np.array_equal(o, out[0]) for o in out[1:])
+def hier8(r):
+    out[r] = planes[r].allreduce_hierarchical(
+        2, arrs[r], [0, 1, 2, 3], groups, op_average=False,
+        world_size=4, compression="int8")
+run_all(hier8)
+assert all(np.array_equal(o, out[0]) for o in out[1:])
+def rhd(r):
+    out[r] = planes[r].allreduce_rhd(3, arrs[r], [0, 1, 2, 3],
+                                     op_average=False, world_size=4)
+run_all(rhd)
+assert all(np.array_equal(o, out[0]) for o in out[1:])
+for p in planes: p.close()
+for s in services: s.shutdown()
+print("HIER-OK")
+"""
+
+
+def test_hierarchical_schedule_clean_under_shim(tmp_path):
+    """ISSUE 12: the hierarchical and rhd data-plane phases — owner-
+    targeted intra-group scatter, delegate gather/ring/broadcast (exact
+    and int8 wire), pairwise recursive doubling — across 4 rank threads
+    on the real loopback transport, shim on: every report is baselined
+    or nonexistent."""
+    active = _run_inline_under_shim(HIER_HARNESS, "hier", tmp_path)
+    assert not active, "\n".join(f["message"] for f in active)

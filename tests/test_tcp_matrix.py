@@ -19,6 +19,8 @@ import threading
 import numpy as np
 import pytest
 
+from conftest import spawn_tcp_ranks
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HVDRUN = os.path.join(REPO, "bin", "hvdrun")
 
@@ -664,3 +666,306 @@ def test_pipelined_ring_adasum_native_wire_matches_oracle():
             assert moved < 3.0 * data[0].nbytes, sent
     finally:
         harness.close()
+
+
+# ===================================================================
+# ISSUE 12 schedule matrix: the hierarchical and rhd schedules on the
+# same transport rig — parity vs the seed ring / float64 oracle,
+# bitwise rank consistency, odd worlds and mixed groups, the
+# mid-collective fault cell, and digest-identical elastic re-planning.
+# ===================================================================
+def _sched_allreduce(harness, schedule, data, groups=None, **kw):
+    """One allreduce round through the named data-plane schedule."""
+    harness._ring_id += 1
+    rid = harness._ring_id
+    ranks = list(range(harness.p))
+    kw.setdefault("op_average", False)
+    if schedule == "hierarchical":
+        return harness.run_all(
+            lambda r: harness.planes[r].allreduce_hierarchical(
+                rid, data[r], ranks, groups, world_size=harness.p,
+                timeout=60, **kw))
+    assert schedule == "rhd"
+    return harness.run_all(lambda r: harness.planes[r].allreduce_rhd(
+        rid, data[r], ranks, world_size=harness.p, timeout=60, **kw))
+
+
+@pytest.mark.parametrize("schedule", ["hierarchical", "rhd"])
+def test_schedule_dtype_compression_parity_matrix(schedule):
+    """schedule x dtype x compression cells in a non-power-of-two world
+    (p=5, mixed groups [3, 2]): exact legs must match the seed ring
+    within the dtype's wire tolerance (int32 exactly), compressed legs
+    the float64 oracle within the codec bound, and every cell must be
+    bitwise identical across ranks — the invariant that makes a
+    schedule safe to swap under a running model."""
+    import ml_dtypes
+
+    harness = _PipelinedHarness(5, segment_bytes=8192, stripes=2)
+    groups = [[0, 1, 2], [3, 4]]
+    try:
+        for size in (500, 20001):
+            fdata = [np.random.RandomState(31 * size + r).randn(size)
+                     for r in range(harness.p)]
+            exact = np.sum(np.stack(fdata), 0)
+
+            # ---- exact legs: parity against the seed ring ------------
+            for dtype, rtol, atol in [
+                    (np.float32, 1e-4, 1e-3),
+                    (ml_dtypes.bfloat16, 1e-1, 0.5),
+                    (np.float16, 3e-2, 0.2)]:
+                data = [d.astype(dtype) for d in fdata]
+                outs = _sched_allreduce(harness, schedule, data,
+                                        groups=groups)
+                ref = harness.allreduce(data, seed=True)
+                _assert_rank_consistent(outs)
+                assert outs[0].dtype == np.dtype(dtype)
+                np.testing.assert_allclose(
+                    np.asarray(outs[0], np.float64),
+                    np.asarray(ref[0], np.float64),
+                    rtol=rtol, atol=atol,
+                    err_msg=f"{schedule} {np.dtype(dtype).name} "
+                            f"size={size}")
+
+            # int32: modular wire arithmetic stays EXACT vs seed
+            idata = [(np.arange(size) * (r + 1) - size // 2).astype(
+                np.int32) for r in range(harness.p)]
+            outs = _sched_allreduce(harness, schedule, idata,
+                                    groups=groups)
+            ref = harness.allreduce(idata, seed=True)
+            _assert_rank_consistent(outs)
+            assert np.array_equal(outs[0], ref[0]), \
+                f"{schedule} int32 size={size}"
+
+            # ---- compressed legs (fp32 input) ------------------------
+            # rhd accepts the knob but wires native fp32 (latency
+            # regime), so its "compressed" cells are exact; the
+            # hierarchical cells compose the codec across all 4 phases.
+            data = [d.astype(np.float32) for d in fdata]
+            for comp in ("int8", "bf16"):
+                outs = _sched_allreduce(harness, schedule, data,
+                                        groups=groups, compression=comp)
+                _assert_rank_consistent(outs)
+                tol = 0.8 if comp == "int8" else 0.4
+                if schedule == "rhd":
+                    tol = 1e-3
+                assert np.abs(
+                    np.asarray(outs[0], np.float64) - exact
+                ).max() < tol, f"{schedule} {comp} size={size}"
+    finally:
+        harness.close()
+
+
+@pytest.mark.parametrize("p,groups", [
+    (3, [[0, 1], [2]]),               # odd world, singleton group
+    (5, [[0, 1], [2, 3], [4]]),       # odd world, HIER_LOCAL_SIZE=2 tail
+    (6, [[0, 1, 2], [3, 4, 5]]),      # even split of a non-power-of-two
+    (6, [[0, 1, 2, 3], [4, 5]]),      # mixed 4+2 grouping
+])
+def test_schedule_odd_worlds_match_seed(p, groups):
+    """Non-power-of-two worlds and odd/mixed group shapes: both new
+    schedules (hierarchical over the given groups, rhd with its
+    fold-in extras) must match the seed ring and stay rank-consistent
+    — the shapes an elastic reconfiguration leaves behind."""
+    harness = _PipelinedHarness(p, segment_bytes=8192, stripes=2)
+    try:
+        for size in (997, 20001):
+            data = [np.random.RandomState(7 * size + r).randn(size)
+                    .astype(np.float32) for r in range(p)]
+            ref = harness.allreduce(data, seed=True)
+            for schedule in ("hierarchical", "rhd"):
+                outs = _sched_allreduce(harness, schedule, data,
+                                        groups=groups)
+                _assert_rank_consistent(outs)
+                np.testing.assert_allclose(
+                    np.asarray(outs[0], np.float64),
+                    np.asarray(ref[0], np.float64),
+                    rtol=1e-4, atol=1e-3,
+                    err_msg=f"{schedule} p={p} size={size}")
+    finally:
+        harness.close()
+
+
+def test_hierarchical_average_prescale_postscale():
+    """The op/scale surface composes with the two-level plan: average
+    divides the wide total once, pre/postscale apply at the ends, all
+    rank-consistently (the widened-wire rule the flat ring follows)."""
+    harness = _PipelinedHarness(4, segment_bytes=4096, stripes=2)
+    groups = [[0, 1], [2, 3]]
+    try:
+        data = [np.random.RandomState(60 + r).randn(4001).astype(
+            np.float32) for r in range(4)]
+        exact = np.sum(np.stack([d.astype(np.float64) for d in data]), 0)
+        outs = _sched_allreduce(harness, "hierarchical", data,
+                                groups=groups, op_average=True)
+        _assert_rank_consistent(outs)
+        np.testing.assert_allclose(np.asarray(outs[0], np.float64),
+                                   exact / 4, rtol=1e-4, atol=1e-4)
+        outs = _sched_allreduce(harness, "hierarchical", data,
+                                groups=groups, prescale=0.5,
+                                postscale=2.0)
+        _assert_rank_consistent(outs)
+        np.testing.assert_allclose(np.asarray(outs[0], np.float64),
+                                   exact, rtol=1e-4, atol=1e-4)
+        outs = _sched_allreduce(harness, "rhd", data, op_average=True)
+        _assert_rank_consistent(outs)
+        np.testing.assert_allclose(np.asarray(outs[0], np.float64),
+                                   exact / 4, rtol=1e-4, atol=1e-4)
+    finally:
+        harness.close()
+
+
+def test_replan_groups_digest_identical_across_reconfig(monkeypatch):
+    """Elastic acceptance: group planning is a pure function of the
+    live membership (+ env override) — repeated plans and plans from
+    differently-ordered membership produce digest-identical groupings,
+    so every survivor of a reconfiguration executes the same plan the
+    coordinator stamped."""
+    import hashlib
+    import json as _json
+
+    from horovod_tpu.ops import tcp_controller
+
+    co = object.__new__(tcp_controller.CoordinatorService)
+    co._host_of = {r: f"host{r // 4}" for r in range(8)}
+
+    def digest(groups):
+        return hashlib.sha256(
+            _json.dumps(groups, sort_keys=True).encode()).hexdigest()
+
+    monkeypatch.delenv("HVD_HIER_LOCAL_SIZE", raising=False)
+    full = [co._plan_groups(range(8)) for _ in range(3)]
+    assert full[0] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert len({digest(g) for g in full}) == 1
+
+    # rank 5 lost: the re-plan from surviving membership is itself
+    # deterministic and keeps the host partition
+    survivors = [r for r in range(8) if r != 5]
+    replans = [co._plan_groups(survivors) for _ in range(3)]
+    assert replans[0] == [[0, 1, 2, 3], [4, 6, 7]]
+    assert len({digest(g) for g in replans}) == 1
+    # membership order must not matter
+    assert co._plan_groups(reversed(survivors)) == replans[0]
+
+    # the explicit local-size override chunks the sorted membership,
+    # same determinism contract
+    monkeypatch.setenv("HVD_HIER_LOCAL_SIZE", "3")
+    chunked = [co._plan_groups(survivors) for _ in range(3)]
+    assert chunked[0] == [[0, 1, 2], [3, 4, 6], [7]]
+    assert len({digest(g) for g in chunked}) == 1
+
+    # degenerate topologies yield no two-level plan (stay flat)
+    monkeypatch.delenv("HVD_HIER_LOCAL_SIZE", raising=False)
+    co._host_of = {r: f"h{r}" for r in range(4)}   # one rank per host
+    assert co._plan_groups(range(4)) is None
+    co._host_of = {r: "h0" for r in range(4)}      # all one host
+    assert co._plan_groups(range(4)) is None
+    co._host_of = {}                               # unknown topology
+    assert co._plan_groups(range(4)) is None
+
+
+HIER_FAULT_WORKER = r"""
+import os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+r = hvd.rank()
+t = jnp.ones((70000,)) * (r + 1)
+start = time.monotonic()
+try:
+    hvd.allreduce(t, op=hvd.Sum, name="hier.ft")
+    print(f"rank {r} COMPLETED", flush=True)
+except hvd.HvdAbortedError as exc:
+    elapsed = time.monotonic() - start
+    from horovod_tpu.common import basics
+    svc = basics._get_state().controller._peer_service
+    leaked = len(svc._mailbox) if svc is not None else 0
+    print(f"rank {r} ABORTED origin={exc.origin_rank} "
+          f"elapsed={elapsed:.1f} leaked={leaked}", flush=True)
+print(f"rank {r} DONE", flush=True)
+"""
+
+
+def test_hierarchical_crash_mid_collective_aborts_all_ranks():
+    """ISSUE 12 fault cell: rank 2 dies AFTER the coordinator stamped a
+    hierarchical ring_go — peers in BOTH groups are committed (blocked
+    in phase recvs / on the delegate ring).  Liveness converts the
+    silence into one coordinated abort: every survivor wakes with the
+    typed error naming origin=2, well inside the deadline, mailbox
+    clean."""
+    results = spawn_tcp_ranks(4, HIER_FAULT_WORKER, extra_env={
+        "JAX_PLATFORMS": "cpu",
+        "HVD_TPU_SCHEDULE": "hierarchical",
+        "HVD_HIER_LOCAL_SIZE": "2",
+        "HVD_TCP_RING_THRESHOLD": "1024",
+        "HVD_TPU_HEARTBEAT_INTERVAL": "0.25",
+        "HVD_TPU_LIVENESS_TIMEOUT": "2",
+        "HVD_TPU_ABORT_TIMEOUT": "10",
+        "HVD_STALL_CHECK_TIME_SECONDS": "1",
+        # keep the ring recv timeout far beyond liveness so the typed
+        # abort, not a local TimeoutError, wakes the blocked phases
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "30",
+        "HVD_TPU_FAULT_SPEC": "rank2:ring:1:crash",
+    })
+    assert results[2][0] == 1, f"crashed rank: {results[2][1]}"
+    for r in (0, 1, 3):
+        code, out, err = results[r]
+        assert code == 0, f"rank {r}: {out}\n{err[-2000:]}"
+        line = next(l for l in out.splitlines()
+                    if l.startswith(f"rank {r} ABORTED"))
+        fields = dict(kv.split("=") for kv in line.split()[3:])
+        assert fields["origin"] == "2", line
+        assert float(fields["elapsed"]) < 10.0, line
+        assert fields["leaked"] == "0", line
+
+
+def test_resolve_schedule_bands_and_fallbacks(monkeypatch):
+    """The coordinator's auto resolution: rhd owns the [8KB, 256KB]
+    latency band (below it the star's single fused round-trip wins),
+    hierarchical needs a viable grouping, disagreeing requests fall
+    back to auto instead of fusing, and forced-but-infeasible choices
+    degrade to the flat ring."""
+    from types import SimpleNamespace
+
+    from horovod_tpu.ops import tcp_controller
+    from horovod_tpu.ops.tcp_dataplane import (DEFAULT_RHD_MAX_BYTES,
+                                               DEFAULT_RHD_MIN_BYTES)
+
+    monkeypatch.delenv("HVD_HIER_LOCAL_SIZE", raising=False)
+    co = object.__new__(tcp_controller.CoordinatorService)
+    co._published = None
+    co._host_of = {r: f"h{r // 2}" for r in range(4)}
+
+    def resolve(nbytes, scheds=("auto",) * 4):
+        reqs = {i: SimpleNamespace(schedule=s)
+                for i, s in enumerate(scheds)}
+        return co._resolve_schedule(reqs, list(range(4)), nbytes)
+
+    # auto: the rhd band has a floor AND a ceiling (both inclusive)
+    assert resolve(DEFAULT_RHD_MIN_BYTES)[0] == "rhd"
+    assert resolve(DEFAULT_RHD_MAX_BYTES)[0] == "rhd"
+    sched, groups = resolve(DEFAULT_RHD_MIN_BYTES - 1)
+    assert (sched, groups) == ("hierarchical", [[0, 1], [2, 3]])
+    assert resolve(DEFAULT_RHD_MAX_BYTES + 1)[0] == "hierarchical"
+    # rhd carries no groups
+    assert resolve(DEFAULT_RHD_MIN_BYTES)[1] is None
+    # forced hierarchical keeps its groups whatever the size
+    sched, groups = resolve(1 << 10, scheds=("hierarchical",) * 4)
+    assert (sched, groups) == ("hierarchical", [[0, 1], [2, 3]])
+    # disagreeing requests fall back to auto resolution, never fuse a
+    # mixed plan (here: large payload + topology -> hierarchical)
+    assert resolve(1 << 20, scheds=("rhd", "flat_ring", "auto", "auto")
+                   )[0] == "hierarchical"
+
+    # no topology: everything outside the band is the flat ring, and a
+    # forced hierarchical degrades to it
+    co._host_of = {}
+    assert resolve(DEFAULT_RHD_MAX_BYTES + 1)[0] == "flat_ring"
+    assert resolve(1 << 10)[0] == "flat_ring"
+    assert resolve(1 << 20, scheds=("hierarchical",) * 4
+                   )[0] == "flat_ring"
+    # "star" reaching a ring round (tuned-value propagation race) runs
+    # the flat ring rather than desyncing
+    assert resolve(1 << 20, scheds=("star",) * 4)[0] == "flat_ring"
