@@ -89,12 +89,12 @@ class CheckpointManager:
         params, opt, step, epoch = state._committed
         if step == self._last_step:
             return False   # commit() re-runs at a retried boundary
-        rank, world = self._topology()
+        rank, world, wid = self._topology()
         self._last_step = step
         snap = {"params": params, "opt": opt,
                 "opt_full": bool(state._opt_full),
                 "step": int(step), "epoch": int(epoch),
-                "rank": rank, "world": world}
+                "rank": rank, "world": world, "wid": wid}
         with self._cond:
             self._snapshot = snap   # latest wins
             self._cond.notify()
@@ -102,11 +102,16 @@ class CheckpointManager:
 
     @staticmethod
     def _topology():
+        """Live (rank, world, worker_id) at save time — re-read on
+        every snapshot, NOT cached at construction: manifest authorship
+        follows whoever holds rank 0 NOW, so after a coordinator
+        fail-over (docs/elastic.md#coordinator-fail-over) the new root
+        writes the manifests without any re-keying step."""
         from horovod_tpu.common import basics
 
         if basics.is_initialized():
-            return basics.rank(), basics.size()
-        return 0, 1
+            return basics.rank(), basics.size(), basics.worker_id()
+        return 0, 1, 0
 
     def wait(self, timeout=30.0) -> bool:
         """Block until the writer drained the queue (tests and drain
@@ -191,11 +196,16 @@ class CheckpointManager:
         store.write_shard(self._dir, step, epoch, world, rank, payload)
         if rank == 0:
             # manifest last: readers treat its presence as "worth
-            # validating", and validation still demands all W shards
+            # validating", and validation still demands all W shards.
+            # root_wid records WHICH worker authored it — informational
+            # (resume is authorship-agnostic by contract), but it makes
+            # "did the post-fail-over root really take over?" a
+            # greppable fact instead of a timestamp puzzle
             store.write_manifest(
                 self._dir, step, epoch, world,
                 extra={"n_params": n_params, "opt_kind": opt_kind,
-                       "opt_num_leaves": opt_num})
+                       "opt_num_leaves": opt_num,
+                       "root_wid": snap.get("wid", 0)})
         self._prune(rank, keep_key=(step, epoch))
 
     def _prune(self, rank, keep_key):
@@ -210,6 +220,15 @@ class CheckpointManager:
                 store.remove_shard(self._dir, s, e, w, rank)
                 if rank == 0:
                     store.remove_manifest(self._dir, s, e, w)
+                    # sweep the WHOLE dead group, not just this rank's
+                    # shard: after an elastic shrink or a coordinator
+                    # fail-over, shard indices beyond the current world
+                    # (and the dead root's own shards) have no owner
+                    # left to prune them — without this they accumulate
+                    # for the life of the checkpoint directory
+                    for r in range(w):
+                        if r != rank:
+                            store.remove_shard(self._dir, s, e, w, r)
 
     # ------------------------------------------------------------ resume side
     def restore_latest(self, state):
@@ -219,7 +238,13 @@ class CheckpointManager:
         shape mismatch with the current model).  Returns ``(step,
         epoch)`` or None.  Call on ONE rank (the sync root) before the
         driver's first ``sync()`` — the sync broadcast distributes and
-        re-shards for everyone else."""
+        re-shards for everyone else.
+
+        Authorship-agnostic by contract: any COMPLETE manifest is a
+        valid resume point no matter which root wrote it — the one the
+        original rank 0 committed before dying, or the one the
+        fail-over-elected root wrote after (the recorded ``root_wid``
+        is informational)."""
         for step, epoch, world in store.list_manifests(self._dir):
             try:
                 result = self._restore_one(state, step, epoch, world)
@@ -234,8 +259,9 @@ class CheckpointManager:
                 self._last_step = step
                 self._log.warning(
                     "checkpoint: resumed from step %d (epoch %d, "
-                    "written at world %d)", step, epoch, world)
-                return result
+                    "written at world %d by root worker %s)", step,
+                    epoch, world, result[2])
+                return result[:2]
         return None
 
     def _restore_one(self, state, step, epoch, world):
@@ -301,4 +327,4 @@ class CheckpointManager:
         state._committed = (params, opt, int(step), int(epoch))
         state._opt_full = opt_full
         state.restore()
-        return int(step), int(epoch)
+        return int(step), int(epoch), manifest.get("root_wid", 0)

@@ -10,7 +10,10 @@ Every file is written tmp + ``os.replace`` (atomic on POSIX), and the
 meta sidecar lands AFTER its shard — so the digest only ever describes
 a fully-renamed shard.  Completeness is a READ-time property: a
 manifest is usable iff all ``world`` shards exist and every shard's
-bytes hash to its recorded digest.  A job killed mid-write therefore
+bytes hash to its recorded digest.  "Rank 0" means whoever holds rank
+0 at write time — after a coordinator fail-over the elected root
+authors the manifests (its stable worker id is recorded as
+``root_wid``), and readers accept complete manifests from any author.  A job killed mid-write therefore
 leaves a manifest that simply fails validation and the reader falls
 back to the previous complete one; nothing needs fsync-ordered
 bookkeeping beyond the rename barrier.

@@ -71,6 +71,12 @@ class Config:
         env_util.DEFAULT_RECONFIG_TIMEOUT_SECONDS
     min_ranks: int = env_util.DEFAULT_MIN_RANKS
     max_ranks: int = env_util.DEFAULT_MAX_RANKS
+    # Coordinator fail-over (docs/elastic.md#coordinator-fail-over):
+    # survivors of a rank-0 loss race a CAS election at the rendezvous
+    # server and re-form under a new coordinator instead of dying.
+    coord_failover: bool = False
+    election_timeout_seconds: float = \
+        env_util.DEFAULT_ELECTION_TIMEOUT_SECONDS
     # ZeRO-sharded weight update + executor selection (docs/sharding.md):
     # ``zero`` turns on optimizer-state sharding in the high-level
     # training wrappers; ``zero_min_size`` keeps tiny models on the
@@ -169,6 +175,11 @@ class Config:
             max_ranks=_validated_nonneg(
                 env_util.HVD_TPU_MAX_RANKS,
                 env_util.DEFAULT_MAX_RANKS),
+            coord_failover=env_util.get_bool(
+                env_util.HVD_TPU_COORD_FAILOVER),
+            election_timeout_seconds=env_util.get_float(
+                env_util.HVD_TPU_ELECTION_TIMEOUT,
+                env_util.DEFAULT_ELECTION_TIMEOUT_SECONDS),
             zero=env_util.get_bool(env_util.HVD_TPU_ZERO),
             zero_min_size=_validated_nonneg(
                 env_util.HVD_TPU_ZERO_MIN_SIZE,

@@ -1,0 +1,102 @@
+"""Coordinator fail-over election (docs/elastic.md#coordinator-fail-over).
+
+Rank 0 hosts the :class:`~horovod_tpu.ops.tcp_controller.CoordinatorService`,
+so its loss used to be fatal by design: the component that orchestrates
+the rescue was the casualty.  With ``HVD_TPU_COORD_FAILOVER=1`` the
+survivors rescue THEMSELVES — the launcher-hosted rendezvous server
+(run/http_server.py) outlives rank 0, and its atomic put-if-absent
+endpoint is enough shared state for a leader election:
+
+- every survivor that decides the coordinator is unreachable computes
+  the SAME successor membership deterministically from its current
+  ``(epoch, members)``: the dead coordinator's worker id (``members[0]``)
+  drops out, survivor order is preserved — so the new rank 0 is the
+  lowest surviving worker id, exactly the rank the PR 7 reconfiguration
+  path would have made the state-sync root anyway;
+- each survivor POSTs its proposed reconfiguration directive at the
+  epoch-scoped key ``election/e<epoch>``; the rendezvous server keeps
+  the FIRST value and answers every poster with it, so exactly one
+  proposal wins and every loser ADOPTS the winning directive verbatim
+  (split-brain is structurally impossible: there is one key);
+- the winning directive then rides the ordinary abort machinery
+  (`HvdReconfigureError` → ``hvd.elastic.run`` → ``_elastic_reinit``),
+  and the new rank 0 starts a fresh CoordinatorService when the
+  re-formed world gang-starts at epoch N+1.  Coordinator soft state
+  (response caches, negotiation entries, liveness last-seen, RTT EWMAs)
+  is rebuilt from scratch — none of it outlives a membership epoch.
+
+Epoch fencing: the key embeds the elector's CURRENT epoch, so a
+straggler still living at epoch N-1 cannot race an election for epoch
+N's coordinator, and a directive adopted twice is idempotent
+(``_elastic_reinit`` ignores ``epoch <= current``).
+
+The same key doubles as the drain-handoff record: when rank 0 drains
+gracefully (SIGTERM with fail-over armed), the membership planner
+records its handoff directive here BEFORE fan-out — a survivor that
+misses the pull-only drain delivery and later times out against the
+departed coordinator elects, finds the recorded directive, and adopts
+it, converging on the identical epoch N+1 membership.
+"""
+
+import time
+
+from horovod_tpu.common.handles import (RECONFIG_MARKER,
+                                        encode_reconfig_reason)
+from horovod_tpu.utils.logging import get_logger
+
+# rendezvous scope for the per-epoch election keys (key: ``e<epoch>``)
+ELECTION_SCOPE = "election"
+
+
+def election_key(epoch) -> str:
+    return f"e{epoch}"
+
+
+def propose_directive(epoch, members, reason, proposer_wid) -> str:
+    """The directive this survivor would install if it wins: epoch N+1,
+    the current membership minus the dead coordinator's worker id,
+    order preserved.  Every survivor computes the same successor world;
+    only the cause text (which names the proposer) differs — so the CAS
+    has exactly one winner and the winner is identifiable."""
+    dead_wid = members[0]
+    survivors = list(members[1:])
+    cause = (f"coordinator (worker {dead_wid}) lost: {reason}; "
+             f"fail-over elected by worker {proposer_wid}")
+    return encode_reconfig_reason(epoch + 1, survivors, [dead_wid],
+                                  cause)
+
+
+def elect(addr, port, epoch, members, reason, proposer_wid,
+          timeout=10.0):
+    """Race the epoch-scoped CAS election and return the winning
+    reconfiguration directive (this proposer's own, or an adopted
+    one), or ``None`` when the election is not winnable — rendezvous
+    unreachable within ``timeout``, or the recorded winner is not a
+    well-formed directive.  ``None`` means the caller falls back to
+    today's fatal "coordinator unreachable" abort."""
+    from horovod_tpu.run import http_client
+
+    log = get_logger()
+    deadline = time.monotonic() + timeout
+    proposal = propose_directive(epoch, members, reason, proposer_wid)
+    try:
+        winner = http_client.cas_put(
+            addr, port, ELECTION_SCOPE, election_key(epoch),
+            proposal.encode(), deadline=deadline).decode()
+    except Exception as exc:  # noqa: BLE001 — no rendezvous, no quorum
+        log.error("fail-over: election at epoch %d unreachable within "
+                  "%gs (%s); falling back to fatal abort", epoch,
+                  timeout, exc)
+        return None
+    if not winner.startswith(RECONFIG_MARKER):
+        log.error("fail-over: election key e%d holds a malformed "
+                  "directive; falling back to fatal abort", epoch)
+        return None
+    if winner == proposal:
+        log.warning("fail-over: worker %d won the epoch-%d election; "
+                    "re-forming without worker %d", proposer_wid,
+                    epoch, members[0])
+    else:
+        log.warning("fail-over: worker %d adopted the epoch-%d "
+                    "election result", proposer_wid, epoch)
+    return winner

@@ -51,12 +51,15 @@ class ElasticContext:
     """
 
     def __init__(self, members, epoch, min_ranks=1, max_ranks=0,
-                 rendezvous=None):
+                 rendezvous=None, coord_failover=False):
         self._members = list(members)   # worker ids, current-rank order
         self._epoch = epoch
         self._min_ranks = min_ranks
         self._max_ranks = max_ranks
         self._rendezvous = rendezvous   # (addr, port) | None
+        # coordinator fail-over armed (docs/elastic.md): a rank-0 loss
+        # or drain is plannable like any other — survivors re-elect
+        self._coord_failover = coord_failover
         self._lock = threading.Lock()
         # encoded directive once planned (None: fatal); sticky once
         # ``_decided`` is set; guarded by self._lock
@@ -102,9 +105,10 @@ class ElasticContext:
             return None  # explicit kill switch: never rescued
         if not (0 <= origin_rank < len(self._members)):
             return None  # can't attribute the loss to a member
-        if origin_rank == 0:
-            # rank 0 hosts the coordinator itself: the component that
-            # would orchestrate the rescue is the casualty
+        if origin_rank == 0 and not self._coord_failover:
+            # rank 0 hosts the coordinator itself: unless fail-over is
+            # armed, the component that would orchestrate the rescue
+            # is the casualty
             return None
         dead_wid = self._members[origin_rank]
         survivors = [w for w in self._members if w != dead_wid]
@@ -127,8 +131,38 @@ class ElasticContext:
             "with members %s", dead_wid,
             "draining" if drain else "lost", reason, new_epoch,
             new_members)
-        return encode_reconfig_reason(new_epoch, new_members,
-                                      [dead_wid], reason, drain=drain)
+        directive = encode_reconfig_reason(new_epoch, new_members,
+                                           [dead_wid], reason,
+                                           drain=drain)
+        if origin_rank == 0:
+            # durable handoff (docs/elastic.md#coordinator-fail-over):
+            # this coordinator is the one leaving, so a survivor that
+            # misses the directive's fan-out has nobody left to re-pull
+            # it from.  Recording it at the epoch's election key means
+            # such a survivor — timing out against the departed
+            # coordinator and racing the fail-over election — adopts
+            # THIS directive instead of proposing its own, and both
+            # delivery paths converge on the identical epoch N+1 world.
+            self._record_handoff(directive)
+        return directive
+
+    def _record_handoff(self, directive):
+        """Best-effort CAS of a rank-0 departure directive at the
+        election key; a failure only costs the backstop — survivors
+        that elect without it compute the same successor membership."""
+        if self._rendezvous is None:
+            return
+        from horovod_tpu.elastic import election
+        from horovod_tpu.run import http_client
+        addr, port = self._rendezvous
+        try:
+            http_client.cas_put(addr, port, election.ELECTION_SCOPE,
+                                election.election_key(self._epoch),
+                                directive.encode(), retry_for=2.0)
+        except Exception:  # noqa: BLE001 — see docstring
+            self._log.warning(
+                "elastic: could not record the coordinator handoff "
+                "directive for epoch %d", self._epoch, exc_info=True)
 
     def _registered_joiners(self, exclude):
         """Worker ids registered under the join scope, admission order

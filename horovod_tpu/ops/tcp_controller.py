@@ -1173,7 +1173,8 @@ class TcpController:
                     members=self._members, epoch=self._epoch,
                     min_ranks=self._config.min_ranks,
                     max_ranks=self._config.max_ranks,
-                    rendezvous=(addr, int(port)))
+                    rendezvous=(addr, int(port)),
+                    coord_failover=self._config.coord_failover)
             self._coordinator = CoordinatorService(
                 self._size, self._key,
                 stall_warning_sec=self._config.stall_warning_seconds,
@@ -1428,10 +1429,11 @@ class TcpController:
                             budget)
                     if budget > 0 and now - fail_since > budget:
                         # a dead coordinator must fail the job, not
-                        # hang it: self-abort naming the coordinator
-                        self._local_abort(
-                            0, f"coordinator unreachable for "
-                               f"{int(now - fail_since)}s: {exc}")
+                        # hang it: fail-over election when armed,
+                        # else self-abort naming the coordinator
+                        self._coordinator_lost(
+                            f"coordinator unreachable for "
+                            f"{int(now - fail_since)}s: {exc}")
                         return
                 else:
                     fail_since = None
@@ -1448,6 +1450,49 @@ class TcpController:
                     return
         finally:
             hb_client.close()
+
+    def _coordinator_lost(self, reason):
+        """Every path that decides the coordinator is unreachable funnels
+        here: with fail-over armed the survivors race the rendezvous CAS
+        election and the winning reconfiguration directive replaces the
+        fatal abort — the same typed delivery, a different verdict.  Not
+        armed (or the election is not winnable): today's exact behavior,
+        a fatal self-abort naming the coordinator rank."""
+        directive = self._try_failover(reason)
+        self._local_abort(0, directive if directive is not None
+                          else reason)
+
+    def _try_failover(self, reason):
+        """Attempt the coordinator fail-over election
+        (docs/elastic.md#coordinator-fail-over).  Returns the winning
+        reconfiguration directive, or None when fail-over is off, not
+        survivable (below --min-ranks), or the election cannot be won
+        within HVD_TPU_ELECTION_TIMEOUT — every None falls back to the
+        fatal path, byte-identical to fail-over-off behavior."""
+        if not (self._config.coord_failover and self._config.elastic):
+            return None
+        if self._rank == 0 or self._size <= 1:
+            # rank 0 IS the coordinator host: its own unreachability
+            # verdict means this process is the casualty, not a survivor
+            return None
+        with self._abort_lock:
+            if self._abort_state is not None:
+                return None   # a verdict (or directive) already landed
+        addr = env_util.get_str(env_util.HVD_RENDEZVOUS_ADDR)
+        port = env_util.get_str(env_util.HVD_RENDEZVOUS_PORT)
+        if addr is None or port is None:
+            return None   # no rendezvous server, no election ground
+        if len(self._members) - 1 < self._config.min_ranks:
+            self._log.error(
+                "fail-over: %d survivors < --min-ranks %d; coordinator "
+                "loss is fatal", len(self._members) - 1,
+                self._config.min_ranks)
+            return None
+        from horovod_tpu.elastic import election
+        return election.elect(
+            addr, int(port), self._epoch, self._members, reason,
+            proposer_wid=self._members[self._rank],
+            timeout=self._config.election_timeout_seconds)
 
     def _local_abort(self, origin_rank, reason, fan_out=True):
         """Apply a coordinated abort on this worker: purge the ring
@@ -1555,12 +1600,21 @@ class TcpController:
     def _report_abort(self, origin_rank, reason):
         """Broadcast an abort: best-effort notify the coordinator (which
         relays it to every rank via heartbeat replies and negotiation
-        responses), then apply it locally."""
+        responses), then apply it locally.  When the notify fails AND
+        the evidence already names rank 0 dead (a ring send to the
+        coordinator's own process broke — RingSendError(peer=0)), the
+        two signals corroborate: the coordinator is gone, so this is a
+        fail-over trigger, not merely an undeliverable report."""
+        from horovod_tpu.elastic.membership import USER_ABORT_PREFIX
         try:
             self._client().send(network.AbortMsg(origin_rank, reason),
                                 timeout=5.0)
         except Exception:  # noqa: BLE001 — local abort still proceeds
-            pass
+            if (origin_rank == 0
+                    and not (isinstance(reason, str)
+                             and reason.startswith(USER_ABORT_PREFIX))):
+                self._coordinator_lost(reason)
+                return
         self._local_abort(origin_rank, reason)
 
     def abort(self, origin_rank, reason):
@@ -1671,9 +1725,10 @@ class TcpController:
                 # the control plane is gone (mux retry budget spent):
                 # surface the SAME typed, symmetric error as the
                 # heartbeat self-abort, not a one-off transport string
-                self._local_abort(
-                    0, f"coordinator unreachable during negotiation of "
-                       f"'{request.name}': {exc}")
+                # — or, fail-over armed, the SAME election verdict
+                self._coordinator_lost(
+                    f"coordinator unreachable during negotiation of "
+                    f"'{request.name}': {exc}")
                 # sticky: _local_abort just set it (or an earlier abort
                 # did); set-once means this read cannot tear
                 request.handle.set_error(

@@ -35,7 +35,7 @@ _FLAKY_P = (0.05, 0.2)
 
 
 def generate_spec(seed, num_ranks, num_faults, elastic=False,
-                  degrade=0):
+                  degrade=0, coord_failover=False):
     rng = random.Random(seed)
     specs = []
     for _ in range(num_faults):
@@ -72,4 +72,18 @@ def generate_spec(seed, num_ranks, num_faults, elastic=False,
         duration = rng.randint(2, 8)
         specs.append(f"rank{rank}:link:{step}:{action}:{param}:"
                      f"{duration}")
+    # coordinator-kill cell (--coord-failover): rank 0 joins the
+    # crash/preempt pool via ONE dedicated cell whose draws come
+    # strictly AFTER every pre-existing draw — the same cross-version
+    # replay contract as the elastic and degrade cells, so a seed's
+    # spec without the flag is byte-identical to every older tree.
+    # The survivors are expected to elect a new coordinator
+    # (docs/elastic.md#coordinator-fail-over), so this cell only makes
+    # sense with fail-over armed in the job under test.
+    if coord_failover:
+        point = rng.choice(("allreduce", "broadcast", "allgather",
+                            "ring"))
+        action = rng.choice(("crash", "preempt"))
+        step = rng.randint(2, 5)   # after warmup: epoch-0 world forms
+        specs.append(f"rank0:{point}:{step}:{action}")
     return ",".join(specs)

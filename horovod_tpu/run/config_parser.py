@@ -69,6 +69,10 @@ _PARAMS = {
     "max_ranks": (env_util.HVD_TPU_MAX_RANKS, "elastic.max_ranks"),
     "reconfig_timeout": (env_util.HVD_TPU_RECONFIG_TIMEOUT,
                          "elastic.reconfig_timeout"),
+    "coord_failover": (env_util.HVD_TPU_COORD_FAILOVER,
+                       "elastic.coord_failover"),
+    "election_timeout": (env_util.HVD_TPU_ELECTION_TIMEOUT,
+                         "elastic.election_timeout"),
     "term_grace": (env_util.HVD_TPU_TERM_GRACE,
                    "fault_tolerance.term_grace"),
     "drain": (env_util.HVD_TPU_DRAIN, "fault_tolerance.drain"),

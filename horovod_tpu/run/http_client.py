@@ -89,6 +89,20 @@ def list_keys(addr, port, scope, retry_for=DEFAULT_RETRY_FOR,
     return [name for name in body.decode().split("\n") if name]
 
 
+def cas_put(addr, port, scope, key, value: bytes,
+            retry_for=DEFAULT_RETRY_FOR, deadline=None) -> bytes:
+    """Atomic put-if-absent returning the WINNING value — the server's
+    POST endpoint (coordinator fail-over election, docs/elastic.md).
+
+    The first value posted under ``scope/key`` sticks; every caller
+    gets the winner back, so ``cas_put(...) == value`` means this
+    caller won the race.  Safe to retry across transport blips: a
+    replayed POST of the winner's own value reads it straight back.
+    """
+    return request("POST", addr, port, scope, key, data=value,
+                   retry_for=_clip(retry_for, deadline))
+
+
 def get(addr, port, scope, key, timeout=None, retry_for=DEFAULT_RETRY_FOR):
     """GET; if ``timeout`` is set, poll until the key appears.
 

@@ -36,6 +36,34 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    def do_POST(self):
+        # atomic compare-and-set, put-if-absent flavor (coordinator
+        # fail-over election, docs/elastic.md#coordinator-fail-over):
+        # the FIRST value posted under /scope/key sticks; every POST —
+        # winner and loser alike — answers with the winning value, and
+        # X-Hvd-Created says whether THIS request created it.  A
+        # replayed winner's POST therefore reads back its own value
+        # (created: false) — retry-idempotent by construction.
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        if scope is None:
+            self.send_response(400)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        with self.server.kv_lock:
+            bucket = self.server.kv.setdefault(scope, {})
+            created = key not in bucket
+            if created:
+                bucket[key] = value
+            winner = bucket[key]
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(winner)))
+        self.send_header("X-Hvd-Created", "true" if created else "false")
+        self.end_headers()
+        self.wfile.write(winner)
+
     def do_GET(self):
         scope, key = self._split()
         if scope == "__list__":

@@ -155,7 +155,8 @@ def _ssh_command(slot, command, env, ssh_port=None):
 
 def launch_job(slots, command, rendezvous_addr, rendezvous_port,
                extra_env=None, ssh_port=None, verbose=False,
-               output_filename=None, elastic=False, min_ranks=1) -> int:
+               output_filename=None, elastic=False, min_ranks=1,
+               coord_failover=False) -> int:
     """Launch one process per slot; kill everything on first failure.
     Returns the CULPRIT's exit code (or 0): the first rank that failed
     on its own — ranks the kill-on-first-failure fan-out subsequently
@@ -167,7 +168,11 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
     around the survivors, so the launcher's job is to supervise them to
     completion.  The fan-out still fires when rank 0 dies (it hosts the
     coordinator — nothing can orchestrate a rescue) or when fewer than
-    ``min_ranks`` workers remain.
+    ``min_ranks`` workers remain.  With ``coord_failover=True``
+    (docs/elastic.md#coordinator-fail-over) even a rank-0 loss is
+    survivable: the workers elect a replacement coordinator at the
+    rendezvous, so the launcher supervises the survivors exactly as for
+    any other rank's death.
 
     A SIGTERM delivered to the launcher itself (the platform preempting
     the whole allocation) is forwarded once to every worker process
@@ -241,9 +246,12 @@ def launch_job(slots, command, rendezvous_addr, rendezvous_port,
                                  info.get("exit_ts")))
                 alive[0] -= 1
                 survivors = alive[0]
-            if elastic and slot.rank != 0 and survivors >= min_ranks:
+            if (elastic and (slot.rank != 0 or coord_failover)
+                    and survivors >= min_ranks):
                 # survivable under elastic: the runtime re-forms around
-                # the remaining ranks; keep supervising, don't kill
+                # the remaining ranks (a rank-0 loss only with fail-over
+                # armed — the survivors elect a replacement coordinator);
+                # keep supervising, don't kill
                 log.warning(
                     "rank %d failed (%s); elastic mode: supervising "
                     "%d surviving rank(s)", slot.rank,

@@ -312,6 +312,20 @@ def make_parser():
                               "re-form the world at the new epoch "
                               "(HVD_TPU_RECONFIG_TIMEOUT, default "
                               "60).")
+    elastic.add_argument("--coord-failover", action="store_true",
+                         default=None,
+                         help="Survive rank-0 (coordinator) loss too: "
+                              "survivors race a CAS election at the "
+                              "rendezvous server and re-form under a "
+                              "new coordinator instead of dying "
+                              "(HVD_TPU_COORD_FAILOVER; requires "
+                              "--elastic; see docs/elastic.md).")
+    elastic.add_argument("--election-timeout", type=float, default=None,
+                         help="Budget in seconds for one fail-over "
+                              "election round — the CAS race plus "
+                              "directive adoption "
+                              "(HVD_TPU_ELECTION_TIMEOUT, default "
+                              "10).")
 
     race = parser.add_argument_group("race detection")
     race.add_argument("--race", action="store_true", default=None,
@@ -522,7 +536,8 @@ def run_commandline(argv=None) -> int:
                           ssh_port=args.ssh_port, verbose=args.verbose,
                           output_filename=args.output_filename,
                           elastic=bool(args.elastic),
-                          min_ranks=args.min_ranks or 1)
+                          min_ranks=args.min_ranks or 1,
+                          coord_failover=bool(args.coord_failover))
     finally:
         rendezvous.stop()
     # a signal death surfaces as Popen's negative code; exit statuses

@@ -139,6 +139,11 @@ HVD_TPU_RECONFIG_TIMEOUT = "HVD_TPU_RECONFIG_TIMEOUT"
 HVD_TPU_MIN_RANKS = "HVD_TPU_MIN_RANKS"
 # cap on admitted membership after rejoins (0 = unlimited)
 HVD_TPU_MAX_RANKS = "HVD_TPU_MAX_RANKS"
+# coordinator fail-over: survive rank-0 loss via a CAS election at the
+# rendezvous server instead of the fatal "coordinator unreachable" abort
+HVD_TPU_COORD_FAILOVER = "HVD_TPU_COORD_FAILOVER"
+# budget for one fail-over election round (CAS + directive adoption)
+HVD_TPU_ELECTION_TIMEOUT = "HVD_TPU_ELECTION_TIMEOUT"
 
 # --- durable sharded checkpointing (docs/checkpoint.md) ----------------------
 # checkpoint directory (empty/unset = durable checkpointing off): each
@@ -216,6 +221,7 @@ DEFAULT_CONNECT_RETRY_SECONDS = 30.0
 DEFAULT_RECONFIG_TIMEOUT_SECONDS = 60.0
 DEFAULT_MIN_RANKS = 1
 DEFAULT_MAX_RANKS = 0  # unlimited
+DEFAULT_ELECTION_TIMEOUT_SECONDS = 10.0
 DEFAULT_ZERO_MIN_SIZE = 1024  # flat params below this stay replicated
 DEFAULT_TERM_GRACE_SECONDS = 5.0
 DEFAULT_CKPT_INTERVAL_STEPS = 10

@@ -1,0 +1,529 @@
+"""Coordinator fail-over tests (docs/elastic.md#coordinator-fail-over).
+
+Unit layer: the rendezvous CAS endpoint (concurrent races, replay
+idempotence, deadline clipping), the election protocol (deterministic
+successor world, split-brain impossibility, epoch scoping), the armed
+vs default membership planning (rank-0 loss and rank-0 drain flip from
+fatal to plannable ONLY under ``HVD_TPU_COORD_FAILOVER``), the durable
+drain-handoff record, and the controller-side ``_try_failover`` guards
+(off, rank 0 itself, below --min-ranks, no rendezvous).
+
+Integration layer, against real worker processes on the tcp plane:
+
+- the acceptance scenario — a 4-rank job loses rank 0 (the
+  coordinator) mid-allreduce under fail-over; the survivors elect
+  worker 1, reconfigure to 3 ranks, and train to BITWISE-identical
+  parameters vs an uninterrupted 3-rank run;
+- fail-over OFF (the default): the same rank-0 fault stays fatal with
+  today's exact typed-error behavior — the regression pin;
+- rank-0 graceful drain: SIGTERM on rank 0 with fail-over armed plans
+  the handoff then drains (exit 0, zero aborts anywhere); with
+  fail-over off the drain is refused and rank 0 exits 143;
+- checkpoint manifest handoff: the post-fail-over root authors the
+  manifests (``root_wid`` records it), and a whole-job kill after the
+  fail-over auto-resumes from the NEW root's manifest.
+"""
+
+import threading
+import time
+
+import pytest
+
+from conftest import spawn_tcp_ranks
+from horovod_tpu.checkpoint import store
+from horovod_tpu.common.handles import (HvdReconfigureError,
+                                        make_abort_error)
+from horovod_tpu.elastic import election
+from horovod_tpu.elastic.membership import ElasticContext
+from horovod_tpu.run import http_client
+from horovod_tpu.run.http_server import RendezvousServer
+
+
+@pytest.fixture
+def rendezvous():
+    server = RendezvousServer()
+    port = server.start()
+    try:
+        yield "127.0.0.1", port
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------- CAS endpoint ------
+def test_cas_put_first_writer_wins_and_replay_is_idempotent(rendezvous):
+    addr, port = rendezvous
+    assert http_client.cas_put(addr, port, "el", "k", b"first") \
+        == b"first"
+    # a later proposal loses and is handed the recorded winner
+    assert http_client.cas_put(addr, port, "el", "k", b"second") \
+        == b"first"
+    # a RETRIED post of the winning value (client timed out after the
+    # server recorded it) still reads as a win — replay idempotence
+    assert http_client.cas_put(addr, port, "el", "k", b"first") \
+        == b"first"
+    # the plain GET surface sees the same record
+    assert http_client.get(addr, port, "el", "k") == b"first"
+    # distinct keys are independent races
+    assert http_client.cas_put(addr, port, "el", "k2", b"second") \
+        == b"second"
+
+
+def test_cas_put_concurrent_race_has_exactly_one_winner(rendezvous):
+    addr, port = rendezvous
+    results = [None] * 8
+    barrier = threading.Barrier(8)
+
+    def racer(i):
+        barrier.wait()
+        results[i] = http_client.cas_put(addr, port, "el", "race",
+                                         b"proposal-%d" % i)
+
+    threads = [threading.Thread(target=racer, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(set(results)) == 1, results
+    assert results[0] in {b"proposal-%d" % i for i in range(8)}
+
+
+def test_cas_put_deadline_clips_the_retry_budget():
+    # nothing listens on the reserved port: the request must give up at
+    # the caller's deadline, not after the full DEFAULT_RETRY_FOR
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        http_client.cas_put("127.0.0.1", 1, "el", "k", b"v",
+                            deadline=time.monotonic() + 0.5)
+    assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------- election protocol ----
+def test_propose_directive_is_deterministic_across_proposers():
+    a = election.propose_directive(2, [4, 1, 7, 9], "hb timeout",
+                                   proposer_wid=1)
+    b = election.propose_directive(2, [4, 1, 7, 9], "hb timeout",
+                                   proposer_wid=9)
+    exc_a, exc_b = make_abort_error(0, a), make_abort_error(0, b)
+    # every survivor computes the SAME successor world; only the cause
+    # text (naming the proposer) differs, so the CAS picks one winner
+    for exc in (exc_a, exc_b):
+        assert isinstance(exc, HvdReconfigureError)
+        assert exc.epoch == 3
+        assert exc.members == [1, 7, 9]   # lowest survivor = new rank 0
+        assert exc.dead == [4]
+    assert "worker 1" in exc_a.cause and "worker 9" in exc_b.cause
+
+
+def test_split_brain_two_simultaneous_electors_one_winner(rendezvous):
+    addr, port = rendezvous
+    members, results = [0, 1, 2, 3], [None, None]
+    barrier = threading.Barrier(2)
+
+    def elector(slot, wid):
+        barrier.wait()
+        results[slot] = election.elect(
+            addr, port, epoch=0, members=members,
+            reason="coordinator unreachable", proposer_wid=wid,
+            timeout=10.0)
+
+    threads = [threading.Thread(target=elector, args=(0, 1)),
+               threading.Thread(target=elector, args=(1, 3))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results[0] is not None and results[0] == results[1]
+    exc = make_abort_error(0, results[0])
+    assert exc.epoch == 1 and exc.members == [1, 2, 3]
+    # exactly one elector's proposal is on record
+    assert ("elected by worker 1" in exc.cause) \
+        != ("elected by worker 3" in exc.cause)
+
+
+def test_election_keys_are_epoch_scoped(rendezvous):
+    addr, port = rendezvous
+    first = election.elect(addr, port, 0, [0, 1, 2], "lost",
+                           proposer_wid=1)
+    # a NEW epoch is a new race: the epoch-0 record cannot leak into
+    # the epoch-1 election (stale-elector fencing)
+    second = election.elect(addr, port, 1, [1, 2], "lost again",
+                            proposer_wid=2)
+    assert make_abort_error(0, first).members == [1, 2]
+    assert make_abort_error(0, second).members == [2]
+
+
+def test_elect_without_rendezvous_returns_none():
+    assert election.elect("127.0.0.1", 1, 0, [0, 1], "lost",
+                          proposer_wid=1, timeout=0.5) is None
+
+
+# ------------------------------------------------- membership planning -----
+def test_plan_rank0_loss_requires_the_failover_arm():
+    off = ElasticContext(members=[0, 1, 2, 3], epoch=0)
+    assert off.plan(0, "rank 0 died") is None   # today's contract
+    armed = ElasticContext(members=[0, 1, 2, 3], epoch=0,
+                           coord_failover=True)
+    exc = make_abort_error(0, armed.plan(0, "rank 0 died"))
+    assert isinstance(exc, HvdReconfigureError)
+    assert exc.epoch == 1 and exc.members == [1, 2, 3]
+    assert exc.dead == [0]
+
+
+def test_plan_drain_rank0_requires_the_failover_arm():
+    off = ElasticContext(members=[0, 1, 2], epoch=0)
+    assert off.plan_drain(0) is None            # refusal -> exit 143
+    armed = ElasticContext(members=[0, 1, 2], epoch=0,
+                           coord_failover=True)
+    exc = make_abort_error(0, armed.plan_drain(0))
+    assert exc.drain and exc.members == [1, 2]
+
+
+def test_plan_rank0_user_abort_never_rescued_even_armed():
+    armed = ElasticContext(members=[0, 1, 2], epoch=0,
+                           coord_failover=True)
+    assert armed.plan(0, "aborted by user") is None
+
+
+def test_rank0_departure_records_durable_handoff(rendezvous):
+    addr, port = rendezvous
+    ctx = ElasticContext(members=[0, 1, 2], epoch=0,
+                         rendezvous=(addr, port), coord_failover=True)
+    directive = ctx.plan_drain(0)
+    # the directive is CAS-recorded at the epoch's election key: a
+    # survivor that misses the fan-out elects and adopts THIS plan
+    recorded = http_client.get(addr, port, election.ELECTION_SCOPE,
+                               election.election_key(0))
+    assert recorded.decode() == directive
+    # a racing elector adopts the handoff instead of its own proposal
+    adopted = election.elect(addr, port, 0, [0, 1, 2],
+                             "coordinator unreachable", proposer_wid=2)
+    assert adopted == directive
+
+
+def test_non_rank0_departure_records_no_handoff(rendezvous):
+    addr, port = rendezvous
+    ctx = ElasticContext(members=[0, 1, 2], epoch=0,
+                         rendezvous=(addr, port), coord_failover=True)
+    assert ctx.plan(1, "rank 1 died") is not None
+    with pytest.raises(Exception):
+        http_client.get(addr, port, election.ELECTION_SCOPE,
+                        election.election_key(0), retry_for=0.5)
+
+
+# ------------------------------------------------ controller-side guards ---
+def _controller(rendezvous=None, **cfg_kw):
+    """A detached TcpController carrying just the state
+    ``_try_failover`` consults (the ``test_inprocess_controllers_refuse
+    _drain`` idiom — no sockets, no threads)."""
+    import threading as _threading
+
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.ops.tcp_controller import TcpController
+    from horovod_tpu.utils.logging import get_logger
+
+    cfg_kw.setdefault("elastic", True)
+    cfg_kw.setdefault("coord_failover", True)
+    cfg_kw.setdefault("election_timeout_seconds", 5.0)
+    c = object.__new__(TcpController)
+    c._config = Config(**cfg_kw)
+    c._rank, c._size = 2, 4
+    c._members, c._epoch = [0, 1, 2, 3], 0
+    c._abort_lock = _threading.Lock()
+    c._abort_state = None
+    c._log = get_logger()
+    return c
+
+
+def test_try_failover_guards(monkeypatch, rendezvous):
+    addr, port = rendezvous
+    monkeypatch.setenv("HVD_RENDEZVOUS_ADDR", addr)
+    monkeypatch.setenv("HVD_RENDEZVOUS_PORT", str(port))
+    # not armed / not elastic: byte-identical to today's fatal path
+    assert _controller(coord_failover=False)._try_failover("x") is None
+    assert _controller(elastic=False)._try_failover("x") is None
+    # rank 0 is the casualty, never an elector (it would evict itself)
+    c = _controller()
+    c._rank = 0
+    assert c._try_failover("x") is None
+    # a landed verdict is sticky — no election behind its back
+    c = _controller()
+    c._abort_state = (1, "already aborted")
+    assert c._try_failover("x") is None
+    # election below --min-ranks stays fatal
+    c = _controller(min_ranks=4)
+    assert c._try_failover("x") is None
+    # all guards clear: the election runs and yields the directive
+    exc = make_abort_error(0, _controller()._try_failover("hb lost"))
+    assert isinstance(exc, HvdReconfigureError)
+    assert exc.epoch == 1 and exc.members == [1, 2, 3]
+
+
+def test_try_failover_without_rendezvous_env(monkeypatch):
+    monkeypatch.delenv("HVD_RENDEZVOUS_ADDR", raising=False)
+    monkeypatch.delenv("HVD_RENDEZVOUS_PORT", raising=False)
+    assert _controller()._try_failover("x") is None
+
+
+# ------------------------------------------------------- config surface ----
+def test_failover_knobs_ride_the_tri_surface(monkeypatch):
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.run.config_parser import _PARAMS
+
+    monkeypatch.setenv("HVD_TPU_COORD_FAILOVER", "1")
+    monkeypatch.setenv("HVD_TPU_ELECTION_TIMEOUT", "3.5")
+    cfg = Config.from_env()
+    assert cfg.coord_failover is True
+    assert cfg.election_timeout_seconds == 3.5
+    assert _PARAMS["coord_failover"][0] == "HVD_TPU_COORD_FAILOVER"
+    assert _PARAMS["election_timeout"][0] == "HVD_TPU_ELECTION_TIMEOUT"
+
+
+# ------------------------------------------------------ launcher gate ------
+def _launch_rank0_death(tmp_path, coord_failover):
+    """Drive run/launch.py supervision with a gang whose rank 0 dies
+    nonzero while the survivors keep running: armed, the launcher must
+    supervise them to completion (exit 0); off, the rank-0 death stays
+    gang-fatal (the kill fan-out fires and rank 0 is the culprit)."""
+    import sys
+
+    from horovod_tpu.run import allocate as allocate_mod
+    from horovod_tpu.run import launch as launch_mod
+
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "if os.environ['HVD_RANK'] == '0':\n"
+        "    sys.exit(1)\n"
+        "time.sleep(2.5)\n")
+    slots = allocate_mod.allocate(
+        [allocate_mod.HostInfo("localhost", 4)], 4)
+    return launch_mod.launch_job(
+        slots, f"{sys.executable} {script}", "127.0.0.1", 0,
+        elastic=True, min_ranks=1, coord_failover=coord_failover)
+
+
+def test_launcher_supervises_survivors_past_rank0_death(tmp_path):
+    assert _launch_rank0_death(tmp_path, coord_failover=True) == 0
+
+
+def test_launcher_rank0_death_stays_gang_fatal_without_the_arm(tmp_path):
+    assert _launch_rank0_death(tmp_path, coord_failover=False) == 1
+
+
+# ------------------------------------------------------------ integration --
+FAILOVER_WORKER = r"""
+import hashlib, os, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+wid = int(os.environ["HVD_RANK"])
+steps = int(os.environ.get("EL_STEPS", "6"))
+
+hvd.init()
+
+state = hvd.elastic.State(
+    params={"w": jnp.zeros((1000,), dtype=jnp.float32)}, step=0)
+
+def train(state):
+    while state.step < steps:
+        # integer-valued and identical on every rank: the ring
+        # allreduce-average is EXACT for any world size, so the final
+        # params are bitwise-independent of membership history
+        grad = jnp.full((1000,), float(state.step + 1),
+                        dtype=jnp.float32)
+        avg = hvd.allreduce(grad, op=hvd.Average,
+                            name=f"failover.grad.{state.step}")
+        state.params = {"w": state.params["w"] - avg}
+        state.step += 1
+        state.commit()
+
+try:
+    result = hvd.elastic.run(train, state)
+except hvd.HvdAbortedError as exc:
+    print(f"wid {wid} ABORTED origin={exc.origin_rank}", flush=True)
+    raise SystemExit(0)
+if result is hvd.elastic.DRAINED:
+    print(f"wid {wid} DRAINED", flush=True)
+    raise SystemExit(0)
+digest = hashlib.sha1(
+    np.asarray(state.params["w"]).tobytes()).hexdigest()
+print(f"rank {hvd.rank()} wid {wid} DIGEST={digest} "
+      f"size={hvd.size()} steps={state.step}", flush=True)
+hvd.shutdown()
+print(f"wid {wid} DONE", flush=True)
+"""
+
+_FO_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "HVD_TPU_HEARTBEAT_INTERVAL": "0.25",
+    "HVD_TPU_ABORT_TIMEOUT": "10",
+    "HVD_TPU_LIVENESS_TIMEOUT": "2",
+    "HVD_TPU_RECONFIG_TIMEOUT": "60",
+    "HVD_STALL_CHECK_TIME_SECONDS": "1",
+    "HVD_STALL_SHUTDOWN_TIME_SECONDS": "30",
+    "HVD_TCP_RING_THRESHOLD": "1024",
+}
+
+_ARMED = {**_FO_ENV, "HVD_TPU_ELASTIC": "1",
+          "HVD_TPU_COORD_FAILOVER": "1"}
+
+
+def _digests(results, ranks):
+    out = {}
+    for r in ranks:
+        code, stdout, stderr = results[r]
+        assert code == 0, f"rank {r}: {stdout}\n{stderr}"
+        line = next(l for l in stdout.splitlines() if "DIGEST=" in l)
+        fields = dict(kv.split("=") for kv in line.split() if "=" in kv)
+        out[r] = (fields["DIGEST"], int(fields["size"]),
+                  int(fields["steps"]))
+    return out
+
+
+# The scenario tests below spawn real multi-rank TCP jobs (tens of
+# seconds each).  They carry the `slow` marker to stay out of the
+# wall-clock-capped tier-1 sweep — the dedicated `coord-failover` CI
+# job (bin/gen-ci) runs this file unfiltered, so they stay enforced.
+@pytest.mark.slow
+def test_rank0_loss_elects_new_coordinator_and_converges_bitwise():
+    """The acceptance scenario: rank 0 of 4 — the coordinator host —
+    crashes at its third allreduce.  With fail-over armed the
+    survivors elect worker 1 via the rendezvous CAS, reconfigure to 3
+    ranks, roll back to the last commit and finish — with parameters
+    BITWISE-identical to an uninterrupted 3-rank run."""
+    failover = spawn_tcp_ranks(4, FAILOVER_WORKER, timeout=180,
+                               extra_env={
+        **_ARMED,
+        "HVD_TPU_FAULT_SPEC": "rank0:allreduce:3:crash",
+    })
+    assert failover[0][0] == 1, f"killed coordinator: {failover[0][1]}"
+    got = _digests(failover, ranks=[1, 2, 3])
+    for r, (digest, size, steps) in got.items():
+        assert size == 3, f"rank {r} finished at world size {size}"
+        assert steps == 6
+    assert len({d for d, _, _ in got.values()}) == 1, got
+    # the election (not a lucky pull) carried at least one survivor
+    evidence = "".join(failover[r][2] for r in (1, 2, 3))
+    assert "fail-over" in evidence, evidence
+
+    uninterrupted = spawn_tcp_ranks(3, FAILOVER_WORKER, timeout=150,
+                                    extra_env=_FO_ENV)
+    want = _digests(uninterrupted, ranks=[0, 1, 2])
+    assert got[1][0] == want[0][0], (got, want)
+
+
+@pytest.mark.slow
+def test_rank0_loss_stays_fatal_with_failover_off():
+    """Regression pin: WITHOUT the arm, the same fault keeps today's
+    exact behavior — every survivor raises the typed abort naming the
+    dead coordinator; nobody elects, nobody reconfigures."""
+    results = spawn_tcp_ranks(4, FAILOVER_WORKER, timeout=120,
+                              extra_env={
+        **_FO_ENV,
+        "HVD_TPU_ELASTIC": "1",
+        "HVD_TPU_FAULT_SPEC": "rank0:allreduce:3:crash",
+    })
+    assert results[0][0] == 1
+    for r in (1, 2, 3):
+        code, out, err = results[r]
+        assert code == 0, f"rank {r}: {out}\n{err}"
+        assert "ABORTED origin=0" in out, f"rank {r}: {out}"
+        assert "DIGEST=" not in out
+        assert "fail-over" not in err, err
+
+
+@pytest.mark.slow
+def test_rank0_sigterm_drains_gracefully_when_armed():
+    """Rank-0 graceful drain: a SIGTERM on the coordinator host with
+    fail-over armed plans the handoff (worker 1 takes over) and then
+    drains — exit 0, DRAINED marker, zero aborts anywhere."""
+    results = spawn_tcp_ranks(4, FAILOVER_WORKER, timeout=180,
+                              extra_env={
+        **_ARMED,
+        "HVD_TPU_FAULT_SPEC": "rank0:allreduce:3:preempt",
+    })
+    code0, out0, err0 = results[0]
+    assert code0 == 0, f"drained coordinator exited {code0}: " \
+                       f"{out0}\n{err0}"
+    assert "wid 0 DRAINED" in out0, out0
+    for r in range(4):
+        assert "ABORTED" not in results[r][1], results[r][1]
+        assert "HvdAbortedError" not in results[r][2], results[r][2]
+    got = _digests(results, ranks=[1, 2, 3])
+    for r, (digest, size, steps) in got.items():
+        assert size == 3 and steps == 6
+    assert len({d for d, _, _ in got.values()}) == 1, got
+
+
+@pytest.mark.slow
+def test_rank0_sigterm_refused_with_failover_off():
+    """Regression pin: with fail-over off the coordinator's own
+    preemption is not survivable — the drain is refused and rank 0
+    exits 143 exactly as before this feature existed."""
+    results = spawn_tcp_ranks(4, FAILOVER_WORKER, timeout=120,
+                              extra_env={
+        **_FO_ENV,
+        "HVD_TPU_ELASTIC": "1",
+        "HVD_TPU_FAULT_SPEC": "rank0:allreduce:3:preempt",
+    })
+    assert results[0][0] == 143, \
+        f"rank 0 exited {results[0][0]}: {results[0][2]}"
+    assert "drain refused" in results[0][2], results[0][2]
+
+
+@pytest.mark.slow
+def test_manifest_authorship_transfers_and_resume_accepts_it(tmp_path):
+    """Checkpoint manifest handoff: after the fail-over the NEW root
+    (worker 1) authors the manifests (``root_wid`` records it); a
+    whole-job kill later auto-resumes from that manifest and finishes
+    digest-identical to an uninterrupted 3-rank run."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    phase1 = spawn_tcp_ranks(4, FAILOVER_WORKER, timeout=180,
+                             extra_env={
+        **_ARMED,
+        "EL_STEPS": "10",
+        "HVD_TPU_CKPT_DIR": ckpt_dir,
+        "HVD_TPU_CKPT_INTERVAL": "1",
+        # rank 0 dies between commits; the survivors fail over, write
+        # world-3 checkpoints under the NEW root, then the whole job
+        # is killed mid-training
+        "HVD_TPU_FAULT_SPEC": (
+            "rank0:allreduce:3:crash,rank1:allreduce:9:crash,"
+            "rank2:allreduce:9:crash,rank3:allreduce:9:crash"),
+    })
+    assert phase1[0][0] == 1
+    for r in (1, 2, 3):
+        assert phase1[r][0] != 0 or "ABORTED" in phase1[r][1], \
+            f"rank {r}: {phase1[r][1]}\n{phase1[r][2]}"
+        assert "DIGEST=" not in phase1[r][1], phase1[r][1]
+    # durable evidence of the handoff: the newest world-3 manifest was
+    # authored by the elected root (worker 1), not the dead worker 0
+    w3 = [(s, e, w) for s, e, w in store.list_manifests(ckpt_dir)
+          if w == 3]
+    assert w3, store.list_manifests(ckpt_dir)
+    newest = store.read_manifest(ckpt_dir, *w3[0])
+    assert newest.get("root_wid") == 1, newest
+
+    phase2 = spawn_tcp_ranks(3, FAILOVER_WORKER, timeout=180,
+                             extra_env={
+        **_FO_ENV,
+        "HVD_TPU_ELASTIC": "1",
+        "EL_STEPS": "10",
+        "HVD_TPU_CKPT_DIR": ckpt_dir,
+        "HVD_TPU_CKPT_INTERVAL": "1",
+    })
+    assert "resumed from step" in phase2[0][2], phase2[0][2]
+    got = _digests(phase2, ranks=[0, 1, 2])
+    for r, (digest, size, steps) in got.items():
+        assert size == 3 and steps == 10
+    assert len({d for d, _, _ in got.values()}) == 1, got
+
+    reference = spawn_tcp_ranks(3, FAILOVER_WORKER, timeout=180,
+                                extra_env={**_FO_ENV,
+                                           "EL_STEPS": "10"})
+    want = _digests(reference, ranks=[0, 1, 2])
+    assert got[0][0] == want[0][0], (got, want)

@@ -386,6 +386,37 @@ def test_elastic_reconfig_path_clean_under_shim(tmp_path):
     assert not active, "\n".join(f["message"] for f in active)
 
 
+def test_coord_failover_path_clean_under_shim(tmp_path):
+    """The coordinator fail-over path under the shim: rank 0 dies
+    mid-collective, the survivors' CAS election races their heartbeat
+    monitors and the abort fan-out, the new rank 0 starts a fresh
+    CoordinatorService while the old epoch's teardown is still in
+    flight — zero non-baselined race reports on any survivor."""
+    results = spawn_tcp_ranks(4, ELASTIC_WORKER, extra_env={
+        "JAX_PLATFORMS": "cpu",
+        "HVD_TPU_RACE": "1",
+        "HVD_TPU_RACE_SEED": "3",
+        "HVD_TPU_RACE_REPORT": str(tmp_path / "cf"),
+        "HVD_TPU_ELASTIC": "1",
+        "HVD_TPU_COORD_FAILOVER": "1",
+        "HVD_TPU_HEARTBEAT_INTERVAL": "0.25",
+        "HVD_TPU_ABORT_TIMEOUT": "10",
+        "HVD_TPU_LIVENESS_TIMEOUT": "2",
+        "HVD_TPU_RECONFIG_TIMEOUT": "60",
+        "HVD_STALL_CHECK_TIME_SECONDS": "1",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "30",
+        "HVD_TCP_RING_THRESHOLD": "1024",
+        "HVD_TPU_FAULT_SPEC": "rank0:allreduce:2:crash",
+    }, timeout=240)
+    assert results[0][0] == 1, f"crashed rank 0: {results[0][1]}"
+    for r in (1, 2, 3):
+        code, out, err = results[r]
+        assert code == 0, f"rank {r}: {out}\n{err}"
+        assert "RECONFIGURED size=3 steps=3" in out, f"rank {r}: {out}"
+    active = _nonbaselined(str(tmp_path / "cf.*.json"))
+    assert not active, "\n".join(f["message"] for f in active)
+
+
 # ------------------------------------------------------------- baseline --
 def test_baseline_is_small_and_justified():
     with open(BASELINE) as f:
