@@ -25,6 +25,11 @@ Also reports (in the same JSON object, under ``extra``):
     engine (native wire dtypes + segment overlap + socket striping)
     swept over segment size and stripe count at 1/4/16/64 MB against
     the seed-era serial f64-wire ring (docs/benchmarks.md).
+  - ``groups`` (``python bench.py --groups`` standalone): process-group
+    overlap — two disjoint groups' allreduces serialized vs
+    concurrently in flight on both the TCP ring plane and the public
+    ``group=`` API, plus the DP x TP grid-vs-mesh transformer step
+    cell (docs/groups.md).
 
 Structure: running ``python bench.py`` starts a supervisor that retries
 the actual measurement in a fresh subprocess (``--worker``), because a
@@ -592,6 +597,102 @@ def _bench_tcp_scaling(ranks=(1, 2, 4, 8), payload_bytes=1 << 14,
     return out
 
 
+def _bench_group_overlap(p=8, group_size=4, payload_bytes=1 << 14,
+                         compute_ms=20.0, iters=4, windows=3):
+    """Process-group overlap probe (ISSUE 14, docs/groups.md): two
+    disjoint groups' allreduces over the real loopback transport,
+    serialized (group A's whole run completes before group B starts)
+    vs concurrently in flight.  Each step is a GIL-free compute stage
+    plus one group-ring allreduce — the model is a TP group on one
+    half of the job and a DP bucket on the other half of the same
+    step.  A data plane with any cross-group serialization point (a
+    shared ring lock, coordinator head-of-line blocking, a global ring
+    namespace) pins concurrent time to serial time; independent
+    per-group planes push ``overlap_speedup`` toward 2x.
+
+    The compute stage is a GIL-free fixed-latency sleep standing in
+    for accelerator-resident work (same rationale as
+    ``_bench_tcp_scaling``), and the payload is small so the step is
+    compute-dominated: on a loaded/1-core CI host the host-CPU cost of
+    the reduction itself cannot overlap, and making it dominant would
+    measure this box's core count instead of whether the transport
+    serializes the two groups."""
+    import threading
+
+    import numpy as np
+
+    groups = [list(range(group_size)), list(range(group_size, p))]
+
+    def run_ranks(ranks, fn):
+        errs = []
+
+        def run(r):
+            try:
+                fn(r)
+            except Exception as exc:  # noqa: BLE001 — reraised below
+                errs.append(exc)
+
+        threads = [threading.Thread(target=run, args=(r,))
+                   for r in ranks]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    services, planes = _ring_harness(p, 1 << 20, 2)
+    seq = [1]
+    rng = np.random.RandomState(2)
+    data = [rng.rand(payload_bytes // 4).astype(np.float32)
+            for _ in range(p)]
+
+    def steps(gi, r, base_rid):
+        grp = groups[gi]
+        # disjoint rid namespaces per group, as the controller's
+        # group-scoped ring-id allocator guarantees on the real path
+        for i in range(iters):
+            time.sleep(compute_ms / 1e3)
+            planes[r].allreduce(base_rid + gi * 1_000_000 + i, data[r],
+                                grp, op_average=False,
+                                world_size=len(grp), timeout=120)
+
+    def serial_run():
+        base = seq[0]
+        seq[0] += iters
+        start = time.perf_counter()
+        for gi, grp in enumerate(groups):
+            run_ranks(grp, lambda r, gi=gi: steps(gi, r, base))
+        return time.perf_counter() - start
+
+    def concurrent_run():
+        base = seq[0]
+        seq[0] += iters
+        start = time.perf_counter()
+        run_ranks(range(p), lambda r: steps(
+            0 if r in groups[0] else 1, r, base))
+        return time.perf_counter() - start
+
+    try:
+        serial_run()      # warmup: connection setup + codepaths
+        concurrent_run()
+        serial_s = sorted(serial_run() for _ in range(windows))[
+            windows // 2]
+        conc_s = sorted(concurrent_run() for _ in range(windows))[
+            windows // 2]
+    finally:
+        for plane in planes:
+            plane.close()
+        for svc in services:
+            svc.shutdown()
+    return {"serial_ms": round(serial_s * 1e3, 3),
+            "concurrent_ms": round(conc_s * 1e3, 3),
+            "overlap_speedup": round(serial_s / conc_s, 3),
+            "groups": [len(g) for g in groups],
+            "payload_bytes": payload_bytes, "compute_ms": compute_ms,
+            "iters": iters}
+
+
 def _bench_ring_pipelined_bandwidth(p=4):
     """Pipelined exact-ring sweep (ISSUE 3): effective GB/s of the
     native-dtype segmented/striped ring vs the seed-era serial
@@ -1092,6 +1193,159 @@ def scaling_worker():
                       "per_device_batch": per_device_batch}))
 
 
+def groups_worker():
+    """Process-group legs (ISSUE 14, docs/groups.md) on the virtual
+    CPU mesh (real chips unchanged: unset the CPU pin).  Two cells,
+    one JSON object:
+
+    - ``api_overlap``: two disjoint groups' allreduces through the
+      REAL public API (``hvd.allreduce(..., group=...)``) from
+      per-rank threads, a serialized pass vs a concurrent pass, with
+      the registry's own ``max_concurrent_groups`` gauge snapshotted
+      after each — the serialized pass must read 1 and the concurrent
+      pass >= 2, which is the "verifiably in flight at once" evidence
+      (asserted, not assumed).
+    - ``dp_tp_step``: transformer train-step time with params sharded
+      through ``hvd.grid(dp=2, tp=4)`` vs the explicit mesh — the
+      grid resolves to the same device mesh, so the ratio is a
+      regression tripwire for the grid-as-mesh path."""
+    import jax
+
+    if not os.environ.get("BENCH_GROUPS_REAL"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import groups as groups_mod
+    from horovod_tpu.common import basics
+
+    devices = jax.devices()
+    hvd.init()
+    n = hvd.size()
+    half = n // 2
+    g0 = hvd.new_group(list(range(half)), name="bench.g0")
+    g1 = hvd.new_group(list(range(half, n)), name="bench.g1")
+    n_elem = int(os.environ.get("BENCH_GROUPS_BYTES", 1 << 14)) // 4
+    iters = int(os.environ.get("BENCH_GROUPS_ITERS", 4))
+    compute_ms = float(os.environ.get("BENCH_GROUPS_COMPUTE_MS", 15.0))
+
+    def member_steps(r, grp, tag):
+        x = jnp.ones((n_elem,), jnp.float32) * (r + 1)
+        for i in range(iters):
+            time.sleep(compute_ms / 1e3)
+            hvd.allreduce(x, op=hvd.Sum, name=f"bench.{tag}.{i}",
+                          group=grp)
+
+    def serial_pass(tag):
+        start = time.perf_counter()
+        for grp in (g0, g1):
+            basics.run_parallel(
+                lambda r, grp=grp: member_steps(r, grp, tag)
+                if r in grp else None)
+        return time.perf_counter() - start
+
+    def concurrent_pass(tag):
+        start = time.perf_counter()
+        basics.run_parallel(
+            lambda r: member_steps(r, g0 if r in g0 else g1, tag))
+        return time.perf_counter() - start
+
+    serial_pass("warm.s")
+    serial_s = serial_pass("timed.s")
+    inflight_serial = groups_mod.stats()["max_concurrent_groups"]
+    concurrent_pass("warm.c")
+    conc_s = concurrent_pass("timed.c")
+    inflight_conc = groups_mod.stats()["max_concurrent_groups"]
+    api_overlap = {
+        "serial_ms": round(serial_s * 1e3, 3),
+        "concurrent_ms": round(conc_s * 1e3, 3),
+        "overlap_speedup": round(serial_s / conc_s, 3),
+        "max_concurrent_groups_serialized": inflight_serial,
+        "max_concurrent_groups": inflight_conc,
+        "iters": iters, "payload_bytes": n_elem * 4,
+        "compute_ms": compute_ms,
+    }
+
+    # -- DP x TP transformer step through the grid vs the explicit mesh
+    import optax
+
+    from horovod_tpu.models import Transformer, TransformerConfig
+    from horovod_tpu.parallel import make_mesh, shard_params
+
+    cfg = TransformerConfig(
+        vocab_size=int(os.environ.get("BENCH_GROUPS_VOCAB", 512)),
+        n_layers=2, d_model=128, n_heads=8, d_ff=256, max_len=64,
+        dtype=jnp.float32)
+    model = Transformer(cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 32)))
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt = optax.sgd(0.01)
+
+    @jax.jit
+    def step(p, opt_state, toks):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, toks)
+            one_hot = jax.nn.one_hot(toks, cfg.vocab_size)
+            return -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(logits) * one_hot, axis=-1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, opt_state = opt.update(grads, opt_state, p)
+        return optax.apply_updates(p, updates), opt_state, loss
+
+    def step_ms(mesh_or_grid):
+        p = shard_params(params, mesh_or_grid)
+        opt_state = opt.init(p)
+        p, opt_state, loss = step(p, opt_state, tokens)
+        float(jax.device_get(loss))  # compile + sync
+        step_iters = int(os.environ.get("BENCH_GROUPS_STEP_ITERS", 6))
+        start = time.perf_counter()
+        for _ in range(step_iters):
+            p, opt_state, loss = step(p, opt_state, tokens)
+        float(jax.device_get(loss))
+        return (time.perf_counter() - start) / step_iters * 1e3
+
+    grd = hvd.grid(dp=2, tp=4)
+    grid_ms = step_ms(grd)
+    mesh_ms = step_ms(make_mesh({"dp": 2, "tp": 4}))
+    dp_tp_step = {"grid_step_ms": round(grid_ms, 3),
+                  "mesh_step_ms": round(mesh_ms, 3),
+                  "grid_vs_mesh": round(grid_ms / mesh_ms, 3)}
+
+    print(json.dumps({"api_overlap": api_overlap,
+                      "dp_tp_step": dp_tp_step,
+                      "platform": devices[0].platform}))
+    hvd.shutdown()
+
+
+def _run_groups(timeout=600):
+    """Run the process-group harness in a CPU-forced subprocess, then
+    attach the TCP-plane overlap probe (in-process: pure loopback
+    sockets + threads, no JAX backend involved); returns the merged
+    dict, or None when both legs failed."""
+    line, _, _ = _run_worker_once(
+        flag="--groups-worker",
+        extra_env={"XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                                 " --xla_force_host_platform_device_count=8"
+                                 ).strip()},
+        timeout=timeout)
+    result = {} if line is None else json.loads(line)
+    try:
+        result["tcp_plane_overlap"] = _bench_group_overlap()
+    except Exception as exc:  # noqa: BLE001 — keep the XLA cells
+        sys.stderr.write(f"tcp-plane group overlap probe failed: "
+                         f"{exc!r}\n")
+    return result or None
+
+
 def _bench_pipeline(devices, steps=None, batch=None, img=None):
     """Input-pipeline overlap measurement: the same host-fed training
     loop with and without ``prefetch_to_device``.  The copy cost the
@@ -1430,6 +1684,10 @@ def _attach_scaling(line):
         sharding = _run_sharding()
         if sharding is not None:
             record["extra"]["sharding"] = sharding
+    if os.environ.get("BENCH_GROUPS", "1") not in ("0", "false", "no"):
+        grp = _run_groups()
+        if grp is not None:
+            record["extra"]["groups"] = grp
     return json.dumps(record)
 
 
@@ -1446,6 +1704,13 @@ if __name__ == "__main__":
         result = _run_sharding()
         print(json.dumps(result if result is not None else
                          {"error": "sharding run failed"}))
+        sys.exit(0 if result is not None else 1)
+    elif "--groups-worker" in sys.argv:
+        groups_worker()
+    elif "--groups" in sys.argv:
+        result = _run_groups()
+        print(json.dumps(result if result is not None else
+                         {"error": "groups run failed"}))
         sys.exit(0 if result is not None else 1)
     elif "--checkpoint" in sys.argv:
         sys.exit(checkpoint_bench())

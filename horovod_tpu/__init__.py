@@ -70,6 +70,7 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     allreduce_async,
     allgather,
     allgather_async,
+    barrier,
     broadcast,
     broadcast_async,
     alltoall,
@@ -81,6 +82,13 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     synchronize,
     poll,
     join,
+)
+from horovod_tpu.groups import (  # noqa: F401
+    Grid,
+    GroupUnsatisfiableError,
+    ProcessGroup,
+    grid,
+    new_group,
 )
 from horovod_tpu.common.objects import broadcast_object  # noqa: F401
 from horovod_tpu.jax_api import (  # noqa: F401

@@ -189,6 +189,10 @@ def init(comm=None, controller=None):
 
         _state = _GlobalState(topology, devices, config, executor, impl,
                               timeline)
+        # a fresh world must not inherit the previous job's process
+        # groups (docs/groups.md): the registry belongs to ONE init
+        from horovod_tpu import groups as groups_mod
+        groups_mod.reset()
         _maybe_install_drain(config)
 
 
@@ -238,12 +242,24 @@ def shutdown():
         _state.controller.shutdown()
         _state.timeline.close()
         _state = None
+    from horovod_tpu import groups as groups_mod
+    groups_mod.reset()
 
 
 def worker_id() -> int:
     """This process's stable elastic identity (the launcher-assigned
     initial rank; unchanged by reconfiguration)."""
     return _get_state().worker_id
+
+
+def members() -> list:
+    """Current worker-id list in rank order: position r holds the
+    stable worker id serving rank r at this membership epoch (identity
+    before any elastic reconfiguration).  Process groups record THESE
+    ids, so their rank-specs survive renumbering (docs/groups.md)."""
+    state = _get_state()
+    m = getattr(state.controller, "_members", None)
+    return list(m) if m is not None else list(range(state.topology.size))
 
 
 def _elastic_reinit(epoch, members):
@@ -283,6 +299,12 @@ def _elastic_reinit(epoch, members):
         state.topology = topology
         state.controller = impl
         state.epoch = epoch
+        # re-form EVERY process group for the new membership
+        # (docs/groups.md): a group is a pure function of (spec,
+        # members) — grids re-plan over the survivors, explicit rank
+        # lists with a dead worker turn typed-unsatisfiable
+        from horovod_tpu import groups as groups_mod
+        groups_mod.reform(list(members))
         get_logger().warning(
             "elastic: worker %d re-formed at epoch %d as rank %d/%d",
             wid, epoch, new_rank, new_size)
@@ -324,6 +346,10 @@ def _elastic_join_init(epoch, members):
                               timeline)
         _state.worker_id = wid
         _state.epoch = epoch
+        # same init-boundary rule as init(): a joiner's fresh world must
+        # not inherit a previous job's process groups (docs/groups.md)
+        from horovod_tpu import groups as groups_mod
+        groups_mod.reset()
         _maybe_install_drain(config)
         get_logger().warning(
             "elastic: worker %d joined at epoch %d as rank %d/%d",

@@ -85,6 +85,10 @@ class Config:
     zero: bool = False
     zero_min_size: int = env_util.DEFAULT_ZERO_MIN_SIZE
     executor: str = "psum"
+    # Process groups (docs/groups.md): cap on live sub-communicators per
+    # job — each owns negotiation state, caches and (tcp) a ring plane,
+    # so an unbounded registry is a leak.
+    group_max: int = env_util.DEFAULT_GROUP_MAX
     # Preemption-aware drain + durable checkpointing
     # (docs/checkpoint.md): ``drain`` converts a worker SIGTERM (the
     # preemption notice) into a planned departure; ``ckpt_dir`` enables
@@ -186,6 +190,9 @@ class Config:
                 env_util.DEFAULT_ZERO_MIN_SIZE),
             executor=_validated_executor(env_util.get_str(
                 env_util.HVD_TPU_EXECUTOR, "psum")),
+            group_max=max(1, env_util.get_int(
+                env_util.HVD_TPU_GROUP_MAX,
+                env_util.DEFAULT_GROUP_MAX)),
             drain=env_util.get_bool(env_util.HVD_TPU_DRAIN, True),
             ckpt_dir=env_util.get_str(env_util.HVD_TPU_CKPT_DIR),
             ckpt_interval_steps=max(1, env_util.get_int(

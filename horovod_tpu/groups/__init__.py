@@ -1,0 +1,406 @@
+"""Process groups: concurrent sub-communicators (docs/groups.md).
+
+A :class:`ProcessGroup` is a named subset of the world that owns its
+own negotiation namespace: the group id joins every request signature,
+response-cache key, and fusion bucket key (the PR 1 bucket-key
+separation and the PR 9 never-fuse rules are the template), so
+collectives from different groups never fuse, never cache-collide, and
+can be concurrently in flight on both data planes — per-group ring
+planes and group-qualified ring-id namespaces on the TCP plane,
+per-(group, signature) memoized sub-executors on the XLA plane.
+
+Groups are a PURE FUNCTION of the membership and their rank-spec
+(reference: Horovod process sets, arXiv:1802.05799 §4): the registry
+records each group's member WORKER IDS at creation, and an elastic
+reconfiguration re-forms every group at the new epoch by remapping
+those ids onto the survivors' new ranks.  A grid re-plans from the
+surviving membership; an explicit rank list that references a departed
+worker becomes typed-unsatisfiable — using the handle raises
+:class:`GroupUnsatisfiableError` instead of hanging a negotiation.
+
+The handle is a stable key, not a snapshot: ``group.ranks`` /
+``group.size`` / ``group.rank()`` always read the CURRENT incarnation
+from the registry, so a handle created before a reconfiguration keeps
+working after it (or fails typed, never stale)."""
+
+import hashlib
+import threading
+
+import numpy as np
+
+from horovod_tpu.common.handles import HvdError
+from horovod_tpu.utils import env as env_util
+
+
+class GroupUnsatisfiableError(HvdError):
+    """An explicit-rank group references a departed worker: the spec
+    cannot be satisfied by the surviving membership, so the group is
+    dead — typed, so callers can tell "re-create me" from a hang."""
+
+    def __init__(self, name, missing):
+        self.group_name = name
+        self.missing = tuple(sorted(missing))
+        super().__init__(
+            f"process group '{name}' is unsatisfiable after "
+            f"reconfiguration: worker id(s) {list(self.missing)} "
+            f"departed (explicit rank lists do not re-plan; re-create "
+            f"the group from the surviving membership)")
+
+
+class _Spec:
+    """What a group IS, membership-independently: the worker ids (or
+    grid shape) it was created from.  ``reform`` re-derives the live
+    incarnation from (spec, members) — nothing else."""
+
+    __slots__ = ("gid", "name", "kind", "wids", "sizes", "axis",
+                 "coords")
+
+    def __init__(self, gid, name, kind, wids=None, sizes=None,
+                 axis=None, coords=None):
+        self.gid = gid
+        self.name = name
+        self.kind = kind          # "ranks" | "grid"
+        self.wids = wids          # tuple of worker ids ("ranks")
+        self.sizes = sizes        # ordered (axis, size) tuple ("grid")
+        self.axis = axis          # grid axis this group runs along
+        self.coords = coords      # fixed coords on the other axes
+
+
+class ProcessGroup:
+    """Handle for a sub-communicator.  Accepted via ``group=`` by every
+    public collective; identity is the deterministic ``gid`` (identical
+    on every rank creating the same spec, no communication needed)."""
+
+    __slots__ = ("gid", "name")
+
+    def __init__(self, gid, name):
+        self.gid = gid
+        self.name = name
+
+    @property
+    def ranks(self):
+        """Current member ranks (re-mapped at each elastic epoch)."""
+        return live_ranks(self.gid)
+
+    @property
+    def size(self):
+        return len(self.ranks)
+
+    def rank(self, global_rank=None):
+        """Group-local rank of ``global_rank`` (default: the calling
+        rank), or -1 when it is not a member."""
+        if global_rank is None:
+            from horovod_tpu.common import basics
+            global_rank = basics.rank()
+        ranks = self.ranks
+        try:
+            return ranks.index(int(global_rank))
+        except ValueError:
+            return -1
+
+    def __contains__(self, global_rank):
+        return int(global_rank) in self.ranks
+
+    def __repr__(self):
+        return (f"ProcessGroup(name={self.name!r}, gid={self.gid!r}, "
+                f"ranks={list(live_ranks(self.gid, strict=False) or ())})")
+
+
+# ------------------------------------------------------------- registry
+_lock = threading.RLock()
+_specs = {}          # gid -> _Spec
+_live = {}           # gid -> tuple(ranks) | GroupUnsatisfiableError
+_tl = threading.local()   # per-rank-thread auto-name counters
+_stats_lock = threading.Lock()
+_max_inflight = 0    # high-water mark of distinct groups in flight
+
+
+def _auto_seq(key):
+    """Deterministic per-rank-thread sequence number for ``key``: every
+    rank's n-th creation of the same spec names the same group (same
+    pattern as eager's thread-local auto-names)."""
+    counters = getattr(_tl, "counters", None)
+    if counters is None:
+        counters = _tl.counters = {}
+    n = counters.get(key, 0)
+    counters[key] = n + 1
+    return n
+
+
+def _gid(name, wids):
+    return hashlib.sha1(
+        f"{name}|{','.join(str(w) for w in wids)}".encode()
+    ).hexdigest()[:12]
+
+
+def _members():
+    """Current worker-id list in rank order (identity before any
+    elastic reconfiguration)."""
+    from horovod_tpu.common import basics
+    return basics.members()
+
+
+def _max_groups():
+    return env_util.get_int(env_util.HVD_TPU_GROUP_MAX,
+                            env_util.DEFAULT_GROUP_MAX)
+
+
+def new_group(ranks, name=None):
+    """Create (or return) the process group over ``ranks``.
+
+    ``ranks`` are CURRENT global ranks; the registry records the
+    corresponding worker ids, so the group survives reconfigurations
+    that keep all members alive and fails typed otherwise.  Identical
+    calls on different ranks converge on the identical handle — the
+    auto-name is a deterministic per-thread sequence, never random."""
+    from horovod_tpu.common import basics
+    world = basics.size()
+    rank_list = tuple(sorted({int(r) for r in ranks}))
+    if not rank_list:
+        raise HvdError("new_group: empty rank list")
+    if rank_list[0] < 0 or rank_list[-1] >= world:
+        raise HvdError(
+            f"new_group: ranks {list(rank_list)} out of range for "
+            f"world size {world}")
+    members = _members()
+    wids = tuple(members[r] for r in rank_list)
+    if name is None:
+        name = f"group.{rank_list[0]}-{rank_list[-1]}" \
+               f".{_auto_seq(('ranks', rank_list))}"
+    gid = _gid(name, wids)
+    with _lock:
+        if gid not in _specs:
+            if len(_specs) >= _max_groups():
+                raise HvdError(
+                    f"new_group: more than {_max_groups()} live "
+                    f"process groups (HVD_TPU_GROUP_MAX); groups leak "
+                    f"— create them once, not per step")
+            _specs[gid] = _Spec(gid, name, "ranks", wids=wids)
+            _live[gid] = rank_list
+    return ProcessGroup(gid, name)
+
+
+class Grid:
+    """DP x TP x PP (x anything) rank grid: world ranks arranged
+    C-order over the named axes — the SAME layout
+    ``parallel.mesh.make_mesh`` gives the device mesh, so
+    ``grid.group(axis)`` and the mesh axis of the same name always
+    name the same peers."""
+
+    __slots__ = ("name", "sizes", "_groups")
+
+    def __init__(self, name, sizes, groups):
+        self.name = name
+        self.sizes = sizes          # ordered (axis, size) tuple
+        self._groups = groups       # axis -> {coords: ProcessGroup}
+
+    @property
+    def axes(self):
+        return tuple(a for a, _ in self.sizes)
+
+    def group(self, axis, rank=None):
+        """The ``axis`` group containing ``rank`` (default: caller)."""
+        if rank is None:
+            from horovod_tpu.common import basics
+            rank = basics.rank()
+        coords = self.coords(rank)
+        key = tuple(c for (a, _), c in zip(self.sizes, coords)
+                    if a != axis)
+        try:
+            return self._groups[axis][key]
+        except KeyError:
+            raise HvdError(
+                f"grid '{self.name}': no {axis!r} group for rank "
+                f"{rank}") from None
+
+    def coords(self, rank):
+        """(axis coords) of ``rank`` in C-order, mirroring make_mesh."""
+        shape = tuple(s for _, s in self.sizes)
+        return tuple(int(c) for c in np.unravel_index(int(rank), shape))
+
+    def mesh_axes(self):
+        """Axis-shape dict for ``make_mesh`` (insertion order kept)."""
+        return dict(self.sizes)
+
+
+def grid(**axes):
+    """``hvd.grid(dp=..., tp=..., pp=...)``: partition the world into
+    one group per line of each named axis.  Axis order follows the
+    kwargs (C-order, consistent with ``MeshAxes``/``make_mesh``); the
+    axis sizes must multiply to the world size.  Grid groups RE-PLAN at
+    an elastic reconfiguration: the same shape is recomputed over the
+    surviving membership, or the grid turns typed-unsatisfiable when
+    the shape no longer fits."""
+    from horovod_tpu.common import basics
+    world = basics.size()
+    sizes = tuple((str(a), int(s)) for a, s in axes.items() if s)
+    if not sizes:
+        raise HvdError("grid: at least one axis size is required")
+    total = 1
+    for _, s in sizes:
+        if s <= 0:
+            raise HvdError(f"grid: axis sizes must be positive: {axes}")
+        total *= s
+    if total != world:
+        raise HvdError(
+            f"grid: axis sizes {dict(sizes)} multiply to {total}, but "
+            f"the world has {world} ranks")
+    gname = f"grid.{'x'.join(f'{a}{s}' for a, s in sizes)}" \
+            f".{_auto_seq(('grid', sizes))}"
+    members = _members()
+    groups = _plan_grid(gname, sizes, members, register=True)
+    return Grid(gname, sizes, groups)
+
+
+def _plan_grid(gname, sizes, members, register):
+    """Form every axis group of a grid over ``members`` (rank i is
+    worker members[i]).  Registration is idempotent by gid."""
+    shape = tuple(s for _, s in sizes)
+    arr = np.arange(int(np.prod(shape))).reshape(shape)
+    groups = {}
+    with _lock:
+        for i, (axis, _) in enumerate(sizes):
+            per_axis = {}
+            moved = np.moveaxis(arr, i, -1)
+            flat = moved.reshape(-1, shape[i])
+            other_shape = moved.shape[:-1]
+            for j in range(flat.shape[0]):
+                coords = tuple(
+                    int(c) for c in np.unravel_index(j, other_shape)) \
+                    if other_shape else ()
+                ranks = tuple(int(r) for r in flat[j])
+                name = f"{gname}.{axis}." \
+                       f"{'-'.join(str(c) for c in coords) or '0'}"
+                wids = tuple(members[r] for r in ranks)
+                gid = _gid(name, wids)
+                if register and gid not in _specs:
+                    _specs[gid] = _Spec(
+                        gid, name, "grid", wids=wids, sizes=sizes,
+                        axis=axis, coords=coords)
+                    _live[gid] = ranks
+                per_axis[coords] = ProcessGroup(gid, name)
+            groups[axis] = per_axis
+    return groups
+
+
+def live_ranks(gid, strict=True):
+    """Current global ranks of group ``gid``.  Raises the group's
+    sticky :class:`GroupUnsatisfiableError` when a reconfiguration made
+    it unsatisfiable (``strict=False``: return None instead)."""
+    with _lock:
+        cur = _live.get(gid)
+    if cur is None:
+        if strict:
+            raise HvdError(f"unknown process group id {gid!r} (created "
+                           f"before the last hvd.init()?)")
+        return None
+    if isinstance(cur, GroupUnsatisfiableError):
+        if strict:
+            raise cur
+        return None
+    return list(cur)
+
+
+def resolve(group):
+    """(gid, ranks) for a ``group=`` argument: (\"\", None) for the
+    world (None), else the group's id and CURRENT member ranks.  The
+    single choke point every collective goes through — unsatisfiable
+    groups fail typed here, before anything reaches a controller."""
+    if group is None:
+        return "", None
+    if not isinstance(group, ProcessGroup):
+        raise HvdError(
+            f"group= expects a ProcessGroup from hvd.new_group()/"
+            f"hvd.grid(), got {type(group).__name__}")
+    return group.gid, tuple(live_ranks(group.gid))
+
+
+def reform(members):
+    """Re-form every registered group for the new membership (called
+    from the elastic reconfiguration path, under the state lock).  A
+    group is a pure function of (spec, members): explicit-rank groups
+    keep exactly their recorded workers (missing worker => typed
+    unsatisfiable); grid groups re-plan the same shape over the
+    survivors when it still fits."""
+    members = list(members)
+    pos = {w: r for r, w in enumerate(members)}
+    with _lock:
+        grids_replanned = set()
+        for gid, spec in list(_specs.items()):
+            if spec.kind == "ranks":
+                missing = [w for w in spec.wids if w not in pos]
+                if missing:
+                    _live[gid] = GroupUnsatisfiableError(spec.name,
+                                                         missing)
+                else:
+                    _live[gid] = tuple(sorted(pos[w]
+                                              for w in spec.wids))
+            else:    # grid: re-plan the shape over the new membership
+                base = spec.name.rsplit(f".{spec.axis}.", 1)[0]
+                shape_total = 1
+                for _, s in spec.sizes:
+                    shape_total *= s
+                if (base, spec.sizes) in grids_replanned:
+                    continue
+                grids_replanned.add((base, spec.sizes))
+                if shape_total != len(members):
+                    err = GroupUnsatisfiableError(
+                        base, [w for w in spec.wids if w not in pos])
+                    for g2, s2 in _specs.items():
+                        if s2.kind == "grid" and s2.sizes == spec.sizes \
+                                and s2.name.startswith(base + "."):
+                            _live[g2] = err
+                    continue
+                # same shape over the survivors, C-order: each existing
+                # gid keeps its (axis, coords) slot with the NEW ranks
+                shape = tuple(s for _, s in spec.sizes)
+                arr = np.arange(shape_total).reshape(shape)
+                for g2, s2 in _specs.items():
+                    if s2.kind != "grid" or s2.sizes != spec.sizes \
+                            or not s2.name.startswith(base + "."):
+                        continue
+                    i = [a for a, _ in s2.sizes].index(s2.axis)
+                    moved = np.moveaxis(arr, i, -1)
+                    other_shape = moved.shape[:-1]
+                    j = int(np.ravel_multi_index(s2.coords,
+                                                 other_shape)) \
+                        if other_shape else 0
+                    ranks = tuple(
+                        int(r) for r in moved.reshape(-1, shape[i])[j])
+                    _live[g2] = ranks
+                    _specs[g2] = _Spec(
+                        g2, s2.name, "grid",
+                        wids=tuple(members[r] for r in ranks),
+                        sizes=s2.sizes, axis=s2.axis, coords=s2.coords)
+
+
+def reset():
+    """Forget every group (hvd.init/shutdown boundary: groups belong
+    to one job, and a fresh world must not inherit stale specs)."""
+    global _max_inflight
+    with _lock:
+        _specs.clear()
+        _live.clear()
+    _tl.counters = {}
+    with _stats_lock:
+        _max_inflight = 0
+
+
+def note_inflight(gids):
+    """Record the number of DISTINCT sub-groups with negotiation
+    entries open right now — the controllers call this from their
+    cycle, and the acceptance tests read the high-water mark to assert
+    cross-group concurrency rather than assume it.  The world ("") is
+    excluded: ``max_concurrent_groups >= 2`` must certify two REAL
+    groups in flight at once, not a world collective passing by."""
+    global _max_inflight
+    n = len({g for g in gids if g})
+    if n:
+        with _stats_lock:
+            if n > _max_inflight:
+                _max_inflight = n
+
+
+def stats():
+    with _stats_lock:
+        return {"max_concurrent_groups": _max_inflight}

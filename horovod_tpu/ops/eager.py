@@ -56,10 +56,23 @@ def _require_rank_context(state, name):
 
 def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
             prescale_factor=1.0, postscale_factor=1.0, splits=None,
-            compression=None) -> Handle:
+            compression=None, group=None) -> Handle:
     state = basics._get_state()
     _require_rank_context(state, name)
+    from horovod_tpu import groups as groups_mod
     from horovod_tpu.common.compression import resolve_compression
+
+    # group scoping (docs/groups.md): resolve the handle to its CURRENT
+    # incarnation — unsatisfiable groups fail typed here, before
+    # anything reaches a controller — and require membership (a
+    # collective from a non-member can never complete)
+    gid, granks = groups_mod.resolve(group)
+    if gid:
+        me = basics.rank()
+        if me not in granks:
+            raise ValueError(
+                f"collective '{name}': rank {me} is not a member of "
+                f"process group {group.name!r} (ranks {list(granks)})")
 
     # None -> the configured default (HVD_TPU_COMPRESSION / autotune);
     # accepts a canonical name or a Compression class.  Adasum combines
@@ -88,6 +101,11 @@ def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
         # (the reference's adapters have the same rule,
         # torch/adapter_v2.h:42).
         committed = _np.array(tensor, copy=True)
+    elif gid:
+        # group-local commit: the entry executes on the group's
+        # sub-executor, whose device list is indexed by group rank
+        committed = state.executor.subset(granks).commit(
+            tensor, granks.index(basics.rank()))
     else:
         committed = state.executor.commit(tensor, basics.rank())
     handle = Handle(name)
@@ -96,14 +114,15 @@ def _submit(req_type, tensor, name, *, op=Sum, root_rank=-1,
         handle=handle, op=op, root_rank=root_rank,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         splits=splits, compression=compression,
-        schedule=getattr(state.config, "schedule", "auto")))
+        schedule=getattr(state.config, "schedule", "auto"),
+        group=gid, group_ranks=granks))
     return handle
 
 
 # ------------------------------------------------------------- allreduce ----
 def allreduce_async(tensor, average=None, name=None, op=None,
                     prescale_factor=1.0, postscale_factor=1.0,
-                    compression=None) -> Handle:
+                    compression=None, group=None) -> Handle:
     """``compression``: ``None`` (use the configured default), a name
     ("none" / "bf16" / "fp16" / "int8") or a
     :class:`horovod_tpu.Compression` member — selects the on-the-wire
@@ -114,25 +133,26 @@ def allreduce_async(tensor, average=None, name=None, op=None,
     return _submit(req_type, tensor, name or _auto_name("allreduce"),
                    op=op, prescale_factor=prescale_factor,
                    postscale_factor=postscale_factor,
-                   compression=compression)
+                   compression=compression, group=group)
 
 
 def allreduce(tensor, average=None, name=None, op=None,
-              prescale_factor=1.0, postscale_factor=1.0, compression=None):
+              prescale_factor=1.0, postscale_factor=1.0, compression=None,
+              group=None):
     return synchronize(allreduce_async(
         tensor, average=average, name=name, op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        compression=compression))
+        compression=compression, group=group))
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,
-                      compression=None):
+                      compression=None, group=None):
     """Allreduce a list of tensors as one negotiation group; fusion batches
     them into single XLA programs."""
     base = name or _auto_name("grouped_allreduce")
     handles = [
         allreduce_async(t, average=average, name=f"{base}.{i}", op=op,
-                        compression=compression)
+                        compression=compression, group=group)
         for i, t in enumerate(tensors)
     ]
     return [synchronize(h) for h in handles]
@@ -141,7 +161,7 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
 # -------------------------------------------------------- reduce_scatter ----
 def reduce_scatter_async(tensor, op=None, average=None, name=None,
                          prescale_factor=1.0, postscale_factor=1.0,
-                         compression=None) -> Handle:
+                         compression=None, group=None) -> Handle:
     """Reduce across ranks, then scatter row blocks of the first
     dimension: rank ``r`` receives rows ``split_sizes[r]`` of the reduced
     tensor (np.array_split partition — the first ``dim0 % size`` ranks
@@ -155,51 +175,59 @@ def reduce_scatter_async(tensor, op=None, average=None, name=None,
                    name or _auto_name("reduce_scatter"), op=op,
                    prescale_factor=prescale_factor,
                    postscale_factor=postscale_factor,
-                   compression=compression)
+                   compression=compression, group=group)
 
 
 def reduce_scatter(tensor, op=None, average=None, name=None,
                    prescale_factor=1.0, postscale_factor=1.0,
-                   compression=None):
+                   compression=None, group=None):
     return synchronize(reduce_scatter_async(
         tensor, op=op, average=average, name=name,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        compression=compression))
+        compression=compression, group=group))
 
 
 # ------------------------------------------------------------- allgather ----
-def allgather_async(tensor, name=None) -> Handle:
+def allgather_async(tensor, name=None, group=None) -> Handle:
     return _submit(RequestType.ALLGATHER, tensor,
-                   name or _auto_name("allgather"))
+                   name or _auto_name("allgather"), group=group)
 
 
-def allgather(tensor, name=None):
-    return synchronize(allgather_async(tensor, name=name))
+def allgather(tensor, name=None, group=None):
+    return synchronize(allgather_async(tensor, name=name, group=group))
 
 
-def grouped_allgather(tensors, name=None):
+def grouped_allgather(tensors, name=None, group=None):
     """Allgather a list of tensors as one negotiation group, mirroring
     :func:`grouped_allreduce`'s naming contract (``base.{i}``)."""
     base = name or _auto_name("grouped_allgather")
-    handles = [allgather_async(t, name=f"{base}.{i}")
+    handles = [allgather_async(t, name=f"{base}.{i}", group=group)
                for i, t in enumerate(tensors)]
     return [synchronize(h) for h in handles]
 
 
 # ------------------------------------------------------------- broadcast ----
-def broadcast_async(tensor, root_rank, name=None) -> Handle:
+def broadcast_async(tensor, root_rank, name=None, group=None) -> Handle:
+    """``root_rank`` is always a GLOBAL rank, with or without a group
+    (the group path translates it internally)."""
     return _submit(RequestType.BROADCAST, tensor,
-                   name or _auto_name("broadcast"), root_rank=root_rank)
+                   name or _auto_name("broadcast"), root_rank=root_rank,
+                   group=group)
 
 
-def broadcast(tensor, root_rank, name=None):
-    return synchronize(broadcast_async(tensor, root_rank, name=name))
+def broadcast(tensor, root_rank, name=None, group=None):
+    return synchronize(broadcast_async(tensor, root_rank, name=name,
+                                       group=group))
 
 
 # -------------------------------------------------------------- alltoall ----
-def alltoall_async(tensor, splits=None, name=None) -> Handle:
+def alltoall_async(tensor, splits=None, name=None, group=None) -> Handle:
     if splits is None:
-        n = basics.size()
+        if group is not None:
+            from horovod_tpu import groups as groups_mod
+            n = len(groups_mod.resolve(group)[1])
+        else:
+            n = basics.size()
         dim0 = int(tensor.shape[0])
         if dim0 % n != 0:
             raise ValueError(
@@ -207,12 +235,27 @@ def alltoall_async(tensor, splits=None, name=None) -> Handle:
                 f"dimension ({dim0}) to be divisible by size ({n})")
         splits = [dim0 // n] * n
     return _submit(RequestType.ALLTOALL, tensor,
-                   name or _auto_name("alltoall"), splits=list(splits))
+                   name or _auto_name("alltoall"), splits=list(splits),
+                   group=group)
 
 
-def alltoall(tensor, splits=None, name=None):
-    result, _ = synchronize(alltoall_async(tensor, splits=splits, name=name))
+def alltoall(tensor, splits=None, name=None, group=None):
+    result, _ = synchronize(alltoall_async(tensor, splits=splits, name=name,
+                                           group=group))
     return result
+
+
+# -------------------------------------------------------------- barrier ----
+def barrier(group=None, name=None):
+    """Block until every rank of ``group`` (default: the world) has
+    entered the barrier.  Implemented as a 1-element allreduce under a
+    reserved auto-name: it rides the ordinary negotiation machinery, so
+    it composes with groups, aborts and elastic epochs for free."""
+    import numpy as _np
+
+    allreduce(_np.zeros(1, dtype=_np.int32), op=Sum,
+              name=name or _auto_name("barrier"), group=group)
+    return None
 
 
 # ------------------------------------------------------------ completion ----

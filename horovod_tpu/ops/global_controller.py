@@ -58,11 +58,13 @@ class MetaReq:
 
     __slots__ = ("name", "req_type", "op", "dtype", "shape", "dims0",
                  "splits", "root_rank", "prescale", "postscale", "ranks",
-                 "error", "compression", "schedule")
+                 "error", "compression", "schedule", "group",
+                 "group_ranks")
 
     def __init__(self, name, req_type, op, dtype, shape, dims0, splits,
                  root_rank, prescale, postscale, ranks, error=None,
-                 compression="none", schedule="auto"):
+                 compression="none", schedule="auto", group="",
+                 group_ranks=None):
         self.error = error  # intra-process validation failure, if any
         self.name = name
         self.req_type = int(req_type)
@@ -77,6 +79,12 @@ class MetaReq:
         self.ranks = tuple(ranks)     # local ranks that submitted
         self.compression = compression  # process-resolved wire compression
         self.schedule = schedule      # process-resolved collective schedule
+        # process-group scoping (docs/groups.md): "" is the world; a
+        # group id keeps negotiations from different groups apart at the
+        # coordinator exactly as in the in-process table
+        self.group = group
+        self.group_ranks = (tuple(group_ranks) if group_ranks is not None
+                            else None)
 
 
 class CycleMsg:
@@ -98,13 +106,15 @@ class LogEntry:
     __slots__ = ("seq", "kind", "req_type", "names", "shapes", "dtype",
                  "op", "prescale", "postscale", "root_rank", "all_dims0",
                  "splits_matrix", "error", "last_rank", "joined", "params",
-                 "compression", "schedule", "origin")
+                 "compression", "schedule", "origin", "group",
+                 "group_ranks")
 
     def __init__(self, seq, kind, req_type=None, names=(), shapes=(),
                  dtype=None, op=0, prescale=1.0, postscale=1.0,
                  root_rank=-1, all_dims0=None, splits_matrix=None,
                  error=None, last_rank=-1, joined=(), params=None,
-                 compression="none", schedule="auto", origin=-1):
+                 compression="none", schedule="auto", origin=-1,
+                 group="", group_ranks=None):
         self.seq = seq
         self.kind = kind  # "group" | "error" | "join_done" | "params"
         #                   | "abort"
@@ -125,6 +135,12 @@ class LogEntry:
         self.compression = compression  # coordinator-resolved wire format
         self.schedule = schedule      # coordinator-resolved schedule
         self.origin = origin          # abort origin rank ("abort" entries)
+        # process-group scoping: "" is the world; a group's entries
+        # carry the full member list so every process re-keys to
+        # group-local ranks identically (docs/groups.md)
+        self.group = group
+        self.group_ranks = (tuple(group_ranks) if group_ranks is not None
+                            else None)
 
 
 class CycleResp:
@@ -135,12 +151,15 @@ class CycleResp:
 
 
 class _GlobalName:
-    __slots__ = ("first_ts", "reqs", "stall_warned")
+    __slots__ = ("first_ts", "reqs", "stall_warned", "group",
+                 "group_ranks")
 
-    def __init__(self):
+    def __init__(self, group="", group_ranks=None):
         self.first_ts = time.monotonic()
         self.reqs = {}   # pid -> MetaReq
         self.stall_warned = False
+        self.group = group
+        self.group_ranks = group_ranks
 
 
 # ---------------------------------------------------------------- coordinator
@@ -251,6 +270,17 @@ class MetaCoordinatorService(network.MuxService):
             base += ls
         return out
 
+    def _entry_required_pids(self, entry):  # holds: self._cv
+        """Processes whose report this entry waits on: a group entry
+        needs exactly the processes hosting its member ranks (joins are
+        a world-level protocol and never stand in for group members,
+        docs/groups.md); a world entry needs every process with a
+        non-joined rank."""
+        if entry.group:
+            return {self._rank_pid[r] for r in entry.group_ranks
+                    if r in self._rank_pid}
+        return self._required_pids()
+
     def _handle_cycle(self, msg):
         with self._cv:
             self._last_seen[msg.pid] = time.monotonic()
@@ -267,18 +297,28 @@ class MetaCoordinatorService(network.MuxService):
             # response); honoring it would poison the cleared join set
             # names already emitted but not yet acked by this pid: a
             # re-report is the lost-response replay, not a new request
-            inflight = {n for e in self._log_entries
+            inflight = {(getattr(e, "group", ""), n)
+                        for e in self._log_entries
                         if e.seq > msg.last_seq for n in e.names}
             for req in msg.reqs:
-                if req.name in inflight or self._aborted is not None:
+                key = (getattr(req, "group", ""), req.name)
+                if key in inflight or self._aborted is not None:
                     # post-abort requests would never complete — the
                     # abort entry below fails them process-side instead
                     continue
-                entry = self._table.get(req.name)
+                entry = self._table.get(key)
                 if entry is None:
-                    entry = _GlobalName()
-                    self._table[req.name] = entry
+                    entry = _GlobalName(
+                        group=key[0],
+                        group_ranks=getattr(req, "group_ranks", None))
+                    self._table[key] = entry
                 entry.reqs[msg.pid] = req
+            if self._table:
+                # cross-group concurrency gauge (docs/groups.md): the
+                # coordinator sees every process's open negotiations, so
+                # this is the pod-wide in-flight measurement
+                from horovod_tpu import groups as groups_mod
+                groups_mod.note_inflight(g for (g, _) in self._table)
             self._advance()
             self._check_stalls()
             entries = [e for e in self._log_entries if e.seq > msg.last_seq]
@@ -302,23 +342,25 @@ class MetaCoordinatorService(network.MuxService):
     def _advance(self):  # holds: self._cv
         """Emit log entries for names every required process reported.
         Caller holds the lock."""
-        required = self._required_pids()
-        ready = [(name, entry) for name, entry in self._table.items()
-                 if required.issubset(entry.reqs.keys())]
+        ready = [(key, entry) for key, entry in self._table.items()
+                 if self._entry_required_pids(entry)
+                 .issubset(entry.reqs.keys())]
         if not ready and not self._join_done_ready():
             return
 
         # validate first; bucket the valid ones with the SAME planner and
         # compatibility key the in-process controllers use
-        validated = []  # (name, meta) | error LogEntries emitted inline
-        for name, entry in ready:
-            del self._table[name]
-            err, meta = self._validate(name, entry)
+        validated = []  # (key, meta) | error LogEntries emitted inline
+        for key, entry in ready:
+            del self._table[key]
+            err, meta = self._validate(key, entry)
             if err is not None:
                 self._emit(LogEntry(self._next_seq(), "error",
-                                    names=[name], error=err))
+                                    names=[key[1]], error=err,
+                                    group=key[0],
+                                    group_ranks=entry.group_ranks))
                 continue
-            validated.append((name, meta))
+            validated.append((key, meta))
 
         def key(item):
             _, meta = item
@@ -328,7 +370,7 @@ class MetaCoordinatorService(network.MuxService):
             return PythonController.allreduce_bucket_key(
                 meta["dtype"], meta["op"], meta["prescale"],
                 meta["postscale"], meta.get("compression", "none"),
-                meta.get("schedule", "auto"))
+                meta.get("schedule", "auto"), meta.get("group", ""))
 
         def nbytes(item):
             _, meta = item
@@ -355,19 +397,23 @@ class MetaCoordinatorService(network.MuxService):
             first_meta = bucket[0][1]
             rtype = RequestType(first_meta["req_type"])
             if rtype == RequestType.ALLREDUCE:
+                # group joins the bucket key above, so every member of a
+                # fused bucket belongs to ONE group (never-fuse rule)
                 self._emit(LogEntry(
                     self._next_seq(), "group",
                     req_type=int(RequestType.ALLREDUCE),
-                    names=[n for n, _ in bucket],
+                    names=[k[1] for k, _ in bucket],
                     shapes=[m["shape"] for _, m in bucket],
                     dtype=first_meta["dtype"], op=first_meta["op"],
                     prescale=first_meta["prescale"],
                     postscale=first_meta["postscale"],
                     compression=first_meta.get("compression", "none"),
                     schedule=first_meta.get("schedule", "auto"),
-                    joined=sorted(self._joined)))
+                    joined=sorted(self._joined),
+                    group=first_meta.get("group", ""),
+                    group_ranks=first_meta.get("group_ranks")))
             else:
-                name, meta = bucket[0]
+                (_, name), meta = bucket[0]
                 self._emit(LogEntry(
                     self._next_seq(), "group", req_type=int(rtype),
                     names=[name], shapes=[meta["shape"]],
@@ -378,7 +424,9 @@ class MetaCoordinatorService(network.MuxService):
                     compression=meta.get("compression", "none"),
                     all_dims0=meta.get("all_dims0"),
                     splits_matrix=meta.get("splits_matrix"),
-                    joined=sorted(self._joined)))
+                    joined=sorted(self._joined),
+                    group=meta.get("group", ""),
+                    group_ranks=meta.get("group_ranks")))
         self._maybe_emit_join_done()
 
     def _join_done_ready(self):  # holds: self._cv
@@ -414,9 +462,16 @@ class MetaCoordinatorService(network.MuxService):
         self._log_entries = [e for e in self._log_entries if e.seq > floor]
 
     # ------------------------------------------------------------ validation
-    def _validate(self, name, entry):  # holds: self._cv
+    def _validate(self, key, entry):  # holds: self._cv
         """Cross-process agreement (reference: ConstructResponse,
         controller.cc:378).  Returns (error, meta)."""
+        gid, name = key
+        # a group entry's world is its member list in spec order; dims /
+        # splits matrices are emitted in THAT order so every process
+        # re-keys to group-local ranks identically (docs/groups.md)
+        member_ranks = (list(entry.group_ranks) if gid
+                        else list(range(self._world)))
+        gsize = len(member_ranks)
         reqs = list(entry.reqs.values())
         first = reqs[0]
 
@@ -453,7 +508,8 @@ class MetaCoordinatorService(network.MuxService):
                 # requests negotiated for different schedules can never
                 # fuse into one program
                 "schedule": PythonController.resolve_group_schedule(
-                    getattr(r, "schedule", "auto") for r in reqs)}
+                    getattr(r, "schedule", "auto") for r in reqs),
+                "group": gid, "group_ranks": entry.group_ranks}
 
         if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM):
             if any(r.shape != first.shape for r in reqs):
@@ -484,29 +540,33 @@ class MetaCoordinatorService(network.MuxService):
             dims = {}
             for r in reqs:
                 dims.update(r.dims0 or {})
-            missing = [r for r in range(self._world)
-                       if r not in dims and r not in self._joined]
+            missing = [r for r in member_ranks
+                       if r not in dims and (gid or r not in self._joined)]
             if missing:
                 return (f"allgather '{name}': missing first-dim info for "
                         f"ranks {missing}", None)
             meta["all_dims0"] = [int(dims.get(r, 0))
-                                 for r in range(self._world)]
+                                 for r in member_ranks]
         elif rtype == RequestType.BROADCAST:
             if any(r.root_rank != first.root_rank for r in reqs):
                 return (f"mismatched root ranks for broadcast '{name}'",
                         None)
             if any(r.shape != first.shape for r in reqs):
                 return (f"mismatched shapes for broadcast '{name}'", None)
+            if gid and first.root_rank not in member_ranks:
+                return (f"broadcast '{name}': root rank "
+                        f"{first.root_rank} is not a member of group "
+                        f"'{gid}'", None)
             root_pid = self._rank_pid.get(first.root_rank)
-            if root_pid is None or first.root_rank not in \
-                    entry.reqs[root_pid].ranks:
+            if root_pid is None or root_pid not in entry.reqs \
+                    or first.root_rank not in entry.reqs[root_pid].ranks:
                 return (f"broadcast '{name}': root rank "
                         f"{first.root_rank} did not participate", None)
         elif rtype == RequestType.ALLTOALL:
             splits = {}
             for r in reqs:
                 splits.update(r.splits or {})
-            missing = [r for r in range(self._world) if r not in splits]
+            missing = [r for r in member_ranks if r not in splits]
             if missing:
                 return (f"alltoall '{name}': missing splits for ranks "
                         f"{missing}", None)
@@ -514,29 +574,31 @@ class MetaCoordinatorService(network.MuxService):
             for r in reqs:
                 dims.update(r.dims0 or {})
             for r, row in splits.items():
-                if len(row) != self._world:
+                if len(row) != gsize:
                     return (f"alltoall '{name}': splits must have one "
-                            f"entry per rank ({self._world})", None)
+                            f"entry per rank ({gsize})", None)
                 if r in dims and sum(row) != dims[r]:
                     return (f"alltoall '{name}': splits sum {sum(row)} "
                             f"!= first dimension {dims[r]} on rank {r}",
                             None)
             meta["splits_matrix"] = [list(splits[r])
-                                     for r in range(self._world)]
+                                     for r in member_ranks]
         return (None, meta)
 
     # ----------------------------------------------------------------- stall
     def _check_stalls(self):  # holds: self._cv
         """Caller holds the lock (reference: StallInspector on rank 0)."""
         now = time.monotonic()
-        for name, entry in list(self._table.items()):
+        for key, entry in list(self._table.items()):
+            gid, name = key
+            label = f"{name} (group '{gid}')" if gid else name
             age = now - entry.first_ts
             if age > self._stall_warning and not entry.stall_warned:
-                waiting = sorted(set(range(self._nproc))
+                waiting = sorted(self._entry_required_pids(entry)
                                  - set(entry.reqs.keys()))
                 self._log.warning(
                     "Stalled tensor: %s reported by processes %s, waiting "
-                    "on processes %s for more than %ds", name,
+                    "on processes %s for more than %ds", label,
                     sorted(entry.reqs.keys()), waiting,
                     int(self._stall_warning))
                 entry.stall_warned = True
@@ -545,14 +607,23 @@ class MetaCoordinatorService(network.MuxService):
                 # REQUIRED process names the origin rank (a fully-joined
                 # process legitimately submits nothing and must not take
                 # the blame), and EVERY process's ranks fail with the
-                # same typed error (not just this name's waiters)
-                waiting = sorted(self._required_pids()
+                # same typed error (not just this name's waiters).
+                # Group-scoped entries stamp the lagging GROUP member —
+                # and the abort still fails the whole job (docs/groups.md:
+                # no half-dead jobs)
+                waiting = sorted(self._entry_required_pids(entry)
                                  - set(entry.reqs.keys()))
-                origin = (sum(self._local_sizes[:waiting[0]])
-                          if waiting else -1)
+                if not waiting:
+                    origin = -1
+                elif gid:
+                    origin = min(
+                        r for r in entry.group_ranks
+                        if self._rank_pid.get(r) == waiting[0])
+                else:
+                    origin = sum(self._local_sizes[:waiting[0]])
                 self._initiate_abort(
                     origin,
-                    f"stalled tensor '{name}' exceeded shutdown "
+                    f"stalled tensor '{label}' exceeded shutdown "
                     f"threshold of {self._stall_shutdown}s (waiting on "
                     f"processes {waiting})")
                 return
@@ -771,16 +842,27 @@ class GlobalMeshController(PythonController):
         if not self._config.stall_check_disable:
             self._check_local_stalls()
 
-        # names whose local ranks have all contributed -> report metadata
-        needed_local = self._local_rank_set - self._joined_view
+        # cross-group concurrency gauge (docs/groups.md), same as the
+        # in-process cycle this method overrides
+        if self._table:
+            from horovod_tpu import groups as groups_mod
+            groups_mod.note_inflight(g for (g, _) in self._table)
+
+        # names whose local ranks have all contributed -> report
+        # metadata.  A group entry waits on exactly the LOCAL members of
+        # its group (joins never stand in for group ranks); the world
+        # waits on every non-joined local rank.
+        world_needed = self._local_rank_set - self._joined_view
         new_reqs = []
-        for name, entry in self._table.items():
-            if name in self._reported:
+        for key, entry in self._table.items():
+            if key in self._reported:
                 continue
+            needed_local = (self._local_rank_set & set(entry.group_ranks)
+                            if entry.group else world_needed)
             if needed_local and not needed_local.issubset(
                     entry.requests.keys()):
                 continue
-            new_reqs.append(self._meta_for(name, entry))
+            new_reqs.append(self._meta_for(key, entry))
 
         newly_joined = sorted(self._joined_view - self._joined_reported)
 
@@ -830,7 +912,7 @@ class GlobalMeshController(PythonController):
         self._send_fail_since = None
         self._last_cycle_sent = time.monotonic()
         # reported only once the coordinator actually received them
-        self._reported.update(r.name for r in new_reqs)
+        self._reported.update((r.group, r.name) for r in new_reqs)
         self._joined_reported.update(newly_joined)
 
         for entry in resp.entries:
@@ -843,13 +925,16 @@ class GlobalMeshController(PythonController):
         if self._reported or join_outstanding:
             self._wakeup.set()
 
-    def _meta_for(self, name, entry):
+    def _meta_for(self, key, entry):
+        gid, name = key
         reqs = entry.requests
         # intra-process agreement first (the coordinator only compares
         # ACROSS processes); a local mismatch is reported as an error so
         # every process's ranks fail consistently
         error = PythonController.validate_requests(
-            name, reqs, size=self._size, joined=bool(self._joined_view))
+            name, reqs,
+            size=(len(entry.group_ranks) if gid else self._size),
+            joined=bool(self._joined_view) and not gid)
         first = next(iter(reqs.values()))
         shape = tuple(first.tensor.shape) if first.tensor is not None else ()
         dtype = (np.dtype(first.tensor.dtype).name
@@ -868,7 +953,8 @@ class GlobalMeshController(PythonController):
             compression=self.resolve_group_compression(
                 r.compression for r in reqs.values()),
             schedule=self.resolve_group_schedule(
-                getattr(r, "schedule", "auto") for r in reqs.values()))
+                getattr(r, "schedule", "auto") for r in reqs.values()),
+            group=gid, group_ranks=entry.group_ranks)
 
     # ------------------------------------------------------------- execution
     def _apply(self, entry):
@@ -887,9 +973,10 @@ class GlobalMeshController(PythonController):
             return
 
         if entry.kind == "error":
+            egid = getattr(entry, "group", "")
             for name in entry.names:
-                local = self._table.pop(name, None)
-                self._reported.discard(name)
+                local = self._table.pop((egid, name), None)
+                self._reported.discard((egid, name))
                 if local is not None:
                     for request in local.requests.values():
                         request.handle.set_error(entry.error)
@@ -908,28 +995,57 @@ class GlobalMeshController(PythonController):
 
         rtype = RequestType(entry.req_type)
         joined_global = set(entry.joined)
+        gid = getattr(entry, "group", "")
+        granks = (list(entry.group_ranks)
+                  if gid and entry.group_ranks else None)
         groups = []
         for name, shape in zip(entry.names, entry.shapes):
-            local = self._table.pop(name, None)
-            self._reported.discard(name)
+            local = self._table.pop((gid, name), None)
+            self._reported.discard((gid, name))
             requests = local.requests if local is not None else {}
-            tensors = {rank: r.tensor for rank, r in requests.items()}
-            for rank in self._local_rank_set:
-                if rank in joined_global or rank not in tensors:
-                    tensors.setdefault(rank, None)
+            if granks is not None:
+                # group entries are re-keyed to GROUP-LOCAL ranks (same
+                # rule as python_controller._build_group): the executor
+                # that runs them is the group's sub-mesh, whose world is
+                # 0..len(granks)-1 in member order
+                tensors = {granks.index(rank): r.tensor
+                           for rank, r in requests.items()}
+                handles = {granks.index(rank): r.handle
+                           for rank, r in requests.items()}
+                root = (granks.index(entry.root_rank)
+                        if entry.root_rank in granks else entry.root_rank)
+            else:
+                tensors = {rank: r.tensor for rank, r in requests.items()}
+                for rank in self._local_rank_set:
+                    if rank in joined_global or rank not in tensors:
+                        tensors.setdefault(rank, None)
+                handles = {rank: r.handle for rank, r in requests.items()}
+                root = entry.root_rank
             groups.append(GroupEntry(
                 name=name, shape=tuple(shape), dtype=np.dtype(entry.dtype),
                 tensors=tensors,
-                handles={rank: r.handle for rank, r in requests.items()},
-                root_rank=entry.root_rank,
+                handles=handles,
+                root_rank=root,
                 splits=(entry.splits_matrix
                         if entry.splits_matrix is not None else None),
                 op=ReduceOp(entry.op), prescale_factor=entry.prescale,
                 postscale_factor=entry.postscale,
                 all_dims0=entry.all_dims0,
                 compression=getattr(entry, "compression", "none"),
-                schedule=getattr(entry, "schedule", "auto")))
+                schedule=getattr(entry, "schedule", "auto"),
+                group=gid,
+                group_ranks=(tuple(granks) if granks is not None
+                             else None)))
             self._timeline.end(name)
+
+        if granks is not None and not (self._local_rank_set
+                                       & set(granks)):
+            # no local device belongs to this group: nothing to
+            # contribute, and the group's sub-mesh program is not
+            # addressable from this process.  The ordered response
+            # stream is still consumed in sequence, so SPMD ordering
+            # across member processes is untouched.
+            return
 
         # execution + error surfacing shared with the in-process
         # controller (PythonController._execute_allreduce_bucket /
@@ -952,14 +1068,20 @@ class GlobalMeshController(PythonController):
         once reported, the coordinator owns stall handling."""
         now = time.monotonic()
         warn_after = self._config.stall_warning_seconds
-        for name, entry in list(self._table.items()):
-            if name in self._reported:
+        for key, entry in list(self._table.items()):
+            if key in self._reported:
                 continue
+            gid, name = key
             age = now - entry.first_ts
             if age > warn_after and not entry.stall_warned:
                 ready = sorted(entry.requests.keys())
-                missing = sorted(self._local_rank_set - set(ready)
-                                 - self._joined_view)
+                if entry.group:
+                    expected = self._local_rank_set & set(entry.group_ranks)
+                    missing = sorted(expected - set(ready))
+                    name = f"{name} (group '{gid}')"
+                else:
+                    missing = sorted(self._local_rank_set - set(ready)
+                                     - self._joined_view)
                 self._log.warning(
                     "Tensor %s waiting on local ranks %s (ready: %s) for "
                     "more than %ds", name, missing, ready, int(warn_after))

@@ -177,10 +177,19 @@ def _load_lib():
 
 class NativeController:
     def __init__(self, topology, executor, timeline, config):
-        del timeline  # the core writes the timeline itself
+        # the core writes the timeline itself; the reference is kept
+        # only for the grouped-collective companion controller below
+        self._timeline = timeline
         self._topo = topology
         self._executor = executor
         self._config = config
+        # Grouped collectives (group= on the eager API) carry fields the
+        # embedded C++ core's wire format predates; they are routed to a
+        # lazily-created in-process PythonController that shares this
+        # controller's executor, so group isolation (sub-executors,
+        # (group, name) negotiation keys, never-fuse bucket keys) holds
+        # without a binary-format change (docs/groups.md).
+        self._companion = None
         self._lib = _load_lib()
         self._core = self._lib.hvd_core_create(topology.size)
         self._pending = {}   # req_id -> (EagerRequest-ish record)
@@ -199,7 +208,32 @@ class NativeController:
                                         daemon=True, name="hvd-dispatcher")
         self._thread.start()
 
+    def _companion_controller(self):
+        with self._lock:
+            if not self._running:
+                return None
+            if self._companion is None:
+                timeline = self._timeline
+                if timeline is None:
+                    # the native path passes timeline=None (the core
+                    # writes its own trace); the companion needs a real
+                    # (no-op) Timeline object
+                    from horovod_tpu.utils.timeline import Timeline
+                    timeline = Timeline(None)
+                companion = PythonController(self._topo, self._executor,
+                                             timeline, self._config)
+                companion.start()
+                self._companion = companion
+            return self._companion
+
     def enqueue(self, request):
+        if getattr(request, "group", ""):
+            companion = self._companion_controller()
+            if companion is None:
+                request.handle.set_error("horovod_tpu has been shut down")
+                return
+            companion.enqueue(request)
+            return
         req_id = next(self._ids)
         tensor = request.tensor
         shape = [] if tensor is None else [int(d) for d in tensor.shape]
@@ -238,6 +272,10 @@ class NativeController:
         if not self._running:
             return
         self._running = False
+        with self._lock:
+            companion, self._companion = self._companion, None
+        if companion is not None:
+            companion.shutdown()
         self._lib.hvd_core_shutdown(self._core)
         drained = True
         if self._thread is not None:

@@ -51,6 +51,12 @@ class EagerRequest:
     splits: list | None = None
     compression: str = "none"
     schedule: str = "auto"
+    # process-group scoping (docs/groups.md): "" is the world; a group
+    # id makes the group part of the negotiation identity — entries,
+    # signatures and fusion buckets are all group-qualified, so
+    # cross-group requests can never meet, fuse, or cache-collide
+    group: str = ""
+    group_ranks: tuple | None = None
 
     def signature(self):
         """Everything validation checks, flattened into a hashable key
@@ -62,17 +68,21 @@ class EagerRequest:
         return (self.req_type, dtype, shape, self.op, self.root_rank,
                 self.prescale_factor, self.postscale_factor,
                 tuple(self.splits) if self.splits is not None else None,
-                self.compression, self.schedule)
+                self.compression, self.schedule, self.group,
+                self.group_ranks)
 
 
 class _NameEntry:
-    __slots__ = ("first_ts", "req_type", "requests", "stall_warned")
+    __slots__ = ("first_ts", "req_type", "requests", "stall_warned",
+                 "group", "group_ranks")
 
-    def __init__(self, req_type):
+    def __init__(self, req_type, group="", group_ranks=None):
         self.first_ts = time.monotonic()
         self.req_type = req_type
         self.requests = {}
         self.stall_warned = False
+        self.group = group
+        self.group_ranks = group_ranks
 
 
 class GroupEntry:
@@ -81,12 +91,13 @@ class GroupEntry:
 
     __slots__ = ("name", "shape", "dtype", "tensors", "handles", "root_rank",
                  "splits", "op", "prescale_factor", "postscale_factor",
-                 "all_dims0", "compression", "schedule")
+                 "all_dims0", "compression", "schedule", "group",
+                 "group_ranks")
 
     def __init__(self, name, shape, dtype, tensors, handles, root_rank=-1,
                  splits=None, op=ReduceOp.SUM, prescale_factor=1.0,
                  postscale_factor=1.0, all_dims0=None, compression="none",
-                 schedule="auto"):
+                 schedule="auto", group="", group_ranks=None):
         self.name = name
         self.shape = shape
         self.dtype = dtype
@@ -100,6 +111,8 @@ class GroupEntry:
         self.all_dims0 = all_dims0
         self.compression = compression
         self.schedule = schedule
+        self.group = group
+        self.group_ranks = group_ranks
 
 
 class PythonController:
@@ -300,12 +313,18 @@ class PythonController:
 
     def _absorb(self, pending):
         """Absorb new requests into the message table (reference:
-        TensorQueue pop + table insert)."""
+        TensorQueue pop + table insert).  The table key is
+        (group, name): same-named tensors from different groups are
+        DIFFERENT negotiations and must never meet in one entry."""
         for request in pending:
-            entry = self._table.get(request.name)
+            key = (getattr(request, "group", ""), request.name)
+            entry = self._table.get(key)
             if entry is None:
-                entry = _NameEntry(request.req_type)
-                self._table[request.name] = entry
+                entry = _NameEntry(request.req_type,
+                                   group=key[0],
+                                   group_ranks=getattr(
+                                       request, "group_ranks", None))
+                self._table[key] = entry
                 self._timeline.begin(
                     request.name, f"NEGOTIATE_{request.req_type.name}")
             if request.rank in entry.requests:
@@ -329,23 +348,36 @@ class PythonController:
         if not self._config.stall_check_disable:
             self._check_stalls()
 
-        # 3. collect ready responses in deterministic (arrival) order
-        ready_names = []
-        needed = set(range(self._size)) - self._joined_view
-        for name, entry in self._table.items():
+        # 2b. cross-group concurrency gauge (docs/groups.md): distinct
+        # groups with entries open right now — read by the acceptance
+        # tests to assert concurrency rather than assume it
+        if self._table:
+            from horovod_tpu import groups as groups_mod
+            groups_mod.note_inflight(g for (g, _) in self._table)
+
+        # 3. collect ready responses in deterministic (arrival) order.
+        # Readiness is per entry: a group entry needs exactly its
+        # member ranks (no join stand-ins — joins are a world-level
+        # protocol), the world needs every non-joined rank.
+        ready_keys = []
+        world_needed = set(range(self._size)) - self._joined_view
+        for key, entry in self._table.items():
+            needed = (set(entry.group_ranks) if entry.group
+                      else world_needed)
             if needed.issubset(entry.requests.keys()):
-                ready_names.append(name)
+                ready_keys.append(key)
 
         responses = []
-        for name in ready_names:
-            entry = self._table.pop(name)
+        for key in ready_keys:
+            entry = self._table.pop(key)
+            _, name = key
             self._timeline.end(name)
-            if self._cache_check(name, entry):
+            if self._cache_check(key, entry):
                 group = self._build_group(name, entry)
             else:
                 group = self._construct_response(name, entry)
                 if group is not None:
-                    self._cache_store(name, entry)
+                    self._cache_store(key, entry)
             if group is not None:
                 responses.append((entry.req_type, group))
 
@@ -377,7 +409,15 @@ class PythonController:
                 self._joined.clear()
 
     # ---------------------------------------------------------- response cache
-    def _cache_check(self, name, entry) -> bool:
+    @staticmethod
+    def _cache_key(key):
+        """Group-qualified response-cache name: a group's validated
+        signature must never satisfy the world's (or another group's)
+        entry of the same tensor name."""
+        group, name = key
+        return f"g:{group}:{name}" if group else name
+
+    def _cache_check(self, key, entry) -> bool:
         """Fast path (reference: ``response_cache.cc`` HIT): every rank's
         request carries the same signature as the last validated cycle for
         this name — skip validation.  Never taken while ranks have joined
@@ -385,11 +425,13 @@ class PythonController:
         if self._joined_view:
             return False
         return self._sig_cache.check(
-            name, (r.signature() for r in entry.requests.values()))
+            self._cache_key(key),
+            (r.signature() for r in entry.requests.values()))
 
-    def _cache_store(self, name, entry):
+    def _cache_store(self, key, entry):
         self._sig_cache.store(
-            name, (r.signature() for r in entry.requests.values()))
+            self._cache_key(key),
+            (r.signature() for r in entry.requests.values()))
 
     @staticmethod
     def resolve_group_compression(compressions):
@@ -416,22 +458,42 @@ class PythonController:
         cache-hit) table entry."""
         requests = entry.requests
         any_req = next(iter(requests.values()))
-        tensors = {rank: r.tensor for rank, r in requests.items()}
-        for joined_rank in self._joined_view:
-            tensors.setdefault(joined_rank, None)
+        gid = getattr(entry, "group", "")
+        granks = getattr(entry, "group_ranks", None)
+        if gid:
+            # group entries are re-keyed to GROUP-LOCAL ranks: the
+            # executor that runs them is the group's sub-executor
+            # (devices[granks]), whose world is 0..len(granks)-1
+            order = list(granks)
+            tensors = {order.index(rank): r.tensor
+                       for rank, r in requests.items()}
+            handles = {order.index(rank): r.handle
+                       for rank, r in requests.items()}
+            root = (order.index(any_req.root_rank)
+                    if any_req.root_rank in order else any_req.root_rank)
+            splits = {order.index(rank): r.splits
+                      for rank, r in requests.items()}
+        else:
+            tensors = {rank: r.tensor for rank, r in requests.items()}
+            for joined_rank in self._joined_view:
+                tensors.setdefault(joined_rank, None)
+            handles = {rank: r.handle for rank, r in requests.items()}
+            root = any_req.root_rank
+            splits = {rank: r.splits for rank, r in requests.items()}
         return GroupEntry(
             name=name, shape=tuple(any_req.tensor.shape),
             dtype=any_req.tensor.dtype, tensors=tensors,
-            handles={rank: r.handle for rank, r in requests.items()},
-            root_rank=any_req.root_rank,
-            splits={rank: r.splits for rank, r in requests.items()},
+            handles=handles,
+            root_rank=root,
+            splits=splits,
             op=any_req.op, prescale_factor=any_req.prescale_factor,
             postscale_factor=any_req.postscale_factor,
             compression=self.resolve_group_compression(
                 r.compression for r in requests.values()),
             schedule=self.resolve_group_schedule(
                 getattr(r, "schedule", "auto")
-                for r in requests.values()))
+                for r in requests.values()),
+            group=gid, group_ranks=granks)
 
     # ------------------------------------------------------------- validation
     @staticmethod
@@ -521,8 +583,13 @@ class PythonController:
         """Validate cross-rank agreement and build a GroupEntry, or
         error every handle."""
         requests = entry.requests
+        granks = getattr(entry, "group_ranks", None)
         message = self.validate_requests(
-            name, requests, size=self._size, joined=bool(self._joined_view))
+            name, requests,
+            size=(len(granks) if getattr(entry, "group", "") else
+                  self._size),
+            joined=bool(self._joined_view)
+            and not getattr(entry, "group", ""))
         if message is not None:
             for request in requests.values():
                 request.handle.set_error(message)
@@ -532,7 +599,8 @@ class PythonController:
     # ----------------------------------------------------------------- fusion
     @staticmethod
     def allreduce_bucket_key(dtype, op, prescale, postscale,
-                             compression="none", schedule="auto"):
+                             compression="none", schedule="auto",
+                             group=""):
         """Bucket-compatibility key shared with the gmesh coordinator
         (reference: FuseResponses fuses dtype/op/scale-homogeneous runs).
         Compression is part of the key: a compressed and an uncompressed
@@ -540,9 +608,12 @@ class PythonController:
         wire formats and different numerics.  The collective schedule
         likewise: requests negotiated for different schedules must never
         fuse into one bucket (a hierarchical and a flat-ring tensor take
-        different data paths with different round structures)."""
+        different data paths with different round structures).  The
+        process-group id completes the never-fuse rules: requests from
+        different groups reduce over different rank sets and must never
+        share a program (docs/groups.md)."""
         return (np.dtype(dtype).name, int(op), prescale, postscale,
-                compression, schedule)
+                compression, schedule, group)
 
     def _dispatch(self, responses):
         """Fuse compatible allreduces into <= fusion_threshold buckets
@@ -563,7 +634,8 @@ class PythonController:
             return self.allreduce_bucket_key(
                 group.dtype, group.op, group.prescale_factor,
                 group.postscale_factor, group.compression,
-                getattr(group, "schedule", "auto"))
+                getattr(group, "schedule", "auto"),
+                getattr(group, "group", ""))
 
         def nbytes(item):
             _, group = item
@@ -582,10 +654,21 @@ class PythonController:
                 safe(lambda req_type=req_type, g=groups[0]:
                      self._execute_single(req_type, g), groups)
 
+    def _exec_for(self, group_entry):
+        """Executor for one response: the shared world executor, or —
+        for a process-group entry — the memoized sub-executor over the
+        group's device subset (XLA plane: per-(group, signature)
+        program caches come for free from the sub-executor's own
+        per-signature caches, docs/groups.md)."""
+        granks = getattr(group_entry, "group_ranks", None)
+        if getattr(group_entry, "group", "") and granks:
+            return self._executor.subset(tuple(granks))
+        return self._executor
+
     def _execute_allreduce_bucket(self, groups):
         first = groups[0]
         self._timeline_begin_groups(groups, "ALLREDUCE")
-        self._executor.allreduce_fused(
+        self._exec_for(first).allreduce_fused(
             groups, op=first.op,
             prescale_factor=first.prescale_factor,
             postscale_factor=first.postscale_factor,
@@ -594,16 +677,17 @@ class PythonController:
 
     def _execute_single(self, req_type, group):
         self._timeline_begin_groups([group], req_type.name)
+        executor = self._exec_for(group)
         if req_type == RequestType.ALLGATHER:
-            self._executor.allgather(group)
+            executor.allgather(group)
         elif req_type == RequestType.BROADCAST:
-            self._executor.broadcast(group)
+            executor.broadcast(group)
         elif req_type == RequestType.ALLTOALL:
-            self._executor.alltoall(group)
+            executor.alltoall(group)
         elif req_type == RequestType.ADASUM:
-            self._executor.adasum(group)
+            executor.adasum(group)
         elif req_type == RequestType.REDUCE_SCATTER:
-            self._executor.reduce_scatter(group)
+            executor.reduce_scatter(group)
         self._timeline_end_groups([group])
 
     def _timeline_begin_groups(self, groups, phase):
@@ -619,12 +703,15 @@ class PythonController:
         now = time.monotonic()
         warn_after = self._config.stall_warning_seconds
         shutdown_after = self._config.stall_shutdown_seconds
-        for name, entry in list(self._table.items()):
+        for key, entry in list(self._table.items()):
+            _, name = key
+            expected = (set(entry.group_ranks) if entry.group
+                        else set(range(self._size)))
             age = now - entry.first_ts
             if age > warn_after and not entry.stall_warned:
                 ready = sorted(entry.requests.keys())
-                missing = sorted(set(range(self._size))
-                                 - set(ready) - self._joined_view)
+                missing = sorted(expected - set(ready)
+                                 - self._joined_view)
                 self._log.warning(
                     "One or more tensors were submitted to be reduced, "
                     "gathered or broadcasted by subset of ranks and are "
@@ -633,12 +720,14 @@ class PythonController:
                     int(warn_after), name, ready, missing)
                 entry.stall_warned = True
                 # reference: stall_inspector.cc InvalidateStalledCachedTensors
-                self._sig_cache.evict(name)
+                self._sig_cache.evict(self._cache_key(key))
             if shutdown_after > 0 and age > shutdown_after:
                 # promoted from a log line into a coordinated abort: one
                 # typed error on every rank, naming the first lagging
-                # rank as the origin
-                missing = sorted(set(range(self._size))
+                # rank as the origin — group-scoped entries stamp the
+                # lagging GROUP member, and the abort still fails the
+                # whole job (docs/groups.md: no half-dead jobs)
+                missing = sorted(expected
                                  - set(entry.requests.keys())
                                  - self._joined_view)
                 origin = missing[0] if missing else -1

@@ -76,8 +76,14 @@ class CollectiveMsg:
     def __init__(self, name, rank, req_type, op, payload, shape, dtype,
                  root_rank=-1, splits=None, prescale=1.0, postscale=1.0,
                  ring=False, sig=None, compression="none", epoch=0,
-                 schedule="auto"):
+                 schedule="auto", group="", group_ranks=None):
         self.name = name
+        # process-group scoping (docs/groups.md): "" = the world.  The
+        # member list rides the message so the coordinator never needs
+        # this worker's group registry — negotiation state is keyed
+        # (group, name) and readiness counts exactly these ranks.
+        self.group = group
+        self.group_ranks = tuple(group_ranks) if group_ranks else None
         self.epoch = epoch              # sender's membership epoch
         self.rank = rank
         self.req_type = int(req_type)
@@ -196,7 +202,12 @@ def _signature(msg) -> bytes:
              msg.root_rank, tuple(msg.splits or ()), msg.prescale,
              msg.postscale, bool(msg.ring),
              getattr(msg, "compression", "none"),
-             getattr(msg, "schedule", "auto"))
+             getattr(msg, "schedule", "auto"),
+             # group id + membership join the signature (docs/groups.md:
+             # the same tensor name in two groups must never validate —
+             # or cache — against the other's round)
+             getattr(msg, "group", ""),
+             tuple(getattr(msg, "group_ranks", None) or ()))
     return hashlib.sha1(repr(parts).encode()).digest()
 
 
@@ -205,13 +216,20 @@ class _Entry:
     """One named collective being negotiated (reference: the coordinator's
     message table, controller.cc:62)."""
 
-    def __init__(self, req_type):
+    def __init__(self, req_type, group="", group_ranks=None):
         self.req_type = req_type
+        self.group = group              # "" = world (docs/groups.md)
+        self.group_ranks = group_ranks  # tuple | None
         self.requests = {}   # rank -> CollectiveMsg
         self.results = {}    # rank -> ResultMsg
         self.done = threading.Event()
         self.first_ts = time.monotonic()
         self.stall_warned = False
+
+    def expected_ranks(self, size):
+        """The ranks whose contribution completes this entry: the
+        group's members, or the full world."""
+        return (self.group_ranks if self.group else range(size))
 
 
 class CoordinatorService(network.MuxService):
@@ -558,7 +576,11 @@ class CoordinatorService(network.MuxService):
     def _ready(self, entry):  # holds: self._cv
         """Ready once every live (non-joined) rank has contributed — a
         raw count would let a since-joined rank's own request stand in
-        for a live rank's missing one (silent wrong result)."""
+        for a live rank's missing one (silent wrong result).  A grouped
+        entry waits for exactly its members: joins are a world-level
+        protocol, so they never stand in for a group rank."""
+        if entry.group:
+            return set(entry.group_ranks) <= entry.requests.keys()
         live = set(range(self._size)) - self._joined
         return live <= entry.requests.keys()
 
@@ -570,21 +592,32 @@ class CoordinatorService(network.MuxService):
                 f"stale membership epoch {getattr(req, 'epoch', 0)} for "
                 f"tensor '{req.name}' (coordinator is at epoch "
                 f"{self._epoch})"))
+        # (group, name) is THE negotiation key: the same tensor name in
+        # two groups forms two independent entries that can be in
+        # flight concurrently (docs/groups.md)
+        key = (getattr(req, "group", ""), req.name)
         with self._cv:
             if self._abort is not None:
                 return self._abort_result()
-            entry = self._forming.get(req.name)
+            entry = self._forming.get(key)
             if entry is None:
-                entry = _Entry(req.req_type)
-                self._forming[req.name] = entry
+                entry = _Entry(req.req_type, group=key[0],
+                               group_ranks=getattr(req, "group_ranks",
+                                                   None))
+                self._forming[key] = entry
             if req.rank in entry.requests:
                 return ResultMsg(error=(
                     f"duplicate request for tensor '{req.name}' from rank "
                     f"{req.rank} before previous one completed"))
             entry.requests[req.rank] = req
+            gids = {g for (g, _) in self._forming}
             if self._ready(entry):
-                self._complete(req.name, entry)
+                self._complete(key, entry)
                 self._check_join_barrier()
+        # concurrency gauge (read by the acceptance tests): distinct
+        # groups simultaneously negotiating at this coordinator
+        from horovod_tpu import groups as groups_mod
+        groups_mod.note_inflight(gids)
         # Wait outside negotiation state; requests run on their own mux
         # threads, so blocking here is the reference's "wait for the
         # response list" on this rank.
@@ -597,8 +630,8 @@ class CoordinatorService(network.MuxService):
                 # drop the orphaned entry so it can't pin the join
                 # barrier)
                 with self._cv:
-                    if self._forming.get(req.name) is entry:
-                        del self._forming[req.name]
+                    if self._forming.get(key) is entry:
+                        del self._forming[key]
                 return self._abort_result()
             age = time.monotonic() - entry.first_ts
             # hvd-race: ok[racy fast-path check only; warn-once is
@@ -607,13 +640,13 @@ class CoordinatorService(network.MuxService):
                 with self._cv:
                     already, entry.stall_warned = entry.stall_warned, \
                         True
-                    missing = [r for r in range(self._size)
+                    missing = [r for r in entry.expected_ranks(self._size)
                                if r not in entry.requests
                                and r not in self._joined]
                     ready = sorted(entry.requests)
                     if not already:
                         # reference: InvalidateStalledCachedTensors
-                        self._sig_cache.evict(req.name)
+                        self._sig_cache.evict(self._cache_name(key))
                 if not already:
                     self._log.warning(
                         "Stalled tensor: %s ready ranks: %s, waiting "
@@ -625,7 +658,7 @@ class CoordinatorService(network.MuxService):
                 # this entry's waiters) raises the same typed error, and
                 # ring state everywhere is purged via the abort broadcast
                 with self._cv:
-                    missing = [r for r in range(self._size)
+                    missing = [r for r in entry.expected_ranks(self._size)
                                if r not in entry.requests
                                and r not in self._joined]
                 origin = missing[0] if missing else req.rank
@@ -652,9 +685,9 @@ class CoordinatorService(network.MuxService):
             self._joined.add(req.rank)
             self._join_waiters.append((req.rank, event, slot))
             # a rank joining may complete entries now only missing it
-            for name, entry in list(self._forming.items()):
+            for key, entry in list(self._forming.items()):
                 if entry.requests and self._ready(entry):
-                    self._complete(name, entry)
+                    self._complete(key, entry)
             self._check_join_barrier()
         # wakeable: _initiate_abort and _check_join_barrier both set
         # every registered join-waiter event (tested by test_stall's
@@ -679,13 +712,14 @@ class CoordinatorService(network.MuxService):
             self._joined.clear()
 
     # ------------------------------------------------------------- execution
-    def _complete(self, name, entry):  # holds: self._cv
+    def _complete(self, key, entry):  # holds: self._cv
         """Validate cross-rank agreement and compute every rank's result
-        (reference: ConstructResponse validation + the backend op)."""
-        del self._forming[name]
+        (reference: ConstructResponse validation + the backend op).
+        ``key`` is the (group, name) negotiation key."""
+        del self._forming[key]
         reqs = entry.requests
         try:
-            results = self._execute(name, entry)
+            results = self._execute(key, entry)
         except Exception as exc:  # noqa: BLE001 — done MUST be set: the
             # entry left _forming already, so an unset event would spin
             # every waiting rank forever with no stall escape
@@ -733,16 +767,24 @@ class CoordinatorService(network.MuxService):
     def cache_hits(self):
         return self._sig_cache.hits
 
-    def _cache_check(self, name, entry) -> bool:
+    @staticmethod
+    def _cache_name(key):
+        """Signature-cache key for a (group, name) entry: group-
+        qualified so the same tensor name in two groups can never hit
+        the other's cached validation (docs/groups.md)."""
+        group, name = key
+        return f"g:{group}:{name}" if group else name
+
+    def _cache_check(self, key, entry) -> bool:
         """Response-cache fast path (reference: response_cache.cc) — a
         steady-state name whose every rank resubmits the exact signature
         of the last validated round skips re-validation."""
         return self._sig_cache.check(
-            name, (r.sig for r in entry.requests.values()))
+            self._cache_name(key), (r.sig for r in entry.requests.values()))
 
-    def _cache_store(self, name, entry):
+    def _cache_store(self, key, entry):
         self._sig_cache.store(
-            name, (r.sig for r in entry.requests.values()))
+            self._cache_name(key), (r.sig for r in entry.requests.values()))
 
     def _ring_seg(self):
         """Coordinator-resolved pipeline segment size for a ring round:
@@ -833,11 +875,22 @@ class CoordinatorService(network.MuxService):
             sched = "flat_ring"
         return sched, groups
 
-    def _execute(self, name, entry):  # holds: self._cv
+    def _next_ring_id(self, group):  # holds: self._cv
+        """Coordinator-assigned id for one ring round.  Grouped rounds
+        live in a per-group namespace ("g<gid>:<seq>") so purge/straggler
+        drops at the peer mailbox stay group-scoped (docs/groups.md);
+        world rounds keep the bare integer for wire compatibility."""
+        self._ring_seq += 1
+        return f"g{group}:{self._ring_seq}" if group else self._ring_seq
+
+    def _execute(self, key, entry):  # holds: self._cv
+        _, name = key
         reqs = entry.requests
         first = next(iter(reqs.values()))
         rtype = RequestType(first.req_type)
-        cached = self._cache_check(name, entry)
+        cached = self._cache_check(key, entry)
+        # a grouped collective's "world" is its member list
+        gsize = len(entry.group_ranks) if entry.group else self._size
 
         if not cached:
             for r in reqs.values():
@@ -875,10 +928,10 @@ class CoordinatorService(network.MuxService):
                         raise ValueError(
                             f"mismatched reduce ops or scale factors for "
                             f"tensor '{first.name}'")
-                self._cache_store(name, entry)
+                self._cache_store(key, entry)
             if ring and rtype == RequestType.ALLREDUCE:
                 participants = sorted(reqs.keys())
-                self._ring_seq += 1
+                rid = self._next_ring_id(entry.group)
                 # coordinator-resolved wire format (same role as the
                 # ring-vs-payload resolution): unanimous choice wins,
                 # disagreement — e.g. tuned params applied at slightly
@@ -901,7 +954,7 @@ class CoordinatorService(network.MuxService):
                     reqs, participants, nbytes)
                 return {r: ResultMsg(ring_go=True,
                                      participants=participants,
-                                     ring_id=self._ring_seq,
+                                     ring_id=rid,
                                      compression=comp,
                                      ring_segment_bytes=self._ring_seg(),
                                      schedule=sched, groups=groups)
@@ -909,11 +962,14 @@ class CoordinatorService(network.MuxService):
             if ring and rtype == RequestType.ADASUM:
                 participants = sorted(reqs.keys())
                 p = len(participants)
-                if p == self._size and p & (p - 1) == 0:
-                    self._ring_seq += 1
+                # grouped adasum always rides the payload path: the
+                # distributed VHDD tree is laid out over world positions
+                if (not entry.group and p == self._size
+                        and p & (p - 1) == 0):
+                    rid = self._next_ring_id(entry.group)
                     return {r: ResultMsg(
                         ring_go=True, participants=participants,
-                        ring_id=self._ring_seq,
+                        ring_id=rid,
                         ring_segment_bytes=self._ring_seg())
                         for r in reqs}
                 # joined ranks (zero stand-ins at world tree positions)
@@ -925,9 +981,11 @@ class CoordinatorService(network.MuxService):
             # takes the branches above)
             arrs = {r: _decode(m) for r, m in reqs.items()}
             if rtype == RequestType.ADASUM:
-                out = self._adasum(arrs, first)
+                out = self._adasum(arrs, first,
+                                   ranks=entry.group_ranks
+                                   if entry.group else None)
             else:
-                out = self._allreduce(arrs, first)
+                out = self._allreduce(arrs, first, divisor=gsize)
             return {r: _encode(out) for r in reqs}
 
         if rtype == RequestType.REDUCE_SCATTER:
@@ -946,10 +1004,10 @@ class CoordinatorService(network.MuxService):
                         raise ValueError(
                             f"mismatched reduce ops or scale factors for "
                             f"tensor '{first.name}'")
-                self._cache_store(name, entry)
+                self._cache_store(key, entry)
             if ring:
                 participants = sorted(reqs.keys())
-                self._ring_seq += 1
+                rid = self._next_ring_id(entry.group)
                 from horovod_tpu.ops.python_controller import \
                     PythonController
 
@@ -958,7 +1016,7 @@ class CoordinatorService(network.MuxService):
                     for r in reqs.values())
                 return {r: ResultMsg(ring_go=True,
                                      participants=participants,
-                                     ring_id=self._ring_seq,
+                                     ring_id=rid,
                                      compression=comp,
                                      ring_segment_bytes=self._ring_seg())
                         for r in reqs}
@@ -966,7 +1024,7 @@ class CoordinatorService(network.MuxService):
             # rank float64/int64 sum), then hand each rank its row block
             # of the np.array_split partition
             arrs = {r: _decode(m) for r, m in reqs.items()}
-            out = self._allreduce(arrs, first)
+            out = self._allreduce(arrs, first, divisor=gsize)
             participants = sorted(reqs.keys())
             counts = reduce_scatter_split_sizes(first.shape[0],
                                                 len(participants))
@@ -991,10 +1049,10 @@ class CoordinatorService(network.MuxService):
             if ring:
                 participants = sorted(reqs.keys())
                 dims0 = [shapes[r][0] for r in participants]
-                self._ring_seq += 1
+                rid = self._next_ring_id(entry.group)
                 return {r: ResultMsg(ring_go=True,
                                      participants=participants,
-                                     dims0=dims0, ring_id=self._ring_seq,
+                                     dims0=dims0, ring_id=rid,
                                      ring_segment_bytes=self._ring_seg())
                         for r in reqs}
             out = np.concatenate(
@@ -1012,17 +1070,17 @@ class CoordinatorService(network.MuxService):
                         raise ValueError(
                             f"mismatched shapes for broadcast "
                             f"'{first.name}'")
-                self._cache_store(name, entry)
+                self._cache_store(key, entry)
             if first.root_rank not in reqs:
                 raise ValueError(
                     f"broadcast '{first.name}': root rank "
                     f"{first.root_rank} did not participate")
             if ring:
                 participants = sorted(reqs.keys())
-                self._ring_seq += 1
+                rid = self._next_ring_id(entry.group)
                 return {r: ResultMsg(ring_go=True,
                                      participants=participants,
-                                     ring_id=self._ring_seq,
+                                     ring_id=rid,
                                      ring_segment_bytes=self._ring_seg())
                         for r in reqs}
             out = _decode(reqs[first.root_rank])
@@ -1032,10 +1090,10 @@ class CoordinatorService(network.MuxService):
             pieces = {}
             offsets = {}
             for r, m in reqs.items():
-                if m.splits is None or len(m.splits) != self._size:
+                if m.splits is None or len(m.splits) != gsize:
                     raise ValueError(
                         f"alltoall '{first.name}': splits must have one "
-                        f"entry per rank ({self._size})")
+                        f"entry per rank ({gsize})")
                 if sum(m.splits) != (m.shape[0] if m.shape else 0):
                     raise ValueError(
                         f"alltoall '{first.name}': splits sum "
@@ -1048,10 +1106,15 @@ class CoordinatorService(network.MuxService):
                     pieces[(r, len(offsets[r]))] = arr[off:off + n]
                     offsets[r].append(n)
                     off += n
+            # splits rows are indexed by GROUP-LOCAL position for grouped
+            # entries (the member order the group was declared with); for
+            # the world the global rank is the index
+            order = list(entry.group_ranks) if entry.group else sorted(reqs)
             out = {}
             for dst in reqs:
-                parts = [pieces[(src, dst)] for src in sorted(reqs)]
-                recv_splits = [offsets[src][dst] for src in sorted(reqs)]
+                di = order.index(dst) if entry.group else dst
+                parts = [pieces[(src, di)] for src in order]
+                recv_splits = [offsets[src][di] for src in order]
                 res = _encode(np.concatenate(parts, axis=0))
                 res.recv_splits = recv_splits
                 out[dst] = res
@@ -1059,7 +1122,7 @@ class CoordinatorService(network.MuxService):
 
         raise ValueError(f"unknown request type {rtype}")
 
-    def _allreduce(self, arrs, first):
+    def _allreduce(self, arrs, first, divisor=None):
         acc = None
         for r in sorted(arrs):
             a = arrs[r].astype(np.float64) if is_float_dtype(
@@ -1068,18 +1131,22 @@ class CoordinatorService(network.MuxService):
                 a = a * first.prescale
             acc = a if acc is None else acc + a
         if ReduceOp(first.op) == ReduceOp.AVERAGE:
-            acc = acc / self._size
+            # the divisor is the collective's world: the process group's
+            # size for grouped entries, the full size otherwise (joined
+            # ranks still count — they contribute zeros by contract)
+            acc = acc / (divisor or self._size)
         if first.postscale != 1.0:
             acc = acc * first.postscale
         return acc.astype(np.dtype(first.dtype))
 
-    def _adasum(self, arrs, first):
+    def _adasum(self, arrs, first, ranks=None):
         from horovod_tpu.ops.adasum import adasum_reference
 
         # joined ranks contribute zero stand-ins, like the device-mode
-        # executor (zero norm -> plain addition)
+        # executor (zero norm -> plain addition); a grouped entry's tree
+        # spans exactly its member list
         tensors = []
-        for r in range(self._size):
+        for r in (ranks if ranks is not None else range(self._size)):
             if r in arrs:
                 tensors.append(arrs[r])
             else:
@@ -1116,6 +1183,13 @@ class TcpController:
         self._key = None
         self._peer_service = None
         self._ring = None
+        # per-group ring planes (docs/groups.md): each live group gets
+        # its own RingPlane (own send queue, sender thread and stripe
+        # connections) lazily on first grouped ring round, sharing the
+        # one PeerService mailbox — the concurrency lever that lets two
+        # groups' rounds be in flight at once; guarded by _rings_lock
+        self._rings = {}
+        self._rings_lock = threading.Lock()
         self._ring_threshold = env_util.get_int(
             env_util.HVD_TCP_RING_THRESHOLD, DEFAULT_RING_THRESHOLD)
         self._autotune = None       # rank 0 only
@@ -1655,6 +1729,25 @@ class TcpController:
             return
         self._spawn(self._run_one, request)
 
+    def _ring_for(self, group):
+        """The ring plane a round runs on: the world plane, or the
+        group's own lazily-built plane (same resolver + PeerService,
+        independent sender/stripes so concurrent groups never share a
+        send queue)."""
+        if not group:
+            return self._ring
+        with self._rings_lock:
+            plane = self._rings.get(group)
+            if plane is None:
+                plane = RingPlane(
+                    self._rank, self._peer_service, self._resolve_peer,
+                    resolve_bulk=self._resolve_stripe,
+                    segment_bytes=self._config.ring_segment_bytes,
+                    stripes=self._config.ring_stripes,
+                    epoch=self._epoch)
+                self._rings[group] = plane
+            return plane
+
     def _use_ring(self, req_type, nbytes):
         if self._ring is None or self._size <= 1:
             return False
@@ -1715,7 +1808,9 @@ class TcpController:
                 postscale=request.postscale_factor, ring=ring,
                 compression=getattr(request, "compression", "none"),
                 epoch=self._epoch,
-                schedule=getattr(self._config, "schedule", "auto"))
+                schedule=getattr(self._config, "schedule", "auto"),
+                group=getattr(request, "group", ""),
+                group_ranks=getattr(request, "group_ranks", None))
             msg.sig = _signature(msg)
             self._timeline.begin(request.name,
                                  f"NEGOTIATE_{rtype.name}")
@@ -1813,40 +1908,46 @@ class TcpController:
         # segment size so every participant runs the identical plan
         sched = getattr(resp, "schedule", None)
         groups = getattr(resp, "groups", None)
+        # grouped rounds run on the group's own plane; the effective
+        # world of an AVERAGE (and of split planning) is the group size
+        gid = getattr(request, "group", "")
+        plane = self._ring_for(gid)
+        wsize = (len(request.group_ranks)
+                 if gid and request.group_ranks else self._size)
         try:
             if rtype == RequestType.ALLREDUCE:
                 kwargs = dict(
                     op_average=(ReduceOp(request.op) == ReduceOp.AVERAGE),
-                    world_size=self._size,
+                    world_size=wsize,
                     prescale=request.prescale_factor,
                     postscale=request.postscale_factor, timeout=timeout,
                     compression=getattr(resp, "compression", "none"),
                     segment_bytes=seg)
                 if sched == "hierarchical" and groups:
-                    out = self._ring.allreduce_hierarchical(
+                    out = plane.allreduce_hierarchical(
                         resp.ring_id, arr, resp.participants, groups,
                         **kwargs)
                 elif sched == "rhd":
-                    out = self._ring.allreduce_rhd(
+                    out = plane.allreduce_rhd(
                         resp.ring_id, arr, resp.participants, **kwargs)
                 else:
-                    out = self._ring.allreduce(
+                    out = plane.allreduce(
                         resp.ring_id, arr, resp.participants, **kwargs)
             elif rtype == RequestType.REDUCE_SCATTER:
-                out = self._ring.reduce_scatter(
+                out = plane.reduce_scatter(
                     resp.ring_id, arr, resp.participants,
                     op_average=(ReduceOp(request.op) == ReduceOp.AVERAGE),
-                    world_size=self._size,
+                    world_size=wsize,
                     prescale=request.prescale_factor,
                     postscale=request.postscale_factor, timeout=timeout,
                     compression=getattr(resp, "compression", "none"),
                     segment_bytes=seg)
             elif rtype == RequestType.ADASUM:
-                out = self._ring.adasum(
+                out = plane.adasum(
                     resp.ring_id, arr, resp.participants, timeout=timeout,
                     segment_bytes=seg)
             elif rtype == RequestType.BROADCAST:
-                out = self._ring.broadcast(
+                out = plane.broadcast(
                     resp.ring_id,
                     arr if self._rank == request.root_rank else None,
                     resp.participants, request.root_rank,
@@ -1856,7 +1957,7 @@ class TcpController:
                 trailing = arr.shape[1:]
                 per_row = int(np.prod(trailing or (1,))) \
                     * arr.dtype.itemsize
-                blocks = self._ring.allgather(
+                blocks = plane.allgather(
                     resp.ring_id, arr, resp.participants,
                     block_nbytes=[d * per_row for d in resp.dims0],
                     timeout=timeout, segment_bytes=seg)
@@ -1956,19 +2057,37 @@ class TcpController:
             if "ring_segment_bytes" in params:
                 self._config.ring_segment_bytes = \
                     int(params["ring_segment_bytes"])
-                if self._ring is not None:
-                    self._ring.segment_bytes = \
+                for plane in self._all_ring_planes():
+                    plane.segment_bytes = \
                         int(params["ring_segment_bytes"])
             if "ring_stripes" in params:
                 self._config.ring_stripes = int(params["ring_stripes"])
-                if self._ring is not None:
-                    self._ring.stripes = int(params["ring_stripes"])
+                for plane in self._all_ring_planes():
+                    plane.stripes = int(params["ring_stripes"])
             if "schedule" in params:
                 # worker-side effect is the ring-vs-star choice in
                 # _use_ring; the per-round plan itself always comes
                 # stamped on the ring_go, so a transiently-stale value
                 # here can never desync a round
                 self._config.schedule = str(params["schedule"])
+
+    def _all_ring_planes(self):
+        """World plane + every live group plane (tuned-knob fan-out and
+        teardown walk the same list)."""
+        with self._rings_lock:
+            planes = list(self._rings.values())
+        if self._ring is not None:
+            planes.append(self._ring)
+        return planes
+
+    def _close_ring_planes(self):
+        with self._rings_lock:
+            planes, self._rings = list(self._rings.values()), {}
+        for plane in planes:
+            plane.close()
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
 
     def tuned_params(self):
         """Same surface as the native controller (reference:
@@ -1998,9 +2117,7 @@ class TcpController:
             mux, self._mux = self._mux, None
         if mux is not None:
             mux.close()
-        if self._ring is not None:
-            self._ring.close()
-            self._ring = None
+        self._close_ring_planes()
         if self._peer_service is not None:
             self._peer_service.shutdown()
             self._peer_service = None
@@ -2030,9 +2147,7 @@ class TcpController:
             mux, self._mux = self._mux, None
         if mux is not None:
             mux.close()
-        if self._ring is not None:
-            self._ring.close()
-            self._ring = None
+        self._close_ring_planes()
         if self._peer_service is not None:
             self._peer_service.shutdown()
             self._peer_service = None

@@ -354,6 +354,26 @@ class PeerService(network.MuxService):
             for key in self._by_ring.pop(ring_id, ()):
                 self._mailbox.pop(key, None)
 
+    def purge_group(self, group):
+        """Group-aware purge (docs/groups.md): drop every buffered round
+        of process group ``group``.  Grouped ring ids live in a per-group
+        namespace ("g<gid>:<seq>"), so the group's rounds — and only the
+        group's rounds — are identifiable here without consulting any
+        registry.  Used when a group's rounds must all die together
+        (e.g. a reform at a new membership epoch) while other groups'
+        in-flight rounds keep their mailbox state."""
+        prefix = f"g{group}:"
+        with self._cv:
+            for ring_id in [rid for rid in self._by_ring
+                            if isinstance(rid, str)
+                            and rid.startswith(prefix)]:
+                self._purged[ring_id] = None
+                self._purged.move_to_end(ring_id)
+                for key in self._by_ring.pop(ring_id, ()):
+                    self._mailbox.pop(key, None)
+            while len(self._purged) > self._PURGED_KEEP:
+                self._purged.popitem(last=False)
+
     def abort(self, origin_rank, reason):
         """Coordinated abort observed: fail every blocked ``recv`` with
         the typed error, drop all buffered chunks and refuse new ones —

@@ -94,6 +94,10 @@ class XlaExecutor:
         self._allgather_cache = {}
         self._alltoall_cache = {}
         self._reduce_scatter_cache = {}
+        # process-group sub-executors, memoized per rank tuple
+        # (docs/groups.md): each carries its own caches, so per-signature
+        # programs are effectively keyed (group, signature)
+        self._subsets = {}
 
         # Two-level (cross, local) mesh for hierarchical collectives
         # (reference: NCCLHierarchicalAllreduce intra-node/inter-node split,
@@ -146,6 +150,18 @@ class XlaExecutor:
         """Return ``(mesh, axis_name)`` — the 1-D rank mesh and the name of
         its rank-enumerating axis.  Subclass hook."""
         return Mesh(np.array(devices), (AXIS,)), AXIS
+
+    def subset(self, ranks):
+        """The sub-executor over ``ranks``'s devices (memoized).  Ranks are
+        GLOBAL; inside the returned executor they renumber to 0..k-1 in
+        the given order, which is how grouped entries are re-keyed before
+        execution (python_controller._build_group)."""
+        key = tuple(int(r) for r in ranks)
+        sub = self._subsets.get(key)
+        if sub is None:
+            sub = type(self)([self.devices[r] for r in key])
+            self._subsets[key] = sub
+        return sub
 
     def commit(self, tensor, rank):
         """Pin a rank's tensor to its device (no-op if already there)."""

@@ -83,6 +83,30 @@ def data_parallel_mesh(devices=None) -> Mesh:
     return make_mesh({MeshAxes.DP: -1}, devices=devices)
 
 
+def grid_mesh(grid, devices=None) -> Mesh:
+    """Device mesh matching a process-group :class:`~horovod_tpu.groups
+    .Grid` (docs/groups.md): the SAME axis order and C-order layout
+    ``hvd.grid()`` used to partition ranks, so ``grid.group(axis)`` and
+    this mesh's axis of the same name always name the same devices —
+    eager group collectives and in-graph GSPMD sharding agree on one
+    topology."""
+    return make_mesh(grid.mesh_axes(), devices=devices)
+
+
+def as_mesh(mesh_or_grid, devices=None) -> Mesh:
+    """Resolve a ``mesh=`` argument that may be a ``jax`` Mesh OR a
+    process-group Grid — the hook that lets every parallel module take
+    the grid handle directly instead of separate mesh + axis-name
+    plumbing (docs/groups.md)."""
+    if isinstance(mesh_or_grid, Mesh):
+        return mesh_or_grid
+    if hasattr(mesh_or_grid, "mesh_axes"):
+        return grid_mesh(mesh_or_grid, devices=devices)
+    raise TypeError(
+        f"expected a jax.sharding.Mesh or hvd.grid(...) Grid, got "
+        f"{type(mesh_or_grid).__name__}")
+
+
 def shard_global_batch(local_batch, mesh=None, axis=MeshAxes.HVD):
     """Assemble a global, mesh-sharded batch from this process's local
     rows.

@@ -79,8 +79,17 @@ def pipelined(stage_fn, mesh, *, axis_name="pp", stage_param_specs=None,
     Returns ``fn(stacked_params, microbatches)`` where ``stacked_params``
     arrays have a leading stage dimension of size = axis size, and
     ``microbatches`` is ``[M, mb, ...]``.
+
+    ``mesh`` may be a ``jax`` Mesh or an ``hvd.grid(...)`` Grid
+    (docs/groups.md): the grid resolves to the device mesh with the
+    same C-order layout, so the ``pp`` stage sequence matches the
+    grid's ``pp`` process groups.
     """
     from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel.mesh import as_mesh
+
+    mesh = as_mesh(mesh)
 
     if stage_param_specs is None:
         stage_param_specs = P(axis_name)

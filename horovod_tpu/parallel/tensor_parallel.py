@@ -26,6 +26,8 @@ import re
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from horovod_tpu.parallel.mesh import as_mesh
+
 
 def transformer_sharding_rules(tp_axis="tp", fsdp_axis=None):
     """[(path_regex, PartitionSpec)] for GPT-style parameter trees.
@@ -86,7 +88,13 @@ def _fit_spec(spec, ndim):
 
 
 def params_shardings(params, mesh, rules=None):
-    """Pytree of NamedShardings matching ``params`` via the rule table."""
+    """Pytree of NamedShardings matching ``params`` via the rule table.
+
+    ``mesh`` may be a ``jax`` Mesh or an ``hvd.grid(...)`` Grid
+    (docs/groups.md) — the grid resolves to the device mesh with the
+    same axis names and C-order rank layout, so its ``tp`` group and
+    the ``tp`` sharding axis name the same devices."""
+    mesh = as_mesh(mesh)
     if rules is None:
         rules = transformer_sharding_rules()
     mesh_axes = set(mesh.axis_names)
@@ -110,7 +118,9 @@ def constrain(x, mesh, *spec):
     """Activation sharding constraint (a true no-op if the mesh lacks
     every requested axis — mapping absent axes to None would impose a
     full-replication constraint, overriding GSPMD's propagated sharding
-    and forcing an all-gather of e.g. batch-sharded MoE activations)."""
+    and forcing an all-gather of e.g. batch-sharded MoE activations).
+    ``mesh`` may be a Mesh or a Grid, as everywhere in this module."""
+    mesh = as_mesh(mesh)
     mesh_axes = set(mesh.axis_names)
     parts = tuple(a if (a is None or a in mesh_axes) else None for a in spec)
     if not any(p is not None for p in parts):

@@ -5,10 +5,10 @@ docs/fault_tolerance.md) from a fixed seed.  The replay contract is the
 whole point: same seed -> same spec -> same failure step, so a failing
 soak run is replayed exactly.  That contract extends ACROSS versions —
 every new draw (the elastic ``preempt`` cell, the degraded-network
-cells) is taken from the RNG stream strictly AFTER all pre-existing
-draws, so a seed that produced a given spec in an older tree produces a
-byte-identical spec today unless the new feature is explicitly
-requested.
+cells, the coordinator-kill cell, the group-collective cell) is taken
+from the RNG stream strictly AFTER all pre-existing draws, so a seed
+that produced a given spec in an older tree produces a byte-identical
+spec today unless the new feature is explicitly requested.
 """
 
 import random
@@ -35,7 +35,7 @@ _FLAKY_P = (0.05, 0.2)
 
 
 def generate_spec(seed, num_ranks, num_faults, elastic=False,
-                  degrade=0, coord_failover=False):
+                  degrade=0, coord_failover=False, groups=False):
     rng = random.Random(seed)
     specs = []
     for _ in range(num_faults):
@@ -86,4 +86,21 @@ def generate_spec(seed, num_ranks, num_faults, elastic=False,
         action = rng.choice(("crash", "preempt"))
         step = rng.randint(2, 5)   # after warmup: epoch-0 world forms
         specs.append(f"rank0:{point}:{step}:{action}")
+    # group-collective cell (--groups): one fault landing inside a
+    # sub-group collective of a job that runs process groups
+    # (docs/groups.md) — sub-group collectives flow through the same
+    # instrumented points (the submit path and the group's own ring
+    # plane), so the grammar is unchanged; what the cell tests is the
+    # group-scoped abort/purge path.  Its draws come strictly AFTER
+    # every pre-existing draw (binary, degrade, coord-failover), the
+    # same cross-version replay contract: a seed's spec without
+    # --groups is byte-identical to every older tree.  Rank 0 stays
+    # out of the pool for the same reason as the degrade cells —
+    # killing the coordinator turns the cell into a different test.
+    if groups:
+        point = rng.choice(("allreduce", "ring"))
+        action = rng.choice(("crash", "drop"))
+        rank = rng.randrange(1, num_ranks) if num_ranks > 1 else 0
+        step = rng.randint(2, 5)   # after warmup: groups have formed
+        specs.append(f"rank{rank}:{point}:{step}:{action}")
     return ",".join(specs)

@@ -83,6 +83,7 @@ _PARAMS = {
     "zero": (env_util.HVD_TPU_ZERO, "sharding.zero"),
     "zero_min_size": (env_util.HVD_TPU_ZERO_MIN_SIZE, "sharding.zero_min_size"),
     "executor": (env_util.HVD_TPU_EXECUTOR, "sharding.executor"),
+    "group_max": (env_util.HVD_TPU_GROUP_MAX, "groups.max"),
     "race": (env_util.HVD_TPU_RACE, "race.enabled"),
     "race_seed": (env_util.HVD_TPU_RACE_SEED, "race.seed"),
     "race_scope": (env_util.HVD_TPU_RACE_SCOPE, "race.scope"),

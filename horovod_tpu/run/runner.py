@@ -160,6 +160,13 @@ def make_parser():
                             "(parallel.mesh axis vocabulary) so tensor/"
                             "pipeline parallel layers can compose on the "
                             "same mesh.")
+    shard.add_argument("--group-max", type=int, default=None,
+                       help="Cap on live process groups per job "
+                            "(HVD_TPU_GROUP_MAX, default 64): each "
+                            "hvd.new_group()/hvd.grid() group owns "
+                            "negotiation state, signature caches and a "
+                            "tcp ring plane, so an unbounded registry "
+                            "is a leak — see docs/groups.md.")
 
     auto = parser.add_argument_group("autotune")
     auto.add_argument("--autotune", action="store_true", default=None)

@@ -169,7 +169,7 @@ def sharded_state_unwrap(state):
 
 # --------------------------------------------------------------- eager binding
 def ZeroDistributedOptimizer(optimizer, op=Average, compression=None,
-                             min_size=None):
+                             min_size=None, group=None):
     """Eager ZeRO-1: the named-collective binding of the sharded update.
 
     Wraps an optax optimizer so that ``update`` reduce-scatters the
@@ -192,6 +192,12 @@ def ZeroDistributedOptimizer(optimizer, op=Average, compression=None,
     on the block; :func:`gather_zero_state` / :func:`reshard_zero_state`
     convert it to/from the full-size form for checkpointing and elastic
     reconfiguration.
+
+    ``group`` scopes the whole decomposition to a
+    :class:`~horovod_tpu.groups.ProcessGroup` — the DATA-PARALLEL group
+    of a DP x TP x PP grid (docs/groups.md): the shard layout, the
+    gradient reduce-scatter and the parameter allgather all run over
+    the group's members, concurrently with other groups' collectives.
     """
     op_ = ReduceOp(op)
     if op_ == Adasum:
@@ -203,6 +209,11 @@ def ZeroDistributedOptimizer(optimizer, op=Average, compression=None,
     def _topology():
         from horovod_tpu.common import basics
 
+        if group is not None:
+            # group-local view: the shard partition lives over the DP
+            # group's members, re-read per call so an elastic re-form
+            # is picked up (or fails typed) at the next step
+            return group.rank(), group.size
         return basics.rank(), basics.size()
 
     def _sharded(n_params, world):
@@ -231,7 +242,7 @@ def ZeroDistributedOptimizer(optimizer, op=Average, compression=None,
             if world > 1:
                 reduced = eager.allreduce(
                     flat_g, op=op_, name="zero.allreduce",
-                    compression=comp)
+                    compression=comp, group=group)
             flat_p = None
             if params is not None:
                 flat_p, _ = ravel_pytree(params)
@@ -240,7 +251,8 @@ def ZeroDistributedOptimizer(optimizer, op=Average, compression=None,
 
         _, off, cnt = zero_shard_layout(flat_g.size, world, rank)
         g_block = eager.reduce_scatter(
-            flat_g, op=op_, name="zero.reduce_scatter", compression=comp)
+            flat_g, op=op_, name="zero.reduce_scatter", compression=comp,
+            group=group)
         p_block = None
         if params is not None:
             flat_p, _ = ravel_pytree(params)
@@ -248,7 +260,8 @@ def ZeroDistributedOptimizer(optimizer, op=Average, compression=None,
         upd_block, new_state = optimizer.update(g_block, state, p_block)
         # variable-dim0 allgather: blocks differ by one row when
         # world_size does not divide the parameter count
-        full = eager.allgather(upd_block, name="zero.allgather")
+        full = eager.allgather(upd_block, name="zero.allgather",
+                               group=group)
         return unravel(full), new_state
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -268,7 +281,8 @@ def flat_shard(flat, world_size, rank):
     return np.asarray(flat)[off:off + cnt]
 
 
-def gather_zero_state(state, n_params, name_prefix="zero.state_gather"):
+def gather_zero_state(state, n_params, name_prefix="zero.state_gather",
+                      group=None):
     """Assemble the FULL optimizer state from every rank's block.
 
     Tree-maps the eager-ZeRO state: a 1-D leaf whose length equals this
@@ -282,7 +296,7 @@ def gather_zero_state(state, n_params, name_prefix="zero.state_gather"):
     from horovod_tpu.common import basics
     from horovod_tpu.ops import eager
 
-    rank, world = _topology_of(basics)
+    rank, world = _topology_of(basics, group)
     if world <= 1:
         return state
     _, _, cnt = zero_shard_layout(int(n_params), world, rank)
@@ -292,20 +306,21 @@ def gather_zero_state(state, n_params, name_prefix="zero.state_gather"):
     for i, leaf in enumerate(leaves):
         arr = jax.numpy.asarray(leaf)
         if arr.ndim == 1 and arr.shape[0] == cnt and cnt != int(n_params):
-            out.append(eager.allgather(arr, name=f"{name_prefix}.{i}"))
+            out.append(eager.allgather(arr, name=f"{name_prefix}.{i}",
+                                       group=group))
         else:
             out.append(leaf)
     return jax.tree.unflatten(treedef, out)
 
 
-def reshard_zero_state(full_state, n_params):
+def reshard_zero_state(full_state, n_params, group=None):
     """Inverse of :func:`gather_zero_state` at the CURRENT topology:
     slice every full-size 1-D leaf down to this rank's block.  Called
     after elastic reconfiguration (possibly at a different world size
     than the state was gathered at) and after checkpoint restore."""
     from horovod_tpu.common import basics
 
-    rank, world = _topology_of(basics)
+    rank, world = _topology_of(basics, group)
     if world <= 1:
         return full_state
     n_params = int(n_params)
@@ -320,5 +335,7 @@ def reshard_zero_state(full_state, n_params):
     return jax.tree.map(reshard, full_state)
 
 
-def _topology_of(basics):
+def _topology_of(basics, group=None):
+    if group is not None:
+        return group.rank(), group.size
     return basics.rank(), basics.size()

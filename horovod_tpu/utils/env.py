@@ -63,6 +63,12 @@ HVD_TCP_RING_THRESHOLD = "HVD_TCP_RING_THRESHOLD"
 # (docs/tuning.md)
 HVD_TPU_SCHEDULE = "HVD_TPU_SCHEDULE"
 
+# --- process groups (docs/groups.md) -----------------------------------------
+# cap on live process groups per job: each group owns negotiation
+# state, signature caches and (tcp) a ring plane, so an unbounded
+# registry is a leak — new_group past the cap raises
+HVD_TPU_GROUP_MAX = "HVD_TPU_GROUP_MAX"
+
 # --- ZeRO sharding + executor selection (docs/sharding.md) -------------------
 # shard the weight update ZeRO-1 style: reduce-scatter gradients, run
 # the optimizer on this rank's 1/N shard, allgather updated params
@@ -223,6 +229,7 @@ DEFAULT_MIN_RANKS = 1
 DEFAULT_MAX_RANKS = 0  # unlimited
 DEFAULT_ELECTION_TIMEOUT_SECONDS = 10.0
 DEFAULT_ZERO_MIN_SIZE = 1024  # flat params below this stay replicated
+DEFAULT_GROUP_MAX = 64  # live process groups per job
 DEFAULT_TERM_GRACE_SECONDS = 5.0
 DEFAULT_CKPT_INTERVAL_STEPS = 10
 DEFAULT_CKPT_KEEP = 2

@@ -257,7 +257,8 @@ def test_cross_world_restore_reassembles_four_shards(tmp_path,
     # restore at world 1 (reshard is a passthrough there): the restored
     # live state must equal the original full vectors bit-for-bit
     from horovod_tpu.sharding import zero as zero_mod
-    monkeypatch.setattr(zero_mod, "_topology_of", lambda basics: (0, 1))
+    monkeypatch.setattr(zero_mod, "_topology_of",
+                        lambda basics, group=None: (0, 1))
     fresh = State(params={"w": np.zeros(n, np.float32)},
                   optimizer_state={"count": np.float32(0),
                                    "m": np.zeros(n, np.float32)},

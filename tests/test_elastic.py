@@ -440,6 +440,115 @@ def test_elastic_off_matrix_cells_keep_culprit(action, origin):
             f"rank {r}: {out}\n{err}"
 
 
+GROUP_ELASTIC_WORKER = r"""
+import hashlib, os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+wid = int(os.environ["HVD_RANK"])
+steps = int(os.environ.get("EL_STEPS", "6"))
+hvd.init()
+
+# groups are created ONCE, before any failure: the registry records
+# worker ids, so the reconfiguration re-forms them — or fails them
+# typed — without any re-creation by the user
+g01 = hvd.new_group([0, 1], name="el.g01")
+g_dead = hvd.new_group([2, 3], name="el.gdead") if hvd.size() >= 4 \
+    else None
+checked = {"reform": False}
+
+state = hvd.elastic.State(
+    params={"w": jnp.zeros((1000,), dtype=jnp.float32),
+            "v": jnp.zeros((500,), dtype=jnp.float32)}, step=0)
+
+def train(state):
+    while state.step < steps:
+        if g_dead is not None and hvd.size() == 3 \
+                and not checked["reform"]:
+            # epoch N+1: every group was re-formed from worker ids —
+            # the survivors' group lives on the SAME workers at their
+            # new ranks, the dead worker's group is typed-unsatisfiable
+            assert g01.ranks == [0, 1], g01.ranks
+            try:
+                g_dead.ranks
+                raise SystemExit("g_dead must be unsatisfiable")
+            except hvd.GroupUnsatisfiableError:
+                pass
+            checked["reform"] = True
+            print(f"wid {wid} GROUPS_REFORMED_OK", flush=True)
+        grad = jnp.full((1000,), float(state.step + 1),
+                        dtype=jnp.float32)
+        avg = hvd.allreduce(grad, op=hvd.Average,
+                            name=f"elastic.grad.{state.step}")
+        # the sub-group computes, the world consumes: members reduce
+        # inside g01, then rank 0 (a member in every epoch) broadcasts
+        # the group's result so v stays replicated — the state resync
+        # at a reconfiguration requires rank-identical state
+        if hvd.rank() in g01:
+            gavg = hvd.allreduce(
+                jnp.full((500,), float(state.step + 2),
+                         dtype=jnp.float32),
+                op=hvd.Average, name=f"elastic.g.{state.step}",
+                group=g01)
+        else:
+            gavg = jnp.zeros((500,), dtype=jnp.float32)
+        gavg = hvd.broadcast(gavg, root_rank=0,
+                             name=f"elastic.gb.{state.step}")
+        state.params = {"w": state.params["w"] - avg,
+                        "v": state.params["v"] - gavg}
+        state.step += 1
+        state.commit()
+
+try:
+    hvd.elastic.run(train, state)
+except hvd.HvdAbortedError as exc:
+    print(f"rank {hvd.rank()} wid {wid} ABORTED "
+          f"origin={exc.origin_rank}", flush=True)
+    print(f"rank {hvd.rank()} wid {wid} DONE", flush=True)
+    raise SystemExit(0)
+digest = hashlib.sha1(
+    np.asarray(state.params["w"]).tobytes()
+    + np.asarray(state.params["v"]).tobytes()).hexdigest()
+final_rank, final_size = hvd.rank(), hvd.size()
+print(f"rank {final_rank} wid {wid} DIGEST={digest} "
+      f"size={final_size} steps={state.step}", flush=True)
+hvd.shutdown()
+print(f"rank {final_rank} wid {wid} DONE", flush=True)
+"""
+
+
+def test_elastic_rank_loss_reforms_groups_and_converges_digest_identical():
+    """Sub-group x elastic acceptance (docs/groups.md): a 4-rank job
+    with a live sub-group [0,1] and a doomed sub-group [2,3] loses
+    rank 2 mid-training.  At epoch N+1 every group is re-formed as a
+    pure function of (spec, survivors): [0,1] carries on across the
+    reconfiguration on the same workers, [2,3] raises the typed
+    GroupUnsatisfiableError, and training finishes with a digest
+    IDENTICAL to an uninterrupted 3-rank run making the same world +
+    group updates."""
+    elastic = spawn_tcp_ranks(4, GROUP_ELASTIC_WORKER, timeout=150,
+                              extra_env={
+        **_EL_ENV,
+        "HVD_TPU_ELASTIC": "1",
+        "HVD_TPU_FAULT_SPEC": "rank2:allreduce:3:crash",
+    })
+    assert elastic[2][0] == 1, f"injected crash: {elastic[2][1]}"
+    got = _digests(elastic, ranks=[0, 1, 3])
+    for r, (digest, size, steps) in got.items():
+        assert size == 3, f"rank {r} finished at world size {size}"
+        assert steps == 6
+        assert "GROUPS_REFORMED_OK" in elastic[r][1], elastic[r][1]
+    assert len({d for d, _, _ in got.values()}) == 1, got
+
+    uninterrupted = spawn_tcp_ranks(3, GROUP_ELASTIC_WORKER, timeout=150,
+                                    extra_env=_EL_ENV)
+    want = _digests(uninterrupted, ranks=[0, 1, 2])
+    assert got[0][0] == want[0][0], (got, want)
+
+
 def test_late_joiner_admitted_at_reconfiguration_window():
     """A 5th process registers via the rendezvous while a 4-rank job
     trains; when rank 2 is lost the reconfiguration admits it, and the

@@ -546,6 +546,97 @@ def test_hvd_chaos_prints_reproducible_spec():
     assert spec_for(7) != spec_for(8)       # different seed -> different
 
 
+# ------------------------------------ sub-group collectives (groups.md) -----
+# Two 2-rank process groups; the failure is injected INSIDE one group's
+# collective.  Group-scoped abort semantics: the whole job dies typed
+# with the true origin — including the OTHER group's members, who were
+# busy with their own healthy collective — and no per-group ring state
+# leaks (PeerService purge is group-aware).
+GROUP_MATRIX_WORKER = r"""
+import os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+r = hvd.rank()
+lo = hvd.new_group([0, 1], name="ft.lo")
+hi = hvd.new_group([2, 3], name="ft.hi")
+mine = lo if r < 2 else hi
+n_elems = int(os.environ.get("FT_SIZE", "8"))
+t = jnp.ones((n_elems,)) * (r + 1)
+start = time.monotonic()
+try:
+    hvd.allreduce(t, op=hvd.Sum, name="ft.group", group=mine)
+    # the healthy group reaches the world barrier and must ALSO die
+    hvd.barrier(name="ft.join")
+    print(f"rank {r} COMPLETED", flush=True)
+except hvd.HvdAbortedError as exc:
+    elapsed = time.monotonic() - start
+    from horovod_tpu.common import basics
+    svc = basics._get_state().controller._peer_service
+    leaked = len(svc._mailbox) if svc is not None else 0
+    print(f"rank {r} ABORTED origin={exc.origin_rank} "
+          f"elapsed={elapsed:.1f} leaked={leaked}", flush=True)
+print(f"rank {r} DONE", flush=True)
+"""
+
+
+def test_injected_crash_inside_subgroup_aborts_whole_job():
+    """Rank 1 hard-exits at its group's allreduce submit: every
+    survivor — group peer AND both members of the other, healthy group
+    — raises HvdAbortedError naming rank 1."""
+    results = spawn_tcp_ranks(4, GROUP_MATRIX_WORKER, extra_env={
+        **_FT_ENV,
+        "FT_SIZE": "8",  # star path
+        "HVD_TPU_LIVENESS_TIMEOUT": "2",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "20",
+        "HVD_TPU_FAULT_SPEC": "rank1:allreduce:1:crash",
+    })
+    assert results[1][0] == 1, f"crashed rank: {results[1][1]}"
+    for rank in (0, 2, 3):
+        code, out, err = results[rank]
+        assert code == 0, f"rank {rank}: {out}\n{err}"
+        _assert_aborted(out, rank=rank, origin=1)
+
+
+def test_injected_crash_mid_subgroup_ring_no_leaked_state():
+    """Rank 1 dies after its GROUP ring's go-ahead with rank 0 blocked
+    on chunks in the group-qualified ring namespace: the abort wakes
+    the blocked recv typed and the group-aware purge leaves zero
+    mailbox residue on every survivor."""
+    results = spawn_tcp_ranks(4, GROUP_MATRIX_WORKER, extra_env={
+        **_FT_ENV,
+        "FT_SIZE": "70000",  # above the ring threshold: group rings
+        "HVD_TPU_LIVENESS_TIMEOUT": "2",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "30",
+        "HVD_TPU_FAULT_SPEC": "rank1:ring:1:crash",
+    })
+    assert results[1][0] == 1, f"crashed rank: {results[1][1]}"
+    for rank in (0, 2, 3):
+        code, out, err = results[rank]
+        assert code == 0, f"rank {rank}: {out}\n{err}"
+        _assert_aborted(out, rank=rank, origin=1)
+
+
+def test_injected_drop_inside_subgroup_promotes_stall():
+    """Rank 1 silently skips its group contribution while heartbeating:
+    the stall inspector sees the half-reported GROUP entry, promotes it
+    into a coordinated abort naming rank 1, and all four ranks — the
+    dropper included — fail typed."""
+    results = spawn_tcp_ranks(4, GROUP_MATRIX_WORKER, extra_env={
+        **_FT_ENV,
+        "FT_SIZE": "8",
+        "HVD_TPU_LIVENESS_TIMEOUT": "30",  # must NOT fire: rank 1 lives
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "2",
+        "HVD_TPU_FAULT_SPEC": "rank1:allreduce:1:drop",
+    })
+    for rank, (code, out, err) in enumerate(results):
+        assert code == 0, f"rank {rank}: {out}\n{err}"
+        _assert_aborted(out, rank=rank, origin=1)
+
+
 # ----------------------------------------- pipelined stripe data plane ------
 def _stripe_planes(p=2, segment_bytes=1024, stripes=2):
     """Loopback ring rig — one definition in ``bench._ring_harness``."""

@@ -279,6 +279,51 @@ def test_stall_path_clean_under_shim(tmp_path):
     assert not active, "\n".join(f["message"] for f in active)
 
 
+GROUPS_HARNESS = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu import groups as groups_mod
+from horovod_tpu.common import basics
+
+hvd.init()
+n = hvd.size()
+g0 = hvd.new_group(list(range(n // 2)), name="race.g0")
+g1 = hvd.new_group(list(range(n // 2, n)), name="race.g1")
+
+def per_rank(r):
+    grp = g0 if r in g0 else g1
+    for i in range(3):
+        hvd.allreduce(jnp.ones((64,)) * (r + 1), op=hvd.Sum,
+                      name=f"race.grp.{i}", group=grp)
+        hvd.allgather(jnp.ones((4,)) * r, name=f"race.gath.{i}",
+                      group=grp)
+    hvd.barrier(name="race.join")
+
+basics.run_parallel(per_rank)
+assert groups_mod.stats()["max_concurrent_groups"] >= 2, \
+    groups_mod.stats()
+basics.run_parallel(per_rank)
+hvd.shutdown()
+print("GROUPS-OK")
+"""
+
+
+def test_concurrent_groups_clean_under_shim(tmp_path):
+    """ISSUE 14: two process groups' collectives concurrently in
+    flight from worker threads — per-group negotiation tables, caches
+    and ring namespaces racing each other and the world barrier, shim
+    on: zero non-baselined reports (and the in-flight gauge proves the
+    two groups really did overlap under the shim's preemption)."""
+    env_body = (
+        "import os\n"
+        "os.environ['HVD_CONTROLLER'] = 'python'\n"
+        + GROUPS_HARNESS)
+    active = _run_inline_under_shim(env_body, "groups", tmp_path)
+    assert not active, "\n".join(f["message"] for f in active)
+
+
 FT_WORKER = r"""
 import os, time
 import jax

@@ -49,6 +49,35 @@ def test_generate_spec_old_seed_is_byte_identical():
     assert with_degrade.startswith(want + ",")
     assert with_degrade == generate_spec(7, 4, 3, elastic=True,
                                          degrade=2)
+    # the group-collective cell (ISSUE 14) draws strictly after every
+    # pre-existing cell: without --groups the spec is byte-identical
+    # to older trees, with it the cell appends after the same prefix
+    assert generate_spec(7, 4, 3, elastic=True, groups=False) == want
+    with_groups = generate_spec(7, 4, 3, elastic=True, groups=True)
+    assert with_groups == want + ",rank3:allreduce:5:crash"
+    stacked = generate_spec(7, 4, 3, elastic=True, coord_failover=True,
+                            groups=True)
+    no_groups = generate_spec(7, 4, 3, elastic=True,
+                              coord_failover=True)
+    assert stacked.startswith(no_groups + ",")
+
+
+def test_generate_spec_group_cell_parses_and_spares_rank0():
+    """The group cell must land on a collective/ring point with a
+    crash/drop action on a non-coordinator rank (killing rank 0 turns
+    the group-abort cell into a coordinator fail-over test)."""
+    from horovod_tpu.common import faults
+    from horovod_tpu.run.chaos import generate_spec
+
+    for seed in range(8):
+        base = generate_spec(seed, 8, 2)
+        spec = generate_spec(seed, 8, 2, groups=True)
+        assert spec.startswith(base + ",")
+        (cell,) = faults.parse_fault_spec(spec[len(base) + 1:])
+        assert cell.point in ("allreduce", "ring")
+        assert cell.action in ("crash", "drop")
+        assert cell.rank != 0
+        assert cell.step >= 2
 
 
 def test_generate_spec_degrade_cells_parse_and_target_the_link():
