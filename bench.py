@@ -39,6 +39,7 @@ process.  Prints ONE JSON line at the end.
 
 import json
 import os
+import socket
 import subprocess
 import sys
 import time
@@ -438,10 +439,13 @@ def _bench_ring_allreduce_bandwidth(p=4):
     return out
 
 
-def _ring_harness(p, segment_bytes, stripes):
+def _ring_harness(p, segment_bytes, stripes, reconnect_budget=None):
     """In-process worker ring over real loopback TCP (the exact
     transport of multi-process tcp mode): one PeerService mailbox +
-    RingPlane per rank, control MuxClients + bulk StripeClients."""
+    RingPlane per rank, control MuxClients + bulk StripeClients.
+    ``reconnect_budget`` arms the self-healing session layer explicitly
+    (None = the env default, i.e. off) — the reconnect leg passes it as
+    a ctor kwarg so the measurement never mutates process env."""
     from horovod_tpu.ops.tcp_dataplane import PeerService, RingPlane
     from horovod_tpu.run.service import network
 
@@ -450,11 +454,13 @@ def _ring_harness(p, segment_bytes, stripes):
 
     def resolver(rank):
         return network.MuxClient([("127.0.0.1", services[rank].port)],
-                                 key, timeout=60)
+                                 key, timeout=60,
+                                 reconnect_budget=reconnect_budget)
 
     def resolve_bulk(rank):
         return network.StripeClient(
-            [("127.0.0.1", services[rank].port)], key, timeout=60)
+            [("127.0.0.1", services[rank].port)], key, timeout=60,
+            reconnect_budget=reconnect_budget)
 
     planes = [RingPlane(r, services[r], resolver, resolve_bulk,
                         segment_bytes=segment_bytes, stripes=stripes)
@@ -748,6 +754,115 @@ def _bench_ring_pipelined_bandwidth(p=4):
         for svc in services:
             svc.shutdown()
     return out
+
+
+def _bench_reconnect(heal_trials=5, p=2, nbytes=1 << 23, iters=5,
+                     windows=3):
+    """Self-healing transport leg (ISSUE 17, docs/fault_tolerance.md
+    "connection blips vs dead peers"): two cells, one dict, all
+    in-process loopback (no fault spec — the injector is process-global
+    and would cut EVERY rank's links; the bench severs one client's
+    socket directly, which is exactly what an injected RST does to it).
+
+    - ``heal_ms``: wall time for a bulk StripeClient to notice a dead
+      socket mid-stream, reconnect, resume its session and replay the
+      unacked window — measured as the duration of the first
+      ``post_bulk`` after the socket is shut down under it.  Median
+      and max over ``heal_trials`` severs.
+    - ``session_on/off_gbs``: pipelined-ring allreduce GB/s with the
+      session layer armed (explicit ``reconnect_budget=`` ctor kwarg)
+      vs off (budget None -> legacy byte-identical wire).  The
+      steady-state seq/ack overhead must stay <= 2%
+      (tests/test_bench_gate.py gates the ratio)."""
+    import numpy as np
+
+    from horovod_tpu.ops.tcp_dataplane import ChunkMsg, PeerService
+    from horovod_tpu.run.service import network
+
+    key = b"0" * 32
+
+    # --- cell 1: heal latency of a severed bulk stripe
+    svc = PeerService(key)
+    client = network.StripeClient([("127.0.0.1", svc.port)], key,
+                                  timeout=60, reconnect_budget=30.0)
+    payload = b"\x5a" * (1 << 16)
+    heals_ms = []
+    healed_before = network.session_stats()["reconnects_healed"]
+    try:
+        for i in range(4):   # establish the session + a window
+            client.post_bulk(ChunkMsg((0, i), 0, None), payload)
+        for t in range(heal_trials):
+            with client._lock:
+                sock = client._sock
+            sock.shutdown(socket.SHUT_RDWR)
+            t0 = time.perf_counter()
+            client.post_bulk(ChunkMsg((1, t), 0, None), payload)
+            heals_ms.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        client.close()
+        svc.shutdown()
+    healed = network.session_stats()["reconnects_healed"] - healed_before
+
+    # --- cell 2: steady-state session overhead on the pipelined ring
+    def ring_gbs(budget):
+        services, planes = _ring_harness(p, 1 << 20, 2,
+                                         reconnect_budget=budget)
+        rng = np.random.RandomState(0)
+        data = [rng.randn(nbytes // 4).astype(np.float32)
+                for _ in range(p)]
+        seq = [0]
+
+        def one():
+            seq[0] += 1
+            rid = seq[0]
+            _ring_run_all(planes, lambda r: planes[r].allreduce(
+                rid, data[r], list(range(p)), op_average=False,
+                world_size=p, timeout=300, segment_bytes=1 << 20))
+
+        try:
+            one()   # warmup (connections + session handshakes)
+            samples = []
+            for _ in range(windows):
+                start = time.perf_counter()
+                for _ in range(iters):
+                    one()
+                samples.append(
+                    nbytes * iters / (time.perf_counter() - start) / 1e9)
+            return sorted(samples)[len(samples) // 2]
+        finally:
+            for plane in planes:
+                plane.close()
+            for s in services:
+                s.shutdown()
+
+    off = ring_gbs(None)
+    on = ring_gbs(30.0)
+    return {
+        "heal_ms_median": round(sorted(heals_ms)[len(heals_ms) // 2], 3),
+        "heal_ms_max": round(max(heals_ms), 3),
+        "heal_trials": heal_trials,
+        "reconnects_healed": healed,
+        "session_off_gbs": round(off, 3),
+        "session_on_gbs": round(on, 3),
+        "session_overhead_pct": round((1.0 - on / off) * 100.0, 2),
+        "payload_bytes": nbytes, "ranks": p,
+    }
+
+
+def reconnect_worker():
+    """Subprocess entry for the reconnect leg: pure loopback sockets +
+    threads (no JAX backend), isolated because the session-layer heal
+    counters and the fault injector are process-global state."""
+    print(json.dumps(_bench_reconnect()))
+
+
+def _run_reconnect(timeout=600):
+    """Run the self-healing transport leg in a subprocess; returns the
+    dict, or None when it failed."""
+    line, _, _ = _run_worker_once(flag="--reconnect-worker",
+                                  extra_env={"JAX_PLATFORMS": "cpu"},
+                                  timeout=timeout)
+    return None if line is None else json.loads(line)
 
 
 def _bench_optimizer_state_bytes():
@@ -1688,6 +1803,11 @@ def _attach_scaling(line):
         grp = _run_groups()
         if grp is not None:
             record["extra"]["groups"] = grp
+    if os.environ.get("BENCH_RECONNECT", "1") not in ("0", "false",
+                                                      "no"):
+        rec = _run_reconnect()
+        if rec is not None:
+            record["extra"]["reconnect"] = rec
     return json.dumps(record)
 
 
@@ -1711,6 +1831,13 @@ if __name__ == "__main__":
         result = _run_groups()
         print(json.dumps(result if result is not None else
                          {"error": "groups run failed"}))
+        sys.exit(0 if result is not None else 1)
+    elif "--reconnect-worker" in sys.argv:
+        reconnect_worker()
+    elif "--reconnect" in sys.argv:
+        result = _run_reconnect()
+        print(json.dumps(result if result is not None else
+                         {"error": "reconnect run failed"}))
         sys.exit(0 if result is not None else 1)
     elif "--checkpoint" in sys.argv:
         sys.exit(checkpoint_bench())

@@ -60,6 +60,13 @@ class Config:
     # windows), and whether a confirmed straggler is proposed for
     # drain-style exclusion under elastic.
     rtt_alpha: float = env_util.DEFAULT_RTT_ALPHA
+    # Self-healing transport (docs/fault_tolerance.md "connection blips
+    # vs dead peers"): the reconnect window a broken session may heal
+    # inside (0 = off, the pre-session escalate-immediately behavior)
+    # and the bound on the sender-side replay buffer of unacked frames.
+    reconnect_budget_seconds: float = \
+        env_util.DEFAULT_RECONNECT_BUDGET_SECONDS
+    replay_buffer_bytes: int = env_util.DEFAULT_REPLAY_BUFFER_BYTES
     straggler_factor: float = env_util.DEFAULT_STRAGGLER_FACTOR
     straggler_windows: int = env_util.DEFAULT_STRAGGLER_WINDOWS
     straggler_exclude: bool = False
@@ -161,6 +168,12 @@ class Config:
             rtt_alpha=env_util.get_float(
                 env_util.HVD_TPU_RTT_ALPHA,
                 env_util.DEFAULT_RTT_ALPHA),
+            reconnect_budget_seconds=env_util.get_float(
+                env_util.HVD_TPU_RECONNECT_BUDGET,
+                env_util.DEFAULT_RECONNECT_BUDGET_SECONDS),
+            replay_buffer_bytes=_validated_nonneg(
+                env_util.HVD_TPU_REPLAY_BUFFER_BYTES,
+                env_util.DEFAULT_REPLAY_BUFFER_BYTES),
             straggler_factor=env_util.get_float(
                 env_util.HVD_TPU_STRAGGLER_FACTOR,
                 env_util.DEFAULT_STRAGGLER_FACTOR),

@@ -48,9 +48,28 @@ write for ``duration_s`` seconds (omitted: the rest of the run):
 
     HVD_TPU_FAULT_SPEC="rank1:link:1:delay:200:30,*:allreduce:3:flaky:0.2"
 
+Mid-stream actions (docs/fault_tolerance.md "connection blips vs dead
+peers"): unlike ``flaky`` — which re-rolls the loss BEFORE any byte
+leaves the socket — these break the connection AFTER bytes are on the
+wire, exercising the session layer's reconnect + replay path:
+
+    reset:<p>         with probability p per frame write, write a
+                      partial frame prefix, hard-close the socket and
+                      raise ConnectionResetError (a genuine mid-stream
+                      RST; arms like a degradation, optional duration)
+    blip:<ms>         one-shot: the armed write hard-closes the link to
+                      its peer and every write/connect toward that peer
+                      is refused for the ms window, then accepted again
+                      (a link flap; never re-arms)
+
+Both accept ``*`` in the step field — armed from the first hit — in
+addition to the 1-based step the other actions require:
+
+    HVD_TPU_FAULT_SPEC="rank2:link:*:reset:0.3,rank1:link:5:blip:3000"
+
 Degradations are deterministic under the existing seed contract: the
-flaky/jitter RNG is seeded from the spec text and the rank, so the same
-spec on the same rank rolls the same sequence.
+flaky/jitter/reset RNG is seeded from the spec text and the rank, so
+the same spec on the same rank rolls the same sequence.
 
 Counters are per-process and per-point.  The module is inert (one dict
 lookup per check, one attribute read per frame write) when no spec is
@@ -69,6 +88,10 @@ _ACTIONS = ("crash", "drop", "refuse", "preempt")
 # parameterized, duration-scoped degradations (arm-and-stay, not
 # fire-once); applied at the framing layer via link()
 _DEGRADE_ACTIONS = ("delay", "jitter", "throttle", "flaky", "partition")
+# mid-stream link breaks: armed like degradations, but they sever the
+# connection AFTER bytes hit the wire so the session layer's
+# reconnect + replay path is what absorbs them
+_MIDSTREAM_ACTIONS = ("reset", "blip")
 
 
 class FaultSpec:
@@ -85,8 +108,9 @@ class FaultSpec:
 
     def __repr__(self):
         target = "*" if self.rank is None else f"rank{self.rank}"
-        base = f"{target}:{self.point}:{self.step}:{self.action}"
-        if self.action in _DEGRADE_ACTIONS:
+        step = "*" if self.step is None else self.step
+        base = f"{target}:{self.point}:{step}:{self.action}"
+        if self.action in _DEGRADE_ACTIONS + _MIDSTREAM_ACTIONS:
             if self.action == "partition":
                 base += f":{self.param[0]}-{self.param[1]}"
             else:
@@ -157,13 +181,23 @@ def parse_fault_spec(text):
         else:
             raise ValueError(
                 f"fault spec {part!r}: target must be rank<N> or *")
-        try:
-            step = int(step_s)
-        except ValueError:
-            raise ValueError(
-                f"fault spec {part!r}: step must be an integer") from None
-        if step < 1:
-            raise ValueError(f"fault spec {part!r}: step is 1-based")
+        if step_s == "*":
+            # "armed from the first hit" only makes sense for the
+            # mid-stream breaks — every other action fires exactly once
+            if action not in _MIDSTREAM_ACTIONS:
+                raise ValueError(
+                    f"fault spec {part!r}: step * is only valid for "
+                    f"{'/'.join(_MIDSTREAM_ACTIONS)}")
+            step = None
+        else:
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise ValueError(
+                    f"fault spec {part!r}: step must be an integer "
+                    f"or *") from None
+            if step < 1:
+                raise ValueError(f"fault spec {part!r}: step is 1-based")
         if not point:
             raise ValueError(f"fault spec {part!r}: empty point")
         param = duration = None
@@ -184,6 +218,45 @@ def parse_fault_spec(text):
                 if duration <= 0:
                     raise ValueError(
                         f"fault spec {part!r}: duration must be > 0")
+        elif action == "reset":
+            if len(fields) not in (5, 6):
+                raise ValueError(
+                    f"fault spec {part!r}: reset wants "
+                    f"<target>:<point>:<step>:reset:<p>[:<duration_s>]")
+            try:
+                param = float(fields[4])
+            except ValueError:
+                raise ValueError(
+                    f"fault spec {part!r}: reset wants a probability, "
+                    f"got {fields[4]!r}") from None
+            if not 0.0 <= param <= 1.0:
+                raise ValueError(
+                    f"fault spec {part!r}: reset probability must be in "
+                    f"[0, 1], got {param:g}")
+            if len(fields) == 6:
+                try:
+                    duration = float(fields[5])
+                except ValueError:
+                    raise ValueError(
+                        f"fault spec {part!r}: duration must be "
+                        f"seconds") from None
+                if duration <= 0:
+                    raise ValueError(
+                        f"fault spec {part!r}: duration must be > 0")
+        elif action == "blip":
+            if len(fields) != 5:
+                raise ValueError(
+                    f"fault spec {part!r}: blip wants "
+                    f"<target>:<point>:<step>:blip:<window_ms>")
+            try:
+                param = float(fields[4])
+            except ValueError:
+                raise ValueError(
+                    f"fault spec {part!r}: blip wants a window in ms, "
+                    f"got {fields[4]!r}") from None
+            if param < 0:
+                raise ValueError(
+                    f"fault spec {part!r}: blip window must be >= 0 ms")
         elif action in _ACTIONS:
             if len(fields) != 4:
                 raise ValueError(
@@ -191,7 +264,7 @@ def parse_fault_spec(text):
         else:
             raise ValueError(
                 f"fault spec {part!r}: action must be one of "
-                f"{_ACTIONS + _DEGRADE_ACTIONS}")
+                f"{_ACTIONS + _DEGRADE_ACTIONS + _MIDSTREAM_ACTIONS}")
         specs.append(FaultSpec(rank, point, step, action, param=param,
                                duration=duration))
     return specs
@@ -204,20 +277,25 @@ class LinkState:
     the jitter roll); ``throttle_bps`` is the tightest armed pacing rate
     in bytes/second (0: unthrottled); ``drop`` is the flaky roll for
     this write; ``partitioned`` means the (rank, peer) link crosses an
-    armed partition boundary and the write must fail outright."""
+    armed partition boundary and the write must fail outright; ``reset``
+    means the transport must sever the connection MID-FRAME (partial
+    prefix on the wire, hard close, ConnectionResetError) so the
+    session layer's reconnect + replay path absorbs it."""
 
-    __slots__ = ("delay_s", "throttle_bps", "drop", "partitioned")
+    __slots__ = ("delay_s", "throttle_bps", "drop", "partitioned",
+                 "reset")
 
     def __init__(self, delay_s=0.0, throttle_bps=0.0, drop=False,
-                 partitioned=False):
+                 partitioned=False, reset=False):
         self.delay_s = delay_s
         self.throttle_bps = throttle_bps
         self.drop = drop
         self.partitioned = partitioned
+        self.reset = reset
 
     def __bool__(self):
         return bool(self.delay_s or self.throttle_bps or self.drop
-                    or self.partitioned)
+                    or self.partitioned or self.reset)
 
 
 class FaultInjector:
@@ -236,7 +314,17 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._degrade = [s for s in self._specs
                          if s.action in _DEGRADE_ACTIONS
+                         + _MIDSTREAM_ACTIONS
                          and s.rank in (None, rank)]
+        # peer -> monotonic end of an open blip window (the link toward
+        # that peer refuses writes AND reconnects until then); guarded
+        # by self._lock
+        self._blips = {}
+        # step `*` mid-stream specs are armed from process start — no
+        # counted hit has to happen first
+        for spec in self._degrade:
+            if spec.step is None:
+                self._armed[spec] = time.monotonic()
         # hits of "link" only matter when a spec watches that point —
         # keeps the per-frame-write hot path to one attribute read when
         # faults are armed for other points only
@@ -279,9 +367,11 @@ class FaultInjector:
         delay = jitter = 0.0
         throttle = 0.0
         flaky = 0.0
-        partitioned = False
+        reset_p = 0.0
+        partitioned = reset = False
         now = time.monotonic()
         with self._lock:
+            tripped = []
             for spec in self._active_locked(now):
                 if spec.action == "delay":
                     delay = max(delay, spec.param / 1000.0)
@@ -293,19 +383,54 @@ class FaultInjector:
                         else min(throttle, bps)
                 elif spec.action == "flaky":
                     flaky = max(flaky, spec.param)
+                elif spec.action == "reset":
+                    reset_p = max(reset_p, spec.param)
+                elif spec.action == "blip" and peer is not None:
+                    # one-shot: THIS write severs the link toward its
+                    # peer and opens the refuse window; never re-arms
+                    self._blips[peer] = now + spec.param / 1000.0
+                    tripped.append(spec)
+                    reset = True
                 elif spec.action == "partition" and peer is not None:
                     lo, hi = spec.param
                     if (lo <= self._rank <= hi) != (lo <= peer <= hi):
                         partitioned = True
+            for spec in tripped:
+                del self._armed[spec]
+            if peer is not None and peer in self._blips:
+                if now < self._blips[peer]:
+                    reset = True
+                else:
+                    del self._blips[peer]
             if jitter > 0:
                 delay += self._rng.uniform(0.0, jitter)
             drop = flaky > 0 and self._rng.random() < flaky
+            if reset_p > 0 and self._rng.random() < reset_p:
+                reset = True
         state = LinkState(delay_s=delay, throttle_bps=throttle,
-                          drop=drop, partitioned=partitioned)
+                          drop=drop, partitioned=partitioned,
+                          reset=reset)
         if action is not None:
             state.drop = state.drop or action == "drop"
             _trip_binary(action, "link")
         return state if state else None
+
+    def blip_blocked(self, peer):
+        """True while an open blip window covers the link toward
+        ``peer`` — reconnect attempts inside the window must be refused
+        (the flap is still down), so the session layer's backoff loop
+        is what rides it out."""
+        if peer is None or not self._blips:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            until = self._blips.get(peer)
+            if until is None:
+                return False
+            if now < until:
+                return True
+            del self._blips[peer]
+            return False
 
 
 def _binary_link_state(action):
@@ -373,17 +498,23 @@ def _trip_binary(action, point):
         os._exit(1)
 
 
-def check(point) -> bool:
+def check(point, peer=None) -> bool:
     """Trip any fault armed for this hit of ``point``.
 
     Returns True when the caller must DROP the operation; raises
     ConnectionRefusedError for ``refuse``; ``crash`` never returns.
+    ``peer`` scopes per-link faults: a ``connect`` toward a peer whose
+    blip window is still open is refused (the flap is still down).
     """
     if not _configured:
         _auto_configure()
     injector = _injector
     if injector is None:
         return False
+    if point == "connect" and injector.blip_blocked(peer):
+        raise ConnectionRefusedError(
+            f"injected link blip toward peer {peer}: connection "
+            f"refused (HVD_TPU_FAULT_SPEC)")
     action = injector.fire(point)
     if action is None:
         return False

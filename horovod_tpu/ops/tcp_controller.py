@@ -266,6 +266,12 @@ class CoordinatorService(network.MuxService):
         # deadline so slow disk I/O can't read as death; guarded by
         # self._cv
         self._busy_ranks = set()
+        # ranks whose last heartbeat reported a session heal in flight
+        # (docs/fault_tolerance.md "connection blips vs dead peers"):
+        # treated as busy for liveness AND exempt from straggler
+        # verdicts — a recovering link is never converted into an
+        # exclusion or an abort; guarded by self._cv
+        self._reconnecting_ranks = set()
         # ranks that announced a graceful drain: excluded from liveness
         # blame entirely — silence is their planned departure, not a
         # death to abort over; guarded by self._cv
@@ -318,10 +324,15 @@ class CoordinatorService(network.MuxService):
                 if isinstance(req, network.HeartbeatMsg):
                     # getattr: a pre-busy-field peer's heartbeat simply
                     # never widens its window
-                    if getattr(req, "busy", False):
+                    rec = getattr(req, "reconnecting", None)
+                    if getattr(req, "busy", False) or rec:
                         self._busy_ranks.add(rank)
                     else:
                         self._busy_ranks.discard(rank)
+                    if rec:
+                        self._reconnecting_ranks.add(rank)
+                    else:
+                        self._reconnecting_ranks.discard(rank)
                     rtt = getattr(req, "rtt", None)
                     if rtt is not None:
                         self._peer_rtt[rank] = float(rtt)
@@ -351,6 +362,7 @@ class CoordinatorService(network.MuxService):
                 with self._cv:
                     self._last_seen.pop(req.rank, None)
                     self._busy_ranks.discard(req.rank)
+                    self._reconnecting_ranks.discard(req.rank)
                     self._draining.discard(req.rank)
                     self._peer_rtt.pop(req.rank, None)
                     self._straggler_hits.pop(req.rank, None)
@@ -519,6 +531,13 @@ class CoordinatorService(network.MuxService):
         med = rtt_mod.median(self._peer_rtt.values())
         exclude = None
         for r, value in self._peer_rtt.items():
+            if r in self._reconnecting_ranks:
+                # a healing link inflates RTT by construction; a
+                # reconnect in progress must never ripen into a
+                # straggler verdict (docs/fault_tolerance.md
+                # "connection blips vs dead peers")
+                self._straggler_hits.pop(r, None)
+                continue
             if not (med > 0 and value > self._straggler_factor * med):
                 self._straggler_hits.pop(r, None)
                 continue
@@ -1403,10 +1422,13 @@ class TcpController:
         return self._filter_ifaces(tagged)
 
     def _resolve_peer(self, rank):
+        # epoch rides along so a session healing across a
+        # reconfiguration is fenced by the peer's PeerService instead
+        # of replaying a torn-down ring's frames into the new epoch
         return network.MuxClient(
             self._peer_addrs(rank, env_util.get_float(
                 env_util.HVD_START_TIMEOUT, 120.0)),
-            self._key, timeout=30, peer=rank)
+            self._key, timeout=30, peer=rank, epoch=self._epoch)
 
     def _resolve_stripe(self, rank):
         """One dedicated bulk-data connection to ``rank``'s mailbox —
@@ -1416,7 +1438,7 @@ class TcpController:
         return network.StripeClient(
             self._peer_addrs(rank, env_util.get_float(
                 env_util.HVD_START_TIMEOUT, 120.0)),
-            self._key, timeout=30, peer=rank)
+            self._key, timeout=30, peer=rank, epoch=self._epoch)
 
     @staticmethod
     def _filter_ifaces(tagged):
@@ -1478,10 +1500,17 @@ class TcpController:
                     # coordinator widens this rank's liveness deadline
                     # by that slack, telling slow-but-alive from dead
                     reply = hb_client.send(
-                        network.HeartbeatMsg(self._rank,
-                                             busy=busy.active(),
-                                             rtt=tracker.worst() or None,
-                                             host=self._host_hash()),
+                        network.HeartbeatMsg(
+                            self._rank,
+                            busy=busy.active(),
+                            rtt=tracker.worst() or None,
+                            host=self._host_hash(),
+                            # peers this rank is healing a session
+                            # toward RIGHT NOW: the coordinator widens
+                            # the liveness window and skips straggler
+                            # verdicts instead of reading the recovery
+                            # pause as death
+                            reconnecting=network.healing_peers() or None),
                         timeout=max(interval * 2, 5.0))
                     tracker.sample(rtt_mod.COORD_KEY,
                                    time.monotonic() - t0)

@@ -276,6 +276,13 @@ class PeerService(network.MuxService):
         self.abort_callback = None
         super().__init__(self.NAME, key)
 
+    def session_epoch(self):
+        """Session hellos must carry the plane's membership epoch: a
+        client healing across a reconfiguration is fenced (refused
+        welcome) and escalates instead of replaying a torn-down ring's
+        frames into the new epoch."""
+        return self._epoch
+
     def _handle(self, req, client_address):
         if isinstance(req, ChunkMsg):
             with self._cv:

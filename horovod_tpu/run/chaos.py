@@ -33,9 +33,20 @@ _DELAY_MS = (5, 20, 50)
 _THROTTLE_MBPS = (4, 16, 64)
 _FLAKY_P = (0.05, 0.2)
 
+# mid-stream connection-break cells (--blips; docs/fault_tolerance.md
+# "connection blips vs dead peers"): a probabilistic RST storm or a
+# one-shot link flap, both absorbed by the session layer when
+# HVD_TPU_RECONNECT_BUDGET grants a window.  Rank 0 stays out of the
+# pool — cutting the coordinator's links turns a heal soak into a
+# liveness test.
+_MIDSTREAM_ACTIONS = ("reset", "blip")
+_RESET_P = (0.1, 0.3)
+_BLIP_MS = (200, 1000, 3000)
+
 
 def generate_spec(seed, num_ranks, num_faults, elastic=False,
-                  degrade=0, coord_failover=False, groups=False):
+                  degrade=0, coord_failover=False, groups=False,
+                  blips=0):
     rng = random.Random(seed)
     specs = []
     for _ in range(num_faults):
@@ -103,4 +114,21 @@ def generate_spec(seed, num_ranks, num_faults, elastic=False,
         rank = rng.randrange(1, num_ranks) if num_ranks > 1 else 0
         step = rng.randint(2, 5)   # after warmup: groups have formed
         specs.append(f"rank{rank}:{point}:{step}:{action}")
+    # mid-stream break cells (--blips): reset/blip at the link point.
+    # Their draws come strictly AFTER every pre-existing draw (binary,
+    # degrade, coord-failover, groups) — the same cross-version replay
+    # contract: a seed's spec without --blips is byte-identical to
+    # every older tree.
+    for _ in range(blips):
+        action = rng.choice(_MIDSTREAM_ACTIONS)
+        rank = rng.randrange(1, num_ranks) if num_ranks > 1 else 0
+        step = rng.randint(1, 5)
+        if action == "reset":
+            param = str(rng.choice(_RESET_P))
+            duration = rng.randint(2, 8)
+            specs.append(f"rank{rank}:link:{step}:reset:{param}:"
+                         f"{duration}")
+        else:
+            param = str(rng.choice(_BLIP_MS))
+            specs.append(f"rank{rank}:link:{step}:blip:{param}")
     return ",".join(specs)

@@ -229,6 +229,23 @@ def make_parser():
     fault.add_argument("--no-drain", action="store_true", default=None,
                        help="Force the drain handler off: SIGTERM "
                             "keeps its default kill disposition.")
+    fault.add_argument("--reconnect-budget", type=float, default=None,
+                       help="Reconnect window in seconds: a mid-stream "
+                            "connection break is healed in place "
+                            "(reconnect + session handshake + replay "
+                            "of unacked frames) for up to this long "
+                            "before the break escalates to the abort/"
+                            "elastic path (HVD_TPU_RECONNECT_BUDGET, "
+                            "default 0 = off; see "
+                            "docs/fault_tolerance.md 'connection "
+                            "blips vs dead peers').")
+    fault.add_argument("--replay-buffer-bytes", type=int, default=None,
+                       help="Bound on the sender-side replay buffer "
+                            "of unacknowledged session frames "
+                            "(HVD_TPU_REPLAY_BUFFER_BYTES, default "
+                            "64 MiB); a heal needing an evicted frame "
+                            "escalates instead of resuming with a "
+                            "gap.")
     fault.add_argument("--rtt-alpha", type=float, default=None,
                        help="EWMA smoothing factor for the per-peer "
                             "RTT estimates behind the adaptive "

@@ -135,6 +135,19 @@ HVD_TPU_STRAGGLER_WINDOWS = "HVD_TPU_STRAGGLER_WINDOWS"
 # exclusion (boundary reconfiguration, no abort) instead of only logged
 HVD_TPU_STRAGGLER_EXCLUDE = "HVD_TPU_STRAGGLER_EXCLUDE"
 
+# --- self-healing transport (docs/fault_tolerance.md "connection blips") -----
+# reconnect window: on a mid-stream connection break the sender heals
+# the session in place (reconnect with backoff + session handshake +
+# replay of the unacknowledged frames) for up to this many seconds
+# before surfacing the original transport error to the abort/elastic
+# path (0 = off: every break escalates immediately, the pre-session
+# behavior, byte-identical on the wire)
+HVD_TPU_RECONNECT_BUDGET = "HVD_TPU_RECONNECT_BUDGET"
+# bound on the sender-side replay buffer of unacknowledged session
+# frames (bytes); a heal that would need a frame older than the oldest
+# retained one escalates instead of resuming with a silent gap
+HVD_TPU_REPLAY_BUFFER_BYTES = "HVD_TPU_REPLAY_BUFFER_BYTES"
+
 # --- elastic membership (docs/elastic.md) ------------------------------------
 # survive rank loss: reconfigure membership instead of raising on abort
 HVD_TPU_ELASTIC = "HVD_TPU_ELASTIC"
@@ -234,6 +247,11 @@ DEFAULT_TERM_GRACE_SECONDS = 5.0
 DEFAULT_CKPT_INTERVAL_STEPS = 10
 DEFAULT_CKPT_KEEP = 2
 DEFAULT_RTT_ALPHA = 0.25
+# session heal is opt-in: a dead peer must keep surfacing through the
+# abort/liveness path with the seed-era timings until a deployment
+# explicitly grants a reconnect window
+DEFAULT_RECONNECT_BUDGET_SECONDS = 0.0
+DEFAULT_REPLAY_BUFFER_BYTES = 64 << 20
 DEFAULT_STRAGGLER_FACTOR = 4.0
 DEFAULT_STRAGGLER_WINDOWS = 3
 DEFAULT_SOAK_RANKS = 16

@@ -269,3 +269,30 @@ def test_compression_tri_surface(monkeypatch, tmp_path):
 
     monkeypatch.setenv(env_util.HVD_TPU_COMPRESSION, "fp16")
     assert Config.from_env().compression == "fp16"
+
+
+def test_session_flag_additions_map(tmp_path):
+    """--reconnect-budget / --replay-buffer-bytes (the self-healing
+    transport knobs, docs/fault_tolerance.md "connection blips vs dead
+    peers") land in the worker env contract; YAML fills unset flags."""
+    args = _parse(["-np", "2", "--reconnect-budget", "20",
+                   "--replay-buffer-bytes", "1048576"])
+    env = config_parser.env_from_args(args)
+    assert env[env_util.HVD_TPU_RECONNECT_BUDGET] == "20.0"
+    assert env[env_util.HVD_TPU_REPLAY_BUFFER_BYTES] == "1048576"
+    # unset: the knobs stay out of the env (workers use the defaults —
+    # budget 0 keeps the wire byte-identical to the pre-session layer)
+    bare = config_parser.env_from_args(_parse(["-np", "2"]))
+    assert env_util.HVD_TPU_RECONNECT_BUDGET not in bare
+    assert env_util.HVD_TPU_REPLAY_BUFFER_BYTES not in bare
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        "fault_tolerance:\n"
+        "  reconnect_budget: 15\n"
+        "  replay_buffer_bytes: 2097152\n")
+    args = _parse(["-np", "2", "--reconnect-budget", "20"])
+    config_parser.apply_config_to_args(
+        args, config_parser.load_config_file(str(cfg)))
+    env = config_parser.env_from_args(args)
+    assert env[env_util.HVD_TPU_RECONNECT_BUDGET] == "20.0"   # CLI wins
+    assert env[env_util.HVD_TPU_REPLAY_BUFFER_BYTES] == "2097152"

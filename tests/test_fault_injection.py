@@ -1065,3 +1065,193 @@ def test_partitioned_link_is_a_real_failure_with_the_right_origin():
         code, out, err = results[rank]
         assert code == 0, f"rank {rank}: {out}\n{err}"
         _assert_aborted(out, rank, origin=2, deadline=45.0)
+
+
+# ------------------- mid-stream break grammar + the self-healing matrix -----
+def test_fault_spec_midstream_grammar_round_trip():
+    specs = faults.parse_fault_spec(
+        "rank2:link:*:reset:0.3, rank1:link:2:reset:0.2:6 ,"
+        "rank1:link:5:blip:30000")
+    got = [(s.rank, s.point, s.step, s.action, s.param, s.duration)
+           for s in specs]
+    assert got == [
+        # '*' step: armed from the first write; no duration: permanent
+        (2, "link", None, "reset", 0.3, None),
+        (1, "link", 2, "reset", 0.2, 6.0),
+        (1, "link", 5, "blip", 30000.0, None),
+    ]
+
+
+@pytest.mark.parametrize("bad", [
+    "rank1:allreduce:*:crash",        # '*' step is midstream-only
+    "rank1:link:*:delay:40",          # ... degrade cells too
+    "rank1:link:1:reset",             # reset wants a probability
+    "rank1:link:1:reset:1.5",         # probability > 1
+    "rank1:link:1:reset:often",       # non-numeric probability
+    "rank1:link:1:blip:3000:5",       # blip takes no duration
+    "rank1:link:1:blip:-5",           # negative window
+    "rank1:link:1:blip",              # blip wants a window
+])
+def test_fault_spec_rejects_bad_midstream_grammar(bad):
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec(bad)
+
+
+# Worker for the self-healing matrix (docs/fault_tolerance.md
+# "connection blips vs dead peers"): several steps of allreduce +
+# broadcast folded into one digest, so "completed" also means
+# "bit-identical to the fault-free run" — a heal that corrupted or
+# double-delivered a frame would change the bytes.
+SESSION_WORKER = r"""
+import hashlib, os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+r = hvd.rank()
+steps = int(os.environ.get("FT_STEPS", "4"))
+n_elems = int(os.environ.get("FT_SIZE", "20000"))
+digest = hashlib.sha256()
+try:
+    for step in range(steps):
+        t = jnp.arange(n_elems, dtype=jnp.float32) * (r + 1) + step
+        out = hvd.allreduce(t, op=hvd.Sum, name=f"sess.ar.{step}")
+        digest.update(np.asarray(out).tobytes())
+        b = hvd.broadcast(t, root_rank=0, name=f"sess.bc.{step}")
+        digest.update(np.asarray(b).tobytes())
+    print(f"rank {r} COMPLETED digest={digest.hexdigest()}", flush=True)
+except hvd.HvdAbortedError as exc:
+    print(f"rank {r} ABORTED origin={exc.origin_rank}", flush=True)
+print(f"rank {r} DONE", flush=True)
+"""
+
+# wide liveness/stall windows: these cells assert the HEAL path, so no
+# detector may convert the engineered blip into a verdict first
+_SESSION_ENV = {
+    **_FT_ENV,
+    "FT_STEPS": "4",
+    "FT_SIZE": "20000",   # above the ring threshold: bulk stripes too
+    "HVD_TPU_LIVENESS_TIMEOUT": "15",
+    "HVD_STALL_SHUTDOWN_TIME_SECONDS": "30",
+}
+
+
+def _session_digests(results):
+    out_digests = []
+    for rank, (code, out, err) in enumerate(results):
+        assert code == 0, f"rank {rank}: {out}\n{err[-2000:]}"
+        assert "ABORTED" not in out, f"rank {rank}: {out}"
+        line = next(l for l in out.splitlines()
+                    if l.startswith(f"rank {rank} COMPLETED"))
+        out_digests.append(line.split("digest=")[1])
+    return out_digests
+
+
+def _healed_count(results):
+    return sum(err.count("[hvd-session] reconnect healed")
+               for _code, _out, err in results)
+
+
+def test_midstream_reset_heals_and_completes_bitwise_identical():
+    """THE acceptance scenario (ISSUE 17): every frame rank 2 writes
+    has a 30% chance of tearing the connection mid-ring — and the job
+    completes with digests bitwise-identical to a fault-free run, zero
+    aborts, the breaks healed by session resume + replay instead of
+    costing a reconfiguration."""
+    clean = spawn_tcp_ranks(4, SESSION_WORKER, extra_env=_SESSION_ENV,
+                            timeout=180)
+    chaos = spawn_tcp_ranks(4, SESSION_WORKER, extra_env={
+        **_SESSION_ENV,
+        "HVD_TPU_RECONNECT_BUDGET": "30",
+        "HVD_TPU_FAULT_SPEC": "rank2:link:*:reset:0.3",
+    }, timeout=180)
+    want = _session_digests(clean)
+    assert len(set(want)) == 1, want     # all ranks agree with each other
+    got = _session_digests(chaos)
+    assert got == want, (got, want)      # ... and chaos run is bit-equal
+    assert _healed_count(chaos) >= 1, \
+        "no [hvd-session] heal marker: the chaos never engaged"
+    assert any("[hvd-fault] mid-stream reset" in err
+               for _c, _o, err in chaos), "reset fault never armed"
+
+
+def test_midstream_reset_with_zero_budget_reproduces_typed_abort():
+    """The feature-off pin, both ways: with the default budget (0) a
+    mid-stream reset is exactly today's failure — the typed abort, no
+    heal attempts — and the SAME spec with a budget completes with a
+    heal.  One knob flips between the two worlds."""
+    spec = "rank1:link:1:reset:1.0:4"
+    broken = spawn_tcp_ranks(2, SESSION_WORKER, extra_env={
+        **_SESSION_ENV,
+        "HVD_TPU_LIVENESS_TIMEOUT": "3",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "12",
+        "HVD_TPU_FAULT_SPEC": spec,
+    }, timeout=180)
+    assert _healed_count(broken) == 0, "budget 0 must never heal"
+    outs = "\n".join(out for _c, out, _e in broken)
+    assert "COMPLETED" not in outs, outs
+    assert ("ABORTED" in outs
+            or any(code != 0 for code, _o, _e in broken)), broken
+    healed = spawn_tcp_ranks(2, SESSION_WORKER, extra_env={
+        **_SESSION_ENV,
+        "HVD_TPU_RECONNECT_BUDGET": "30",
+        "HVD_TPU_FAULT_SPEC": spec,
+    }, timeout=180)
+    _session_digests(healed)
+    assert _healed_count(healed) >= 1
+
+
+def test_blip_outlasting_the_budget_escalates():
+    """A 30s link flap against a 2s budget is a dead peer as far as
+    the job can tell: the heal loop exhausts its window (connects are
+    refused while the flap is down), the ORIGINAL error escalates, and
+    the typed abort fires — no infinite retry, no hang."""
+    results = spawn_tcp_ranks(2, SESSION_WORKER, extra_env={
+        **_SESSION_ENV,
+        "HVD_TPU_LIVENESS_TIMEOUT": "5",
+        "HVD_STALL_SHUTDOWN_TIME_SECONDS": "12",
+        "HVD_TPU_RECONNECT_BUDGET": "2",
+        "HVD_TPU_FAULT_SPEC": "rank1:link:5:blip:30000",
+    }, timeout=180)
+    outs = "\n".join(out for _c, out, _e in results)
+    assert "COMPLETED" not in outs, outs
+    assert ("ABORTED" in outs
+            or any(code != 0 for code, _o, _e in results)), results
+    assert _healed_count(results) == 0, \
+        "a connect during an open blip window must be refused"
+
+
+def test_healing_rank_is_exempt_from_straggler_verdicts():
+    """The reconnect/liveness interplay: a rank mid-heal heartbeats as
+    busy + reconnecting, so a tight liveness window and the straggler
+    detector both stand down while the session resumes — the blip never
+    becomes an exclusion."""
+    results = spawn_tcp_ranks(2, SESSION_WORKER, extra_env={
+        **_SESSION_ENV,
+        "FT_STEPS": "6",
+        "HVD_TPU_LIVENESS_TIMEOUT": "2",
+        "HVD_TPU_RECONNECT_BUDGET": "30",
+        "HVD_TPU_FAULT_SPEC": "rank1:link:1:reset:0.3:5",
+    }, timeout=180)
+    _session_digests(results)
+    assert _healed_count(results) >= 1
+    assert not any("straggler verdict" in err for _c, _o, err in results)
+
+
+def test_midstream_reset_heals_on_the_hierarchical_schedule():
+    """The session layer sits below the collective schedule: the
+    two-level hierarchical plan's intra/inter-group streams heal the
+    same way the flat ring's do."""
+    results = spawn_tcp_ranks(4, SESSION_WORKER, extra_env={
+        **_SESSION_ENV,
+        "HVD_TPU_SCHEDULE": "hierarchical",
+        "HVD_HIER_LOCAL_SIZE": "2",
+        "HVD_TPU_RECONNECT_BUDGET": "30",
+        "HVD_TPU_FAULT_SPEC": "rank2:link:*:reset:0.3",
+    }, timeout=180)
+    digests = _session_digests(results)
+    assert len(set(digests)) == 1, digests
+    assert _healed_count(results) >= 1

@@ -1,0 +1,456 @@
+"""Self-healing transport session layer (ISSUE 17,
+docs/fault_tolerance.md "connection blips vs dead peers").
+
+Unit layer: the sender-side replay buffer (seq assignment, cumulative
+ack pruning, byte-bounded eviction), the service-side dedup/gap
+verdicts driven over a raw protocol socket, the feature-off
+wire-identity contract (budget 0 == pre-session frames, no hello).
+
+Integration layer (in-process, real loopback TCP): control and bulk
+sessions healing severed sockets transparently — exactly-once
+delivery across the break, replay + resume, ack pruning, the epoch
+fence, budget exhaustion escalating the ORIGINAL error, and the
+healing-peer registry the liveness heartbeat reports from.
+"""
+
+import socket as socket_mod
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.run.service import network, secret
+
+
+# --------------------------------------------------------------- fixtures --
+class EchoService(network.MuxService):
+    """Records every request it handles (posts and sends alike) and
+    echoes sends back — the delivery ledger the exactly-once
+    assertions read."""
+
+    def __init__(self, key):
+        self.got = []
+        self.got_lock = threading.Lock()
+        super().__init__("session echo", key)
+
+    def _handle(self, req, client_address):
+        with self.got_lock:
+            self.got.append(req)
+        return ("echo", req)
+
+    def received(self):
+        with self.got_lock:
+            return list(self.got)
+
+
+@pytest.fixture
+def key():
+    return secret.make_secret_key()
+
+
+@pytest.fixture
+def echo(key):
+    svc = EchoService(key)
+    yield svc
+    svc.shutdown()
+
+
+def _sever(client_sock):
+    """Cut a connection mid-stream the way an injected RST does: the
+    next write on it raises, the reader wakes with an error."""
+    client_sock.shutdown(socket_mod.SHUT_RDWR)
+
+
+def _wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------- sender unit layer --
+def test_session_sender_seq_ack_and_replay():
+    s = network._SessionSender(epoch=0, replay_bytes=1 << 20)
+    recs = [s.append(lambda q: ("frame", q), 100)
+            for _ in range(5)]
+    assert [seq for seq, _ in recs] == [1, 2, 3, 4, 5]
+    # cumulative ack prunes everything at/below seen
+    s.ack(3)
+    assert s.acked == 3
+    assert sorted(s._frames) == [4, 5]
+    # replay from rx_seen=3: exactly the unacked tail, in order
+    assert s.replayable_from(3) == [("frame", 4), ("frame", 5)]
+    # a later (higher) welcome prunes further
+    assert s.replayable_from(4) == [("frame", 5)]
+    # acks never regress
+    s.ack(2)
+    assert s.acked == 4
+
+
+def test_session_sender_byte_bound_evicts_oldest_and_gaps():
+    s = network._SessionSender(epoch=0, replay_bytes=250)
+    for _ in range(4):
+        s.append(lambda q: ("frame", q), 100)
+    # 400 bytes > 250: the two oldest were dropped
+    assert sorted(s._frames) == [3, 4]
+    # the service only saw frame 1 -> frame 2 is gone: replay would
+    # leave a silent gap, so the sender must refuse (None)
+    assert s.replayable_from(1) is None
+    # but a welcome covering the evicted frames resumes fine
+    assert s.replayable_from(2) == [("frame", 3), ("frame", 4)]
+
+
+# ---------------------------------------------- service-side protocol unit --
+def _raw_session(port, key, session_id="cafe", epoch=0):
+    """Hand-rolled session client: connect, hello, welcome."""
+    sock = socket_mod.create_connection(("127.0.0.1", port), timeout=10)
+    network.write_message(sock, key, (None, network.SessionHello(
+        session_id, epoch, 0)), "q")
+    sock.settimeout(10)
+    _, welcome = network.read_message(sock, key, "r")
+    return sock, welcome
+
+
+def test_service_dedups_by_seq_and_severs_on_gap(echo, key):
+    sock, welcome = _raw_session(echo.port, key)
+    assert isinstance(welcome, network.SessionWelcome)
+    assert welcome.rx_seen == 0 and not welcome.refused
+    try:
+        # in-order, then a duplicate replay of seq 1: delivered once
+        network.write_message(sock, key, (("sq", 1), "a"), "q")
+        network.write_message(sock, key, (("sq", 2), "b"), "q")
+        network.write_message(sock, key, (("sq", 1), "a"), "q")
+        network.write_message(sock, key, (("sq", 2), "b"), "q")
+        _wait_for(lambda: len(echo.received()) >= 2, msg="delivery")
+        time.sleep(0.2)   # would-be dup deliveries need time to land
+        assert echo.received() == ["a", "b"]
+        assert echo.session_dup_drops == 2
+        # a gap (seq 9 when seen=2) is a protocol violation: the
+        # service severs rather than risk replaying past a lost frame
+        network.write_message(sock, key, (("sq", 9), "z"), "q")
+        with pytest.raises((ConnectionError, OSError)):
+            sock.settimeout(5)
+            while True:
+                network.read_message(sock, key, "r")
+    finally:
+        sock.close()
+    assert echo.received() == ["a", "b"]
+
+
+def test_service_resume_reports_seen_and_redelivers_responses(echo, key):
+    sock, _ = _raw_session(echo.port, key, session_id="beef")
+    network.write_message(sock, key, (("sq", 1, 1000), "ping"), "q")
+    sock.settimeout(10)
+    rid, resp = network.read_message(sock, key, "r")
+    assert rid == 1000 and resp == ("echo", "ping")
+    sock.close()
+    # resume: the welcome names how far delivery got, and the retained
+    # response is flushed again (the dying socket may have eaten it)
+    sock2, welcome = _raw_session(echo.port, key, session_id="beef")
+    try:
+        assert welcome.rx_seen == 1
+        assert echo.sessions_resumed == 1
+        sock2.settimeout(10)
+        rid, resp = network.read_message(sock2, key, "r")
+        assert rid == 1000 and resp == ("echo", "ping")
+    finally:
+        sock2.close()
+
+
+def test_stale_epoch_hello_is_refused(echo, key):
+    sock, welcome = _raw_session(echo.port, key, epoch=3)
+    sock.close()
+    assert welcome.refused
+
+
+# ----------------------------------------------------- feature-off contract --
+def test_budget_zero_is_wire_identical_to_pre_session(echo, key,
+                                                      monkeypatch):
+    """The off switch is total: with HVD_TPU_RECONNECT_BUDGET=0 (the
+    default) no hello is sent, request ids are the pre-session plain
+    ints / None, and the service never creates session state."""
+    wires = []
+    real_write = network.write_message
+
+    def recording_write(sock, k, frame, direction):
+        if direction == "q":
+            wires.append(frame)
+        return real_write(sock, k, frame, direction)
+
+    monkeypatch.setattr(network, "write_message", recording_write)
+    client = network.MuxClient([("127.0.0.1", echo.port)], key,
+                               timeout=10, reconnect_budget=0)
+    try:
+        assert client._session is None
+        client.post("fire")
+        assert client.send("ask") == ("echo", "ask")
+    finally:
+        client.close()
+    assert not any(isinstance(f[1], network.SessionHello)
+                   for f in wires), wires
+    rids = [f[0] for f in wires]
+    assert rids[0] is None                       # post: req_id None
+    assert isinstance(rids[1], int)              # send: plain int
+    assert echo._sessions == {}
+    assert echo.sessions_resumed == 0
+
+
+# ------------------------------------------------- control session healing --
+def test_control_session_heals_midstream(echo, key, capfd):
+    client = network.MuxClient([("127.0.0.1", echo.port)], key,
+                               timeout=10, peer=7, reconnect_budget=30,
+                               retry_for=10)
+    before = network.session_stats()["reconnects_healed"]
+    try:
+        for i in range(5):
+            client.post(("post", i))
+        assert client.send(("ask", 0)) == ("echo", ("ask", 0))
+        # cut the live socket out from under the client: the reader
+        # wakes with an error and heals in place; the next writes ride
+        # the healed session
+        with client._state_lock:
+            _sever(client._sock)
+        for i in range(5, 10):
+            client.post(("post", i))
+        assert client.send(("ask", 1)) == ("echo", ("ask", 1))
+        _wait_for(lambda: len([r for r in echo.received()
+                               if r[0] == "post"]) >= 10,
+                  msg="post delivery")
+    finally:
+        client.close()
+    healed = network.session_stats()["reconnects_healed"] - before
+    assert healed >= 1
+    assert echo.sessions_resumed >= 1
+    # exactly-once: every post delivered once, in order
+    posts = [r for r in echo.received() if r[0] == "post"]
+    assert posts == [("post", i) for i in range(10)]
+    err = capfd.readouterr().err
+    assert "[hvd-session] reconnect healed toward peer 7" in err
+
+
+def test_send_blocked_across_the_break_still_completes(echo, key):
+    """A request already in flight when the connection dies must
+    complete after the heal — its response is retained by the service
+    and redelivered on resume, so the waiter never sees the break."""
+
+    class SlowEcho(EchoService):
+        def _handle(self, req, client_address):
+            if req == "slow":
+                time.sleep(1.0)
+            return super()._handle(req, client_address)
+
+    svc = SlowEcho(key)
+    client = network.MuxClient([("127.0.0.1", svc.port)], key,
+                               timeout=10, reconnect_budget=30,
+                               retry_for=10)
+    try:
+        out = [None]
+
+        def ask():
+            out[0] = client.send("slow", timeout=20)
+
+        t = threading.Thread(target=ask)
+        t.start()
+        _wait_for(lambda: len(svc.received()) >= 1, msg="slow arrival")
+        with client._state_lock:
+            _sever(client._sock)
+        t.join(20)
+        assert not t.is_alive(), "send never completed across the heal"
+        assert out[0] == ("echo", "slow")
+    finally:
+        client.close()
+        svc.shutdown()
+
+
+# --------------------------------------------------- bulk session healing --
+class Hdr:
+    """Bulk header carrier: the raw-frame reader injects the payload
+    bytes into the ``payload`` slot (tuples can't carry one)."""
+
+    def __init__(self, tag):
+        self.tag = tag
+        self.payload = None
+
+
+class BulkLedger(network.MuxService):
+    """Collects bulk frame tags in arrival order."""
+
+    def __init__(self, key):
+        self.tags = []
+        self.tags_lock = threading.Lock()
+        super().__init__("bulk ledger", key)
+
+    def _handle(self, req, client_address):
+        with self.tags_lock:
+            self.tags.append(req.tag)
+        return network.AckResponse()
+
+    def seen_tags(self):
+        with self.tags_lock:
+            return list(self.tags)
+
+
+def test_bulk_session_heals_exactly_once_in_order(key, capfd):
+    svc = BulkLedger(key)
+    client = network.StripeClient([("127.0.0.1", svc.port)], key,
+                                  timeout=10, peer=3,
+                                  reconnect_budget=30, retry_for=10)
+    payload = b"\x5a" * 4096
+    before = network.session_stats()["reconnects_healed"]
+    try:
+        for i in range(20):
+            client.post_bulk(Hdr(i), payload)
+        with client._lock:
+            _sever(client._sock)
+        for i in range(20, 25):
+            client.post_bulk(Hdr(i), payload)
+        _wait_for(lambda: len(svc.seen_tags()) >= 25, msg="bulk frames")
+        time.sleep(0.2)
+        assert svc.seen_tags() == list(range(25))
+    finally:
+        client.close()
+        svc.shutdown()
+    assert network.session_stats()["reconnects_healed"] - before >= 1
+    assert "[hvd-session] reconnect healed toward peer 3" in \
+        capfd.readouterr().err
+
+
+def test_bulk_acks_prune_the_replay_buffer(key):
+    """The service acks every _SESSION_ACK_EVERY delivered frames; the
+    stripe's ack reader prunes the replay buffer so steady-state memory
+    stays bounded by the unacked window, not the transfer size."""
+    svc = BulkLedger(key)
+    client = network.StripeClient([("127.0.0.1", svc.port)], key,
+                                  timeout=10, reconnect_budget=30,
+                                  retry_for=10)
+    try:
+        for i in range(40):
+            client.post_bulk(Hdr(i), b"x" * 1024)
+        _wait_for(lambda: client._session.acked >= 32,
+                  msg="cumulative ack")
+        with client._lock:
+            assert len(client._session._frames) <= 2 * \
+                network._SESSION_ACK_EVERY
+    finally:
+        client.close()
+        svc.shutdown()
+
+
+def test_replay_gap_escalates_original_error(key):
+    """A replay buffer too small to cover the unacked window must NOT
+    heal (resuming would silently skip the evicted frame): the
+    original write error escalates, exactly the pre-session path."""
+    svc = BulkLedger(key)
+    # 600-byte bound: frame 1 (512 B) fits; frame 2 (4 KB) evicts the
+    # whole buffer at append — including itself — so the heal's welcome
+    # (rx_seen=1) asks for a frame the sender no longer holds
+    client = network.StripeClient([("127.0.0.1", svc.port)], key,
+                                  timeout=10, reconnect_budget=5,
+                                  replay_bytes=600, retry_for=10)
+    before = network.session_stats()["reconnects_failed"]
+    try:
+        client.post_bulk(Hdr(0), b"x" * 512)
+        _wait_for(lambda: len(svc.seen_tags()) == 1, msg="first frame")
+        with client._lock:
+            _sever(client._sock)
+        with pytest.raises(OSError):
+            client.post_bulk(Hdr(1), b"x" * 4096)
+    finally:
+        client.close()
+        svc.shutdown()
+    assert network.session_stats()["reconnects_failed"] - before >= 1
+
+
+def test_epoch_bump_fences_the_heal(key):
+    """A client healing across a reconfiguration is refused by the
+    fence (its epoch is stale) and escalates the ORIGINAL error —
+    replaying a torn-down ring's frames into the new epoch would
+    corrupt it."""
+    from horovod_tpu.ops.tcp_dataplane import PeerService
+
+    svc = PeerService(key, epoch=0)
+    client = network.StripeClient([("127.0.0.1", svc.port)], key,
+                                  timeout=10, epoch=0,
+                                  reconnect_budget=5, retry_for=10)
+    try:
+        from horovod_tpu.ops.tcp_dataplane import ChunkMsg
+
+        client.post_bulk(ChunkMsg((1, "rs", 0), 0, None), b"x" * 256)
+        # reconfiguration: the plane moves to epoch 1
+        svc._epoch = 1
+        with client._lock:
+            _sever(client._sock)
+        with pytest.raises(OSError):
+            client.post_bulk(ChunkMsg((1, "rs", 1), 0, None), b"x" * 256)
+    finally:
+        client.close()
+        svc.shutdown()
+
+
+def test_budget_exhaustion_escalates_after_the_window(key):
+    """No service to come back to: the heal loop burns its budget and
+    escalates the original error instead of hanging forever."""
+    svc = BulkLedger(key)
+    port = svc.port
+    client = network.StripeClient([("127.0.0.1", port)], key,
+                                  timeout=1, reconnect_budget=1.0,
+                                  retry_for=2)
+    try:
+        client.post_bulk(Hdr(0), b"x" * 256)
+        svc.shutdown()
+        with client._lock:
+            _sever(client._sock)
+        start = time.monotonic()
+        with pytest.raises(OSError):
+            client.post_bulk(Hdr(1), b"x" * 256)
+        elapsed = time.monotonic() - start
+        assert elapsed >= 0.9, f"gave up before the budget: {elapsed}"
+    finally:
+        client.close()
+
+
+def test_healing_peers_registry_reports_in_flight_heals(key):
+    """While a heal is in flight the peer shows up in
+    healing_peers() and the process reads busy — the heartbeat carries
+    both so the coordinator widens the liveness deadline instead of
+    reading the recovery pause as death."""
+    from horovod_tpu.common import busy
+
+    svc = BulkLedger(key)
+    client = network.StripeClient([("127.0.0.1", svc.port)], key,
+                                  timeout=1, peer=5,
+                                  reconnect_budget=3.0, retry_for=2)
+    try:
+        client.post_bulk(Hdr(0), b"x" * 256)
+        svc.shutdown()
+        with client._lock:
+            _sever(client._sock)
+        raised = []
+
+        def post():
+            try:
+                client.post_bulk(Hdr(1), b"x" * 256)
+            except OSError as exc:
+                raised.append(exc)
+
+        t = threading.Thread(target=post)
+        t.start()
+        _wait_for(lambda: 5 in network.healing_peers(), timeout=2.5,
+                  msg="healing registry entry")
+        assert busy.active()
+        t.join(10)
+        assert not t.is_alive()
+        assert raised, "budget exhaustion must escalate"
+        assert 5 not in network.healing_peers()
+        assert not busy.active()
+    finally:
+        client.close()
+
+
+def test_session_stats_snapshot_shape():
+    stats = network.session_stats()
+    for k in ("reconnects_healed", "reconnects_failed",
+              "frames_replayed"):
+        assert k in stats and stats[k] >= 0

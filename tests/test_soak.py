@@ -60,6 +60,39 @@ def test_generate_spec_old_seed_is_byte_identical():
     no_groups = generate_spec(7, 4, 3, elastic=True,
                               coord_failover=True)
     assert stacked.startswith(no_groups + ",")
+    # the mid-stream break cells (ISSUE 17) draw strictly after every
+    # pre-existing cell: without --blips the spec is byte-identical to
+    # older trees, with it the cells append after the same prefix
+    assert generate_spec(7, 4, 3, elastic=True, blips=0) == want
+    with_blips = generate_spec(7, 4, 3, elastic=True, blips=2)
+    assert with_blips.startswith(want + ",")
+    assert with_blips == generate_spec(7, 4, 3, elastic=True, blips=2)
+    full_stack = generate_spec(7, 4, 3, elastic=True,
+                               coord_failover=True, groups=True,
+                               blips=1)
+    assert full_stack.startswith(stacked + ",")
+
+
+def test_generate_spec_blip_cells_parse_and_spare_rank0():
+    """The mid-stream break cells must land on the link point with a
+    reset/blip action on a non-coordinator rank (cutting the
+    coordinator's links turns a heal soak into a liveness test)."""
+    from horovod_tpu.common import faults
+    from horovod_tpu.run.chaos import generate_spec
+
+    for seed in range(8):
+        base = generate_spec(seed, 8, 2)
+        spec = generate_spec(seed, 8, 2, blips=3)
+        assert spec.startswith(base + ",")
+        cells = faults.parse_fault_spec(spec[len(base) + 1:])
+        assert len(cells) == 3
+        for cell in cells:
+            assert cell.point == "link"
+            assert cell.action in ("reset", "blip")
+            assert cell.rank != 0
+            if cell.action == "reset":
+                assert 0.0 < float(cell.param) <= 1.0
+                assert cell.duration is not None and cell.duration > 0
 
 
 def test_generate_spec_group_cell_parses_and_spares_rank0():
@@ -104,11 +137,16 @@ def test_soak_chaos_schedule_is_deterministic_and_rank0_safe():
         # rank 0 hosts the coordinator: afflicting it turns the soak's
         # "no false positives" criterion into a guaranteed real abort
         assert 0 not in cast.values()
-        assert len(set(cast.values())) == 4
+        # the four base casualties stay distinct; the reset victim must
+        # SURVIVE the soak (a healed link on a rank that later dies
+        # proves nothing), so it may not be the crash/preempt rank
+        base = {cast[k] for k in ("crash", "preempt", "delay", "flaky")}
+        assert len(base) == 4
+        assert cast["reset"] not in {cast["crash"], cast["preempt"]}
         from horovod_tpu.common import faults
         parsed = faults.parse_fault_spec(spec)
         assert {s.action for s in parsed} == {
-            "crash", "preempt", "delay", "flaky"}
+            "crash", "preempt", "delay", "flaky", "reset"}
 
 
 def test_hvd_chaos_cli_exposes_degrade_flag():
@@ -122,6 +160,7 @@ def test_hvd_chaos_cli_exposes_degrade_flag():
         capture_output=True, text=True, timeout=60)
     assert out.returncode == 0
     assert "--degrade" in out.stdout
+    assert "--blips" in out.stdout
 
 
 # ----------------------------------------------------------- slow legs ------
