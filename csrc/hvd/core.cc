@@ -6,11 +6,17 @@
 namespace hvd {
 
 // ------------------------------------------------------------ ResponseCache
+// sig-exempt: compression, schedule, group, group_ranks, ring — the
+// native Request does not carry the wire/transport knobs: the Python
+// layer resolves them before dispatch and the native plane keys on the
+// tensor facts only (message.h:78).
+// req-exempt: JOIN — joins never travel through the native collective
+// dispatch; the native core has no elastic path.
 bool ResponseCache::Matches(const Signature& sig, const Request& req) const {
   return sig.type == req.type && sig.dtype == req.dtype &&
          sig.shape == req.shape && sig.op == req.op &&
          sig.root_rank == req.root_rank && sig.prescale == req.prescale &&
-         sig.postscale == req.postscale;
+         sig.postscale == req.postscale && sig.splits == req.splits;
 }
 
 ResponseCache::State ResponseCache::Lookup(const Request& req) const {
@@ -35,7 +41,8 @@ int ResponseCache::Put(const Request& req) {
     lru_.push_front(req.name);
     it->second.first = Signature{req.type,      req.dtype,   req.shape,
                                  req.op,        req.root_rank, req.prescale,
-                                 req.postscale, it->second.first.bit};
+                                 req.postscale, req.splits,
+                                 it->second.first.bit};
     it->second.second = lru_.begin();
     return it->second.first.bit;
   }
@@ -48,7 +55,8 @@ int ResponseCache::Put(const Request& req) {
   entries_.emplace(req.name,
                    std::make_pair(Signature{req.type, req.dtype, req.shape,
                                             req.op, req.root_rank,
-                                            req.prescale, req.postscale, bit},
+                                            req.prescale, req.postscale,
+                                            req.splits, bit},
                                   lru_.begin()));
   return bit;
 }

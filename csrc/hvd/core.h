@@ -105,6 +105,7 @@ class ResponseCache {
     ReduceOp op;
     int32_t root_rank;
     double prescale, postscale;
+    std::vector<int64_t> splits;
     int bit;  // stable position for cross-rank bitvector agreement
   };
   bool Matches(const Signature& sig, const Request& req) const;

@@ -288,6 +288,9 @@ class MetaCoordinatorService(network.MuxService):
             self._acked[msg.pid] = max(self._acked.get(msg.pid, 0),
                                        msg.last_seq)
             self._trim_log()
+            # req-exempt: JOIN — joins never travel through the
+            # collective dispatch; they ride CycleMsg as the
+            # joined-rank report folded in right here (docs/elastic.md)
             if msg.join_epoch == self._join_epoch:
                 for r in msg.joined:
                     if r not in self._joined:
@@ -465,6 +468,12 @@ class MetaCoordinatorService(network.MuxService):
     def _validate(self, key, entry):  # holds: self._cv
         """Cross-process agreement (reference: ConstructResponse,
         controller.cc:378).  Returns (error, meta)."""
+        # sig-exempt: group, group_ranks — agreement is structural here:
+        # the entry table is keyed by (group, tensor), so requests from
+        # different groups can never land in the same entry to disagree
+        # sig-exempt: ring — the ring flag is tcp-transport-local wire
+        # negotiation; the global mesh validates at the meta layer and
+        # has no ring path to disagree about
         gid, name = key
         # a group entry's world is its member list in spec order; dims /
         # splits matrices are emitted in THAT order so every process

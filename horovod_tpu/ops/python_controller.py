@@ -62,6 +62,10 @@ class EagerRequest:
         """Everything validation checks, flattened into a hashable key
         (reference: ``response_cache.h:45`` — cache key is tensor name +
         params)."""
+        # sig-exempt: ring — the ring flag is tcp-transport-local wire
+        # negotiation; the in-process plane executes through XLA and
+        # has no ring path to disagree about
+
         tensor = self.tensor
         shape = tuple(tensor.shape) if tensor is not None else None
         dtype = np.dtype(tensor.dtype).name if tensor is not None else None
@@ -216,6 +220,9 @@ class PythonController:
             self._queue.append(request)
         self._wakeup.set()
 
+    # req-exempt: JOIN — joins never travel through the collective
+    # dispatch; they arrive via this dedicated entry point and fold
+    # into negotiation as the joined-rank set (docs/elastic.md)
     def join(self, rank, handle):
         with self._lock:
             self._joined.add(rank)

@@ -101,6 +101,11 @@ class CollectiveMsg:
         self.schedule = schedule        # requested collective schedule
 
 
+# epoch-exempt: responses ride the fenced request's connection — the
+# coordinator only writes a ResultMsg back on the socket that carried a
+# CollectiveMsg already admitted past the epoch fence in
+# _handle_collective, so a stale-epoch result cannot reach a re-formed
+# world's rank
 class ResultMsg:
     def __init__(self, payload=None, shape=None, dtype=None, error=None,
                  recv_splits=None, ring_go=False, participants=None,
@@ -137,22 +142,37 @@ class ResultMsg:
         self.groups = groups
 
 
+# epoch-exempt: join barriers run inside one epoch by construction —
+# the coordinator address is published under an epoch-suffixed
+# rendezvous scope (run/rendezvous.py) and the session hello fences
+# resumed connections, so a JoinMsg can only reach the coordinator of
+# the epoch it was minted in
 class JoinMsg:
     def __init__(self, rank):
         self.rank = rank
 
 
+# epoch-exempt: reply half of the JoinMsg barrier above — rides the
+# fenced join connection
 class JoinDoneMsg:
     def __init__(self, last_rank, abort=None):
         self.last_rank = last_rank
         self.abort = abort              # (origin_rank, reason) | None
 
 
+# epoch-exempt: teardown is epoch-agnostic by design — a shutdown must
+# deregister the rank whichever epoch the frame was minted in, and
+# acting on a straggler shutdown is idempotent (the rank is gone either
+# way)
 class ShutdownMsg:
     def __init__(self, rank=None):
         self.rank = rank  # deregisters the rank from liveness tracking
 
 
+# epoch-exempt: drain intent is epoch-agnostic by design — the rank is
+# leaving whichever world it lands in; the reconfiguration it triggers
+# mints the next epoch itself, and a duplicate/straggler drain for an
+# already-departed rank is a no-op
 class DrainMsg:
     """A rank announces planned departure: it received the preemption
     notice (SIGTERM) and asks the coordinator to reconfigure the job
@@ -2026,6 +2046,9 @@ class TcpController:
             self._timeline.end(request.name, {"bytes": arr.nbytes})
         return out
 
+    # req-exempt: JOIN — joins never travel through the collective
+    # dispatch; they cross the wire as the dedicated JoinMsg barrier
+    # below (docs/elastic.md)
     def join(self, rank, handle):
         def run():
             try:

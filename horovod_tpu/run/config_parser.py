@@ -92,6 +92,8 @@ _PARAMS = {
     "race_seed": (env_util.HVD_TPU_RACE_SEED, "race.seed"),
     "race_scope": (env_util.HVD_TPU_RACE_SCOPE, "race.scope"),
     "race_report": (env_util.HVD_TPU_RACE_REPORT, "race.report_prefix"),
+    "proto_depth": (env_util.HVD_TPU_PROTO_DEPTH, "proto.depth"),
+    "proto_seed": (env_util.HVD_TPU_PROTO_SEED, "proto.seed"),
 }
 
 # negation flags -> env var forced to "0" (reference: --no-autotune etc.)

@@ -372,6 +372,18 @@ def make_parser():
                            "each rank writes its race findings to "
                            "<prefix>.<pid>.json at exit.")
 
+    proto = parser.add_argument_group("protocol checking")
+    proto.add_argument("--proto-depth", type=int, default=None,
+                       help="bin/hvd-proto model-checker exploration "
+                            "bound in steps (HVD_TPU_PROTO_DEPTH, "
+                            "default 10); see "
+                            "docs/protocol_checking.md.")
+    proto.add_argument("--proto-seed", type=int, default=None,
+                       help="bin/hvd-proto exploration tie-break seed "
+                            "(HVD_TPU_PROTO_SEED, default 0): same "
+                            "seed + depth give a byte-identical "
+                            "report.")
+
     stall = parser.add_argument_group("stall check")
     stall.add_argument("--no-stall-check", action="store_true", default=None)
     stall.add_argument("--stall-check", action="store_true", default=None,

@@ -67,12 +67,20 @@ class AckResponse:
 # coordinators (docs/fault_tolerance.md): any rank can broadcast an
 # abort for the in-flight round; heartbeats keep the coordinator's
 # last-seen table fresh and carry the abort state back.
+# epoch-exempt: the abort channel is epoch-agnostic by design — a
+# world dying at epoch N must be able to kill collectives on ranks that
+# already adopted N+1; fencing it would strand exactly the straggler
+# ranks an abort exists to release (docs/fault_tolerance.md)
 class AbortMsg:
     def __init__(self, origin_rank, reason):
         self.origin_rank = origin_rank
         self.reason = reason
 
 
+# epoch-exempt: liveness must keep flowing across reconfiguration
+# boundaries — the coordinator's last-seen table is how a rank that
+# died MID-reconfiguration gets detected, so heartbeats deliberately
+# cross epochs (docs/fault_tolerance.md)
 class HeartbeatMsg:
     def __init__(self, rank, busy=False, rtt=None, host=None,
                  reconnecting=None):
@@ -604,6 +612,8 @@ class BasicService:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
+                    # wakeable: server shutdown closes the listener and
+                    # every accepted socket, breaking this read
                     req = read_message(self.request, service._key, "q")
                 except (PermissionError, ConnectionError, EOFError):
                     return  # drop unauthenticated/broken peers silently
@@ -852,6 +862,8 @@ class MuxService(BasicService):
                 first = True
                 while True:
                     try:
+                        # wakeable: shutdown() and a session resume both
+                        # close this socket, breaking the blocked read
                         frame = read_message(sock, service._key, "q")
                     except (PermissionError, ConnectionError, EOFError,
                             OSError):
@@ -959,6 +971,8 @@ class MuxService(BasicService):
         again, ack cumulatively every few deliveries."""
         while True:
             try:
+                # wakeable: the next resume for this session (and
+                # shutdown) closes this socket, breaking the read
                 frame = read_message(sock, self._key, "q")
             except (PermissionError, ConnectionError, EOFError, OSError):
                 return
@@ -1308,6 +1322,8 @@ class MuxClient:
     def _read_loop(self, sock):
         while True:
             try:
+                # wakeable: close() severs this socket, which breaks
+                # the blocked read; a heal hands the loop a new socket
                 frame = read_message(sock, self._key, "r")
                 if not (isinstance(frame, tuple) and len(frame) == 2):
                     raise ConnectionError(
@@ -1570,6 +1586,8 @@ class StripeClient:
         quietly when its socket dies (the writer path owns healing)."""
         while True:
             try:
+                # wakeable: per-socket daemon; the writer path closes
+                # this socket on heal/teardown, breaking the read
                 frame = read_message(sock, self._key, "r")
             except Exception:  # noqa: BLE001 — socket gone
                 return

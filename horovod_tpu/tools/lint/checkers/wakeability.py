@@ -17,6 +17,14 @@ produce.  Every ``Condition.wait`` / ``Event.wait`` / ``queue.get`` /
 
 Socket ``recv``/``recv_into`` can never express a timeout at the call
 site, so those always need the annotation.
+
+The session layer's framed reads (``read_message`` /
+``read_bulk_message``) are blocking socket reads one level up: a call
+is bounded when the same function arms a real ``settimeout`` (a
+non-None argument) on a socket, and otherwise needs the ``# wakeable:``
+registration naming what breaks the read — for the resume handshake
+and the replay/ack pumps that is the socket close the healing or
+aborting side performs.
 """
 
 import ast
@@ -27,6 +35,28 @@ from horovod_tpu.tools.lint.findings import Finding
 NAME = "abort-wakeability"
 
 _SOCKET_NAMES = {"sock", "s", "conn", "connection"}
+_FRAMED_READS = {"read_message", "read_bulk_message"}
+
+
+def _bounded_by_settimeout(funcdef):
+    """Whether this function arms a real socket timeout: a
+    ``<sock>.settimeout(x)`` call with ``x`` not the constant None
+    (nested defs excluded — they are scanned as their own functions)."""
+    stack = list(funcdef.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            callee = model.expr_text(node.func) or ""
+            if callee.rsplit(".", 1)[-1] == "settimeout" and node.args:
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and arg.value is None):
+                    return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
 
 
 def _local_kinds(funcdef):
@@ -88,6 +118,7 @@ def check(project, config):
         for ctx, cls, funcdef in model.iter_functions(module):
             attrs = project.class_lock_attrs(cls) if cls else {}
             locals_ = _local_kinds(funcdef)
+            has_socket_timeout = _bounded_by_settimeout(funcdef)
 
             def kind_of(base):
                 tail = base.rsplit(".", 1)[-1]
@@ -106,7 +137,27 @@ def check(project, config):
                         node, ast.Call):
                     return
                 callee = model.expr_text(node.func)
-                if callee is None or "." not in callee:
+                if callee is None:
+                    return
+                if callee.rsplit(".", 1)[-1] in _FRAMED_READS:
+                    # a framed read blocks on the socket one level up;
+                    # bounded only by an armed settimeout in the same
+                    # function
+                    if has_socket_timeout:
+                        return
+                    if module.is_wakeable_annotated(node.lineno) \
+                            or module.has_ignore(node.lineno, NAME):
+                        return
+                    findings.append(Finding(
+                        NAME, module.relpath, node.lineno, _ctx,
+                        callee.rsplit(".", 1)[-1],
+                        f"blocking framed read {callee}() with no armed "
+                        f"settimeout in the function and no "
+                        f"'# wakeable:' registration — a coordinated "
+                        f"abort cannot wake it "
+                        f"(docs/fault_tolerance.md)"))
+                    return
+                if "." not in callee:
                     return
                 base, meth = callee.rsplit(".", 1)
                 kind = kind_of(base)

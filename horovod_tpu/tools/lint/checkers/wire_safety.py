@@ -17,6 +17,20 @@ that leaves a socket is HMAC-signed.  Statically:
 - **unsigned-send**: inside the transport module, a frame-emitting
   function that never calls ``secret.sign``/``sign_parts`` (annotate
   helpers that only forward pre-signed bytes).
+
+Session-layer rules (the self-healing transport's resume handshake,
+docs/fault_tolerance.md):
+
+- **unfenced-resume**: a function that constructs a ``SessionWelcome``
+  admits a resuming connection — it must fence the hello against the
+  service epoch (call ``session_epoch`` or compare an ``.epoch``
+  attribute), or a post-reconfiguration straggler resumes into the new
+  world.
+- **unchecked-replay**: ``replayable_from`` returns ``None`` when the
+  replay buffer no longer holds a frame the service needs — a caller
+  that never does an ``is None`` / ``is not None`` check would iterate
+  the sentinel or, worse, treat the gap as "nothing to replay" and
+  silently skip frames.
 """
 
 import ast
@@ -45,6 +59,36 @@ def _function_calls(funcdef):
     return out
 
 
+def _has_epoch_fence(names):
+    """Whether the function touches the resume fence: a session_epoch
+    call, or a comparison reading an ``.epoch`` attribute (checked by
+    the caller over Compare nodes)."""
+    return any(t.rsplit(".", 1)[-1] == "session_epoch" for t, _ in names)
+
+
+def _compares_epoch_attr(funcdef):
+    for node in ast.walk(funcdef):
+        if isinstance(node, ast.Compare):
+            for operand in [node.left] + list(node.comparators):
+                for sub in ast.walk(operand):
+                    if isinstance(sub, ast.Attribute) \
+                            and sub.attr == "epoch":
+                        return True
+    return False
+
+
+def _has_none_check(funcdef):
+    for node in ast.walk(funcdef):
+        if isinstance(node, ast.Compare) \
+                and any(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops):
+            for operand in [node.left] + list(node.comparators):
+                if isinstance(operand, ast.Constant) \
+                        and operand.value is None:
+                    return True
+    return False
+
+
 def check(project, config):
     findings = []
     allowlist = config.get("wire_pickle_allowlist") or []
@@ -53,6 +97,36 @@ def check(project, config):
         for ctx, _cls, funcdef in model.iter_functions(module):
             calls = _function_calls(funcdef)
             names = [(model.expr_text(c.func) or "", c) for c in calls]
+
+            welcomes = [c for t, c in names
+                        if t.rsplit(".", 1)[-1] == "SessionWelcome"]
+            if welcomes and not _has_epoch_fence(names) \
+                    and not _compares_epoch_attr(funcdef):
+                call = welcomes[0]
+                if not (module.is_wire_safe_annotated(call.lineno)
+                        or module.has_ignore(call.lineno, NAME)):
+                    findings.append(Finding(
+                        NAME, module.relpath, call.lineno, ctx,
+                        "unfenced-resume",
+                        "SessionWelcome constructed with no epoch fence "
+                        "in the function (no session_epoch call, no "
+                        ".epoch comparison) — a post-reconfiguration "
+                        "straggler could resume into the new world "
+                        "(docs/fault_tolerance.md)"))
+
+            replays = [c for t, c in names
+                       if t.rsplit(".", 1)[-1] == "replayable_from"]
+            if replays and not _has_none_check(funcdef):
+                call = replays[0]
+                if not (module.is_wire_safe_annotated(call.lineno)
+                        or module.has_ignore(call.lineno, NAME)):
+                    findings.append(Finding(
+                        NAME, module.relpath, call.lineno, ctx,
+                        "unchecked-replay",
+                        "replayable_from() result never is-None "
+                        "checked — a replay-buffer gap returns the "
+                        "None sentinel and must refuse the resume, "
+                        "not be treated as an empty replay"))
             has_check = any(
                 t.rsplit(".", 1)[-1] in ("check", "check_parts")
                 and ("secret" in t or "." not in t)

@@ -1,0 +1,26 @@
+"""hvd-proto — distributed-protocol static analysis + bounded model
+checking for the control plane (docs/protocol_checking.md).
+
+Two halves behind one CLI (``bin/hvd-proto``), riding hvd-lint's
+findings/baseline machinery verbatim:
+
+1. **Protocol-invariant checkers** over the real source
+   (``tools/proto/checkers/``): epoch-fencing, signature-parity,
+   request-exhaustiveness, collective-divergence.  Each consumes the
+   shared AST core (``tools/lint/model.py``) and emits
+   :class:`~horovod_tpu.tools.lint.findings.Finding` objects whose keys
+   feed the same baseline-suppression workflow as hvd-lint.
+
+2. **A bounded explicit-state model checker** (``tools/proto/mc.py``)
+   over the five hand-maintained distributed protocols written as small
+   message-passing transition systems (``tools/proto/protocols.py``):
+   abort fan-out, elastic reconfiguration with epoch fencing, the
+   leader-election CAS, graceful drain, and the sequence-numbered
+   session/replay layer.  Exhaustive exploration at N=2..4 with
+   crash/loss/reorder events; counterexamples render as
+   ``HVD_TPU_FAULT_SPEC``-style schedules (common/faults.py grammar).
+
+Determinism contract (same as hvd-race): the same seed and flags
+produce a byte-identical report — ``HVD_TPU_PROTO_SEED`` orders the
+exploration frontier, ``HVD_TPU_PROTO_DEPTH`` bounds it.
+"""

@@ -1,0 +1,39 @@
+"""hvd-proto checker registry.  Same contract as the hvd-lint registry
+(``tools/lint/checkers``): every checker module exposes
+
+    NAME: str                      # the id used in annotations/--checkers
+    check(project, config) -> [Finding]
+
+``config`` keys (all optional — a missing key means the fixture-test
+default of "every loaded module"; the project policy in ``cli.py``
+narrows each checker to the protocol surfaces it encodes):
+
+- ``msg_modules``: relpath suffixes scanned for ``*Msg`` wire classes
+  (epoch-fencing)
+- ``parity_surfaces``: the per-plane signature/cache-key extraction
+  functions (signature-parity); ``native_signature``: the C++ response
+  cache source diffed alongside them
+- ``exhaustive_surfaces``: per-plane dispatch modules and the enum each
+  must cover (request-exhaustiveness); ``enum_module``: where the enum
+  classes are defined; ``native_dispatch``: the C++ dispatch source
+- ``divergence_modules``: relpath suffixes scanned for rank-conditional
+  collective divergence
+- ``proto_depth`` / ``proto_seed`` / ``proto_ns``: model-checker bounds
+  (model-check)
+"""
+
+from horovod_tpu.tools.proto.checkers import (
+    collective_divergence,
+    epoch_fencing,
+    request_exhaustiveness,
+    signature_parity,
+)
+from horovod_tpu.tools.proto import mc
+
+ALL_CHECKERS = {
+    epoch_fencing.NAME: epoch_fencing,
+    signature_parity.NAME: signature_parity,
+    request_exhaustiveness.NAME: request_exhaustiveness,
+    collective_divergence.NAME: collective_divergence,
+    mc.NAME: mc,
+}

@@ -1,0 +1,100 @@
+"""Checker 4 — collective divergence.
+
+The classic cross-rank deadlock: a collective called under a
+rank-conditional branch with no matching collective on the other arm —
+rank 0 enters the allreduce, every other rank skips it, and the world
+hangs at the negotiation barrier until the stall detector aborts the
+job.  Statically: for every ``if`` whose test reads a rank (``rank ==
+0``, ``hvd.rank() != root``, ``self._rank``...), the multiset of
+collective invocations must match between the two arms.
+
+A deliberate asymmetry (the coordinator-side bootstrap that only rank 0
+runs BEFORE the world exists, a broadcast-from-root helper where the
+non-root arm receives through the same collective) is annotated
+``# divergence-ok: <why>`` on the ``if`` line (or the comment block
+above it).
+
+Uses the lint core's CFG-lite walk: nested function definitions run on
+other call stacks and do not count as "the other arm executing the
+collective".
+"""
+
+import ast
+import re
+
+from horovod_tpu.tools.lint import model
+from horovod_tpu.tools.lint.findings import Finding
+
+NAME = "collective-divergence"
+
+_COLLECTIVES = {
+    "allreduce", "allgather", "broadcast", "alltoall", "adasum",
+    "reduce_scatter", "grouped_allreduce", "allreduce_async",
+    "allgather_async", "broadcast_async", "alltoall_async",
+    "reduce_scatter_async", "barrier", "join",
+}
+_OK_RE = re.compile(r"divergence-ok:")
+
+
+def _reads_rank(test):
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and "rank" in node.id.lower():
+            return True
+        if isinstance(node, ast.Attribute) \
+                and "rank" in node.attr.lower():
+            return True
+    return False
+
+
+def _collectives_in(stmts):
+    """Collective callee tails invoked in a statement list (nested defs
+    excluded — they run on other call stacks)."""
+    out = []
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            text = model.expr_text(node.func)
+            if text is not None:
+                tail = text.rsplit(".", 1)[-1]
+                if tail in _COLLECTIVES:
+                    out.append(tail)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def check(project, config):
+    findings = []
+    scope = config.get("divergence_modules")
+    for module in project.modules.values():
+        if not model.in_scope(module, scope):
+            continue
+        for ctx, _cls, funcdef in model.iter_functions(module):
+            for node in ast.walk(funcdef):
+                if not isinstance(node, ast.If):
+                    continue
+                if not _reads_rank(node.test):
+                    continue
+                if module.annotated(node.lineno, _OK_RE) \
+                        or module.has_ignore(node.lineno, NAME):
+                    continue
+                body = _collectives_in(node.body)
+                orelse = _collectives_in(node.orelse)
+                only_body = sorted(set(body) - set(orelse))
+                only_else = sorted(set(orelse) - set(body))
+                for name in only_body + only_else:
+                    arm = "if" if name in only_body else "else"
+                    other = "else" if arm == "if" else "if"
+                    findings.append(Finding(
+                        NAME, module.relpath, node.lineno, ctx,
+                        f"{name}:{arm}-arm",
+                        f"collective {name}() runs only on the {arm} "
+                        f"arm of a rank-conditional branch — ranks "
+                        f"taking the {other} arm never enter it and "
+                        f"the world deadlocks at the negotiation "
+                        f"barrier (annotate '# divergence-ok: <why>' "
+                        f"for deliberate asymmetry)"))
+    return findings

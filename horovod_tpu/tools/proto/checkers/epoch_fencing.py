@@ -1,0 +1,190 @@
+"""Checker 1 — epoch fencing.
+
+The elastic-membership contract (docs/elastic.md): after a
+reconfiguration the world re-forms at epoch N+1, and a straggler frame
+from the torn-down epoch must never act on the new world's state.  Any
+wire-message class (the ``*Msg`` naming convention of the tcp, gmesh
+and data-plane protocols) that crosses a reconfigurable boundary must
+therefore
+
+- carry an epoch field (``epoch`` or ``join_epoch``), AND
+- have at least one dispatch site (an ``isinstance(req, XMsg)`` branch,
+  or the handler method it delegates to) compare that field against the
+  service's current epoch,
+
+or be annotated ``# epoch-exempt: <why>`` at the class definition for
+messages that are epoch-agnostic by design (responses riding the fenced
+request's connection, the liveness/abort channel, messages that can
+only reach a service through an epoch-suffixed rendezvous scope).
+
+Findings:
+
+- **missing-epoch**: a ``*Msg`` class with no epoch field and no
+  exemption annotation;
+- **no-dispatch-check**: an epoch-carrying class no scanned module ever
+  dispatches on (dead fence — nothing reads the field);
+- **unfenced-dispatch**: an epoch-carrying class whose dispatch sites
+  never compare the field (the fence exists on the wire but not in the
+  code).
+"""
+
+import ast
+import re
+
+from horovod_tpu.tools.lint import model
+from horovod_tpu.tools.lint.findings import Finding
+
+NAME = "epoch-fencing"
+
+_EPOCH_FIELDS = ("epoch", "join_epoch")
+_EXEMPT_RE = re.compile(r"epoch-exempt:")
+
+
+def _epoch_field(cls):
+    """The epoch attribute a message class carries, or None."""
+    for node in cls.node.body:
+        if isinstance(node, ast.Assign):  # __slots__ tuple
+            for target in node.targets:
+                if isinstance(target, ast.Name) \
+                        and target.id == "__slots__":
+                    for const in ast.walk(node.value):
+                        if isinstance(const, ast.Constant) \
+                                and const.value in _EPOCH_FIELDS:
+                            return const.value
+    init = cls.methods.get("__init__")
+    if init is not None:
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if (isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self"
+                            and target.attr in _EPOCH_FIELDS):
+                        return target.attr
+    # dataclass-style annotated field
+    for node in cls.node.body:
+        if isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id in _EPOCH_FIELDS:
+            return node.target.id
+    return None
+
+
+def _compares_epoch(funcdef):
+    """Whether the function fences: a comparison whose operand reads an
+    epoch field — ``req.epoch != self._epoch``, ``msg.join_epoch ==
+    self._join_epoch``, or the pre-field-tolerant ``getattr(req,
+    "epoch", 0) != ...`` spelling."""
+    def reads_epoch(node):
+        if isinstance(node, ast.Attribute) and node.attr in _EPOCH_FIELDS:
+            return True
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id == "getattr" \
+                and len(node.args) >= 2 \
+                and isinstance(node.args[1], ast.Constant) \
+                and node.args[1].value in _EPOCH_FIELDS:
+            return True
+        return False
+
+    for node in ast.walk(funcdef):
+        if isinstance(node, ast.Compare):
+            for operand in [node.left] + list(node.comparators):
+                for sub in ast.walk(operand):
+                    if reads_epoch(sub):
+                        return True
+    return False
+
+
+def _dispatch_sites(project, cls_name):
+    """(module, context, funcdef) for every function containing an
+    ``isinstance(x, cls_name)`` test (alias-qualified spellings
+    included)."""
+    out = []
+    for module in project.modules.values():
+        for ctx, owner, funcdef in model.iter_functions(module):
+            for node in ast.walk(funcdef):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "isinstance"
+                        and len(node.args) == 2):
+                    continue
+                targets = [node.args[1]]
+                if isinstance(node.args[1], ast.Tuple):
+                    targets = list(node.args[1].elts)
+                for target in targets:
+                    text = model.expr_text(target) or ""
+                    if text.rsplit(".", 1)[-1] == cls_name:
+                        out.append((module, ctx, owner, funcdef))
+                        break
+                else:
+                    continue
+                break
+    return out
+
+
+def _delegates(module, owner, funcdef):
+    """The handler methods a dispatch function hands the message to:
+    ``self.<method>(...)`` calls resolved in the same class (fences
+    routinely live in the per-message ``_handle_x`` delegate, one hop
+    from the ``isinstance`` chain)."""
+    out = []
+    if owner is None:
+        return out
+    for node in ast.walk(funcdef):
+        if isinstance(node, ast.Call):
+            text = model.expr_text(node.func) or ""
+            if text.startswith("self."):
+                method = owner.methods.get(text[len("self."):])
+                if method is not None and method is not funcdef:
+                    out.append(method)
+    return out
+
+
+def check(project, config):
+    findings = []
+    scope = config.get("msg_modules")
+    for module in project.modules.values():
+        if not model.in_scope(module, scope):
+            continue
+        for cls in module.classes.values():
+            if not cls.name.endswith("Msg"):
+                continue
+            line = cls.node.lineno
+            if module.annotated(line, _EXEMPT_RE):
+                continue
+            field = _epoch_field(cls)
+            if field is None:
+                findings.append(Finding(
+                    NAME, module.relpath, line, cls.name,
+                    "missing-epoch",
+                    f"wire message {cls.name} carries no epoch field "
+                    f"and no '# epoch-exempt:' annotation — a straggler "
+                    f"frame from a torn-down epoch could act on the "
+                    f"re-formed world (docs/elastic.md)"))
+                continue
+            sites = _dispatch_sites(project, cls.name)
+            if not sites:
+                findings.append(Finding(
+                    NAME, module.relpath, line, cls.name,
+                    "no-dispatch-check",
+                    f"{cls.name}.{field} is never read at a dispatch "
+                    f"site — no scanned module isinstance-dispatches "
+                    f"this message, so the fence field is dead"))
+                continue
+            fenced = False
+            for site_module, _ctx, owner, funcdef in sites:
+                candidates = [funcdef] + _delegates(site_module, owner,
+                                                   funcdef)
+                if any(_compares_epoch(f) for f in candidates):
+                    fenced = True
+                    break
+            if not fenced:
+                findings.append(Finding(
+                    NAME, module.relpath, line, cls.name,
+                    "unfenced-dispatch",
+                    f"{cls.name} carries '{field}' but no dispatch "
+                    f"site ever compares it against the service's "
+                    f"current epoch — the fence exists on the wire but "
+                    f"not in the code"))
+    return findings

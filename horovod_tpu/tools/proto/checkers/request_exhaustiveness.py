@@ -1,0 +1,130 @@
+"""Checker 3 — request exhaustiveness.
+
+Every controller plane dispatches on the shared ``ops_enum``
+vocabularies (``RequestType`` on the request path, ``ResponseType`` on
+the apply path).  A new member added to the enum but not to one plane's
+dispatch is a silent drop — the collective hangs on exactly one
+controller while the others negotiate it fine (REDUCE_SCATTER's rollout
+is the cautionary tale).
+
+For each configured surface this checker collects every
+``<Enum>.<MEMBER>`` reference in the dispatch module and diffs it
+against the enum's declared members.  A member a plane deliberately
+routes elsewhere (JOIN travels as ``JoinMsg`` / joined-rank reports on
+every plane, never through the collective dispatch) is exempted with a
+``# req-exempt: <MEMBER>[, <MEMBER>...] — <why>`` annotation anywhere
+in the module (``// req-exempt:`` in the C++ source, whose
+``EnumType::kCamelCase`` spellings are folded to the Python member
+names).
+
+Finding detail: ``<plane>:<Enum>.<MEMBER>``.
+"""
+
+import ast
+import os
+import re
+
+from horovod_tpu.tools.lint.findings import Finding
+
+NAME = "request-exhaustiveness"
+
+_EXEMPT_RE = re.compile(
+    r"req-exempt:\s*([A-Z0-9_]+(?:\s*,\s*[A-Z0-9_]+)*)")
+_CXX_MEMBER_RE = re.compile(r"(RequestType|ResponseType)::k([A-Za-z]+)")
+
+
+def _enum_members(project, config, enum_name):
+    """Declared member names of ``enum_name`` from the configured enum
+    module (the first loaded module defining a class of that name when
+    unconfigured — the fixture path)."""
+    suffix = config.get("enum_module")
+    modules = ([project.find_module(suffix)] if suffix
+               else list(project.modules.values()))
+    for module in modules:
+        if module is None:
+            continue
+        cls = module.classes.get(enum_name)
+        if cls is None:
+            continue
+        members = []
+        for node in cls.node.body:
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Name) \
+                            and not target.id.startswith("_"):
+                        members.append(target.id)
+        return members
+    return []
+
+
+def _referenced(module, enum_name):
+    out = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == enum_name:
+            out.add(node.attr)
+    return out
+
+
+def _exempt(source_text):
+    out = set()
+    for match in _EXEMPT_RE.finditer(source_text):
+        out.update(m.strip() for m in match.group(1).split(","))
+    return out
+
+
+def _camel_to_member(name):
+    """kReduceScatter -> REDUCE_SCATTER."""
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).upper()
+
+
+def check(project, config):
+    findings = []
+    for spec in config.get("exhaustive_surfaces") or []:
+        module = project.find_module(spec["module"])
+        if module is None:
+            continue
+        enum_name = spec["enum"]
+        members = _enum_members(project, config, enum_name)
+        if not members:
+            continue
+        seen = _referenced(module, enum_name)
+        exempt = _exempt(module.source)
+        for member in members:
+            if member in seen or member in exempt:
+                continue
+            findings.append(Finding(
+                NAME, module.relpath, 1, "<module>",
+                f"{spec['plane']}:{enum_name}.{member}",
+                f"{enum_name}.{member} is never referenced in the "
+                f"{spec['plane']} plane's dispatch — a request of that "
+                f"type would be silently dropped there (annotate "
+                f"'# req-exempt: {member} — <why>' if it is routed "
+                f"through a dedicated message instead)"))
+
+    native = config.get("native_dispatch")
+    if native and os.path.isfile(native):
+        with open(native, encoding="utf-8") as f:
+            text = f.read()
+        exempt = _exempt(text)
+        seen = {}
+        for enum_name, camel in _CXX_MEMBER_RE.findall(text):
+            seen.setdefault(enum_name, set()).add(_camel_to_member(camel))
+        rel = config.get("native_dispatch_relpath") or \
+            os.path.basename(native)
+        for enum_name in ("RequestType", "ResponseType"):
+            members = _enum_members(project, config, enum_name)
+            for member in members:
+                if member in seen.get(enum_name, set()) \
+                        or member in exempt:
+                    continue
+                findings.append(Finding(
+                    NAME, rel, 1, "<module>",
+                    f"native:{enum_name}.{member}",
+                    f"{enum_name}::k{member.title().replace('_', '')} "
+                    f"is never referenced in the native dispatch — a "
+                    f"request of that type would be silently dropped "
+                    f"(annotate '// req-exempt: {member} — <why>' if "
+                    f"routed elsewhere)"))
+    return findings

@@ -1,0 +1,181 @@
+"""Checker 2 — signature parity.
+
+Every controller plane keys its response cache / request validation /
+fusion buckets on "everything that must agree across ranks": the tcp
+coordinator's ``_signature``, the in-process ``EagerRequest.signature``,
+the gmesh coordinator's ``_validate`` metadata, and the native C++
+``ResponseCache``.  History shows fields get added to one plane and
+missed on the others (schedule, group id and compression each arrived
+that way) — a miss means a request that must NOT validate against a
+cached round silently does on one plane only.
+
+This checker extracts the field set each plane's surface actually reads
+(attribute accesses on the request object, ``getattr`` spellings
+included; ``sig.X == req.X`` comparisons for the C++ cache), normalizes
+naming differences (``prescale_factor`` -> ``prescale``, ``type`` ->
+``req_type``, ``tensor`` -> shape+dtype), and diffs each plane against
+the union.  A field a plane deliberately lacks (the tcp transport-local
+``ring`` flag; wire knobs the native in-process plane resolves before
+dispatch) is exempted with a ``# sig-exempt: <field>[, <field>...] —
+<why>`` annotation inside that plane's surface function (``//
+sig-exempt:`` in the C++ source).
+
+Finding detail: ``<plane>:<field>`` — the plane that is missing the
+field.
+"""
+
+import ast
+import os
+import re
+
+from horovod_tpu.tools.lint.findings import Finding
+
+NAME = "signature-parity"
+
+# naming differences between planes, folded to one vocabulary
+_ALIASES = {
+    "prescale_factor": "prescale",
+    "postscale_factor": "postscale",
+    "type": "req_type",
+}
+# request attributes that are identity/bookkeeping, not signature
+# material (``name`` keys the cache slot itself; ``dims0`` is allgather
+# shape plumbing already covered by ``shape``; ``epoch`` is the fencing
+# checker's domain)
+_IGNORE = {"name", "rank", "ranks", "error", "dims0", "payload", "sig",
+           "epoch", "handle", "req_id", "bit"}
+# reading ``self.tensor`` derives both wire facts the other planes read
+# directly
+_EXPAND = {"tensor": ("shape", "dtype")}
+
+_EXEMPT_RE = re.compile(
+    r"sig-exempt:\s*([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+_CXX_PAIR_RE = re.compile(r"sig\.(\w+)\s*==\s*req\.(\w+)")
+
+
+def _normalize(fields):
+    out = set()
+    for field in fields:
+        field = _ALIASES.get(field, field)
+        if field in _EXPAND:
+            out.update(_EXPAND[field])
+        elif field not in _IGNORE:
+            out.add(field)
+    return out
+
+
+def _find_function(module, dotted):
+    """('Class.method' | 'func') -> FunctionDef in ``module``."""
+    if "." in dotted:
+        cls_name, meth = dotted.split(".", 1)
+        cls = module.classes.get(cls_name)
+        return cls.methods.get(meth) if cls else None
+    for node in module.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == dotted:
+            return node
+    return None
+
+
+def _read_fields(funcdef, subjects):
+    """Attribute names the function reads off its request subject(s)."""
+    fields = set()
+    for node in ast.walk(funcdef):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in subjects:
+            fields.add(node.attr)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id == "getattr" \
+                and len(node.args) >= 2 \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in subjects \
+                and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, str):
+            fields.add(node.args[1].value)
+    return fields
+
+
+def _exempt_fields(module, funcdef):
+    """Fields named by sig-exempt annotations anywhere in the surface
+    function (or the comment block directly above its def line)."""
+    out = set()
+    lines = list(range(funcdef.lineno,
+                       (funcdef.end_lineno or funcdef.lineno) + 1))
+    above = funcdef.lineno - 1
+    while 1 <= above <= len(module.lines) \
+            and module.lines[above - 1].lstrip().startswith("#"):
+        lines.append(above)
+        above -= 1
+    for line in lines:
+        match = _EXEMPT_RE.search(module.comment(line))
+        if match:
+            out.update(f.strip() for f in match.group(1).split(","))
+    return out
+
+
+def _native_plane(path):
+    """(fields, exempt, anchor_line) from the C++ response-cache source:
+    the ``sig.X == req.X`` comparisons of ``ResponseCache::Matches`` are
+    the native plane's signature surface."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    fields = set()
+    for _sig_field, req_field in _CXX_PAIR_RE.findall(text):
+        fields.add(req_field)
+    exempt = set()
+    for match in _EXEMPT_RE.finditer(text):
+        exempt.update(f.strip() for f in match.group(1).split(","))
+    anchor = 1
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if "::Matches" in line:
+            anchor = lineno
+            break
+    return _normalize(fields), exempt, anchor
+
+
+def check(project, config):
+    surfaces = config.get("parity_surfaces") or []
+    planes = []   # (plane, relpath, line, context, fields, exempt)
+    for spec in surfaces:
+        module = project.find_module(spec["module"])
+        if module is None:
+            continue
+        funcdef = _find_function(module, spec["function"])
+        if funcdef is None:
+            continue
+        fields = _normalize(_read_fields(funcdef, set(spec["subjects"])))
+        exempt = _exempt_fields(module, funcdef)
+        planes.append((spec["plane"], module.relpath, funcdef.lineno,
+                       spec["function"], fields, exempt))
+
+    native = config.get("native_signature")
+    if native and os.path.isfile(native):
+        fields, exempt, anchor = _native_plane(native)
+        rel = config.get("native_signature_relpath") or \
+            os.path.basename(native)
+        planes.append(("native", rel, anchor, "ResponseCache::Matches",
+                       fields, exempt))
+
+    if len(planes) < 2:
+        return []   # nothing to diff against
+
+    universe = set()
+    for _plane, _path, _line, _ctx, fields, _exempt in planes:
+        universe |= fields
+
+    findings = []
+    for plane, path, line, ctx, fields, exempt in planes:
+        for field in sorted(universe - fields - exempt):
+            others = sorted(p for p, *_rest in planes
+                            if p != plane and field in _rest[3])
+            findings.append(Finding(
+                NAME, path, line, ctx, f"{plane}:{field}",
+                f"signature field '{field}' (present on plane(s) "
+                f"{', '.join(others) or 'other'}) is missing from the "
+                f"{plane} plane's signature surface — a request "
+                f"differing only in '{field}' would falsely validate "
+                f"or cache-hit there (annotate '# sig-exempt: {field} "
+                f"— <why>' if the plane cannot carry it)"))
+    return findings

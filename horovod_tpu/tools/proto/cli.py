@@ -1,0 +1,207 @@
+"""``bin/hvd-proto`` — the distributed-protocol analysis gate.
+
+Usage::
+
+    bin/hvd-proto horovod_tpu/                 # the tier-1 gate run
+    bin/hvd-proto --format json horovod_tpu/   # machine-readable
+    bin/hvd-proto --checkers epoch-fencing horovod_tpu/ops/
+    bin/hvd-proto --checkers model-check --depth 12 --seed 7 .
+    bin/hvd-proto --write-baseline horovod_tpu/   # refresh suppressions
+
+Exit codes: 0 = clean (baselined findings included), 1 = active
+findings, 2 = usage error — exact parity with ``bin/hvd-lint``.  The
+baseline lives at ``.hvd-proto-baseline.json`` in the repo root; the
+tier-1 gate (tests/test_proto.py) keeps it small and justified.
+Determinism: the same ``--seed`` and ``--depth`` produce a
+byte-identical report (docs/protocol_checking.md).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from horovod_tpu.tools.lint import findings as findings_mod
+from horovod_tpu.tools.lint import model
+from horovod_tpu.tools.proto.checkers import ALL_CHECKERS
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, ".hvd-proto-baseline.json")
+
+# The project policy: the protocol surfaces each checker encodes.
+# epoch-fencing scans the wire-message modules of the reconfigurable
+# planes; signature-parity diffs the four signature/cache-key surfaces
+# (three Python planes + the native response cache); request-
+# exhaustiveness holds every dispatch plane to the shared ops_enum
+# vocabularies; collective-divergence walks the rank-conditional code
+# of the op layers (docs/protocol_checking.md).
+PROJECT_CONFIG = {
+    "msg_modules": [
+        "ops/tcp_controller.py",
+        "ops/tcp_dataplane.py",
+        "ops/global_controller.py",
+        "run/service/network.py",
+    ],
+    "parity_surfaces": [
+        {"plane": "tcp", "module": "ops/tcp_controller.py",
+         "function": "_signature",
+         "subjects": ["msg"]},
+        {"plane": "python", "module": "ops/python_controller.py",
+         "function": "EagerRequest.signature",
+         "subjects": ["self"]},
+        {"plane": "gmesh", "module": "ops/global_controller.py",
+         "function": "MetaCoordinatorService._validate",
+         "subjects": ["r", "first"]},
+    ],
+    "native_signature": os.path.join(REPO_ROOT, "csrc", "hvd",
+                                     "core.cc"),
+    "native_signature_relpath": "csrc/hvd/core.cc",
+    "exhaustive_surfaces": [
+        {"plane": "tcp", "module": "ops/tcp_controller.py",
+         "enum": "RequestType"},
+        {"plane": "python", "module": "ops/python_controller.py",
+         "enum": "RequestType"},
+        {"plane": "gmesh", "module": "ops/global_controller.py",
+         "enum": "RequestType"},
+        {"plane": "native-apply", "module": "ops/native_controller.py",
+         "enum": "ResponseType"},
+    ],
+    "enum_module": "common/ops_enum.py",
+    "native_dispatch": os.path.join(REPO_ROOT, "csrc", "hvd",
+                                    "core.cc"),
+    "native_dispatch_relpath": "csrc/hvd/core.cc",
+    "divergence_modules": [
+        "ops/tcp_controller.py",
+        "ops/tcp_dataplane.py",
+        "ops/global_controller.py",
+        "ops/python_controller.py",
+        "ops/native_controller.py",
+        "run/service/network.py",
+    ],
+    "repo_root": REPO_ROOT,
+}
+
+
+def run_proto(paths, config=None, checkers=None, depth=None, seed=None,
+              _return_project=False):
+    """Programmatic entry: returns the list of findings (pre-baseline).
+    ``config=None`` applies the project policy; tests pass their own."""
+    project = model.load_project(paths)
+    cfg = dict(PROJECT_CONFIG if config is None else config)
+    if depth is not None:
+        cfg["proto_depth"] = depth
+    if seed is not None:
+        cfg["proto_seed"] = seed
+    out = []
+    for name, checker in ALL_CHECKERS.items():
+        if checkers is not None and name not in checkers:
+            continue
+        out.extend(checker.check(project, cfg))
+    out.sort(key=lambda f: (f.path, f.line, f.checker, f.detail))
+    if _return_project:
+        return out, project
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="hvd-proto",
+        description="Distributed-protocol static analysis and bounded "
+                    "model checking for horovod_tpu "
+                    "(docs/protocol_checking.md).")
+    parser.add_argument("paths", nargs="*",
+                        default=[os.path.join(REPO_ROOT, "horovod_tpu")],
+                        help="Files or directories to scan "
+                             "(default: the horovod_tpu package).")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="Baseline JSON of suppressed finding keys "
+                             "(default: .hvd-proto-baseline.json in "
+                             "the repo root).")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="Report every finding, suppressing "
+                             "nothing.")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="Rewrite the baseline from the current "
+                             "findings (existing justifications are "
+                             "kept; new entries get a TODO the gate "
+                             "test rejects until justified).")
+    parser.add_argument("--checkers", default=None,
+                        help="Comma-separated checker subset "
+                             f"(available: {', '.join(ALL_CHECKERS)}).")
+    parser.add_argument("--depth", type=int, default=None,
+                        help="Model-checker exploration bound in steps "
+                             "(default: HVD_TPU_PROTO_DEPTH, else "
+                             "10).")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="Exploration tie-break seed; the same "
+                             "seed and depth give a byte-identical "
+                             "report (default: HVD_TPU_PROTO_SEED, "
+                             "else 0).")
+    parser.add_argument("--format", choices=["text", "json"],
+                        default="text")
+    args = parser.parse_args(argv)
+
+    selected = None
+    if args.checkers:
+        selected = [c.strip() for c in args.checkers.split(",")]
+        unknown = [c for c in selected if c not in ALL_CHECKERS]
+        if unknown:
+            parser.error(f"unknown checker(s): {', '.join(unknown)}")
+
+    all_findings, project = run_proto(args.paths, checkers=selected,
+                                      depth=args.depth, seed=args.seed,
+                                      _return_project=True)
+
+    baseline = {} if args.no_baseline else \
+        findings_mod.load_baseline(args.baseline)
+    if args.write_baseline:
+        # previous entries this run could not have re-observed — an
+        # unselected checker, or a path outside the scan — carry over
+        # verbatim: a scoped --write-baseline must never delete other
+        # scopes' justifications
+        scanned = set(project.modules)
+
+        def out_of_scope(key):
+            checker, _, rest = key.partition(":")
+            relpath = rest.partition(":")[0]
+            if selected is not None and checker not in selected:
+                return True
+            # model-check and the native planes anchor findings outside
+            # the scanned Python module set — always in scope for a
+            # full-checker rewrite, carried over for a scoped one
+            if checker == "model-check" or relpath.startswith("csrc/"):
+                return False
+            return relpath not in scanned
+
+        previous = findings_mod.load_baseline(args.baseline)
+        findings_mod.write_baseline(args.baseline, all_findings,
+                                    previous=previous,
+                                    out_of_scope=out_of_scope)
+        written = len(findings_mod.load_baseline(args.baseline))
+        print(f"wrote {written} suppression(s) to {args.baseline}")
+        return 0
+    active, suppressed, stale = findings_mod.split_baselined(
+        all_findings, baseline)
+
+    if args.format == "json":
+        json.dump({
+            "findings": [f.as_dict() for f in active],
+            "suppressed": [f.as_dict() for f in suppressed],
+            "stale_baseline_keys": stale,
+        }, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for finding in active:
+            print(finding.render())
+        summary = (f"hvd-proto: {len(active)} finding(s), "
+                   f"{len(suppressed)} baselined")
+        if stale:
+            summary += (f", {len(stale)} stale baseline key(s) — "
+                        f"run --write-baseline to prune")
+        print(summary)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,182 @@
+"""Bounded explicit-state model checker for the control-plane protocols.
+
+Each protocol (``protocols.py``) is a message-passing transition system:
+
+    name: str                     # protocol id shown in findings
+    ns: tuple[int, ...]           # world sizes / frame counts to explore
+    initial(n) -> state           # hashable (tuples / frozensets only)
+    actions(state, n) -> [(label, next_state)]
+    invariant(state, n) -> None | str    # safety property id on violation
+    terminal_check(state, n) -> None | str
+        # bounded-liveness property id, asked only on action-free states
+
+States are explored breadth-first up to ``HVD_TPU_PROTO_DEPTH`` steps,
+so the first violation found is a minimal-length counterexample.  Action
+labels follow the ``HVD_TPU_FAULT_SPEC`` grammar
+(``<target>:<point>:<step>:<action>``, docs/fault_injection.md) so a
+counterexample trace renders directly as a fault schedule —
+``to_fault_spec`` projects the fault-grammar steps (crash / drop /
+refuse / preempt) out of a trace for replay on the real runtime.
+
+Determinism contract (mirrors hvd-race): exploration order is fixed by
+sorting each state's actions by label and then shuffling with a
+``random.Random`` seeded from ``HVD_TPU_PROTO_SEED`` + protocol + n.
+BFS still guarantees minimal counterexample length; the seed only
+tie-breaks among equal-length traces.  Same seed + same depth ->
+byte-identical report.
+"""
+
+import inspect
+import os
+import random
+
+from horovod_tpu.tools.lint.findings import Finding
+
+NAME = "model-check"
+
+DEFAULT_DEPTH = 10
+DEFAULT_SEED = 0
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+class Violation:
+    """A property violation with its minimal counterexample trace."""
+
+    def __init__(self, model, n, prop, trace):
+        self.model = model
+        self.n = n
+        self.prop = prop
+        self.trace = tuple(trace)
+
+    def schedule(self):
+        return ",".join(self.trace) if self.trace else "<initial-state>"
+
+
+def to_fault_spec(trace):
+    """Project the fault-grammar steps out of a counterexample trace:
+    the result is a valid ``HVD_TPU_FAULT_SPEC`` value reproducing the
+    environment (crashes, losses, preemptions) the trace needs."""
+    faults = []
+    for label in trace:
+        parts = label.split(":")
+        if len(parts) >= 4 and parts[3] in ("crash", "drop", "refuse",
+                                            "preempt"):
+            faults.append(label)
+    return ",".join(faults)
+
+
+def _trace(visited, state):
+    labels = []
+    while visited[state] is not None:
+        parent, label = visited[state]
+        labels.append(label)
+        state = parent
+    return tuple(reversed(labels))
+
+
+def check_model(model, n, depth=None, seed=None):
+    """Explore ``model`` at world size ``n``; return the first (hence
+    minimal) Violation, or None if every reachable state within
+    ``depth`` steps satisfies the invariant and every action-free state
+    passes the bounded-liveness check."""
+    if depth is None:
+        depth = _env_int("HVD_TPU_PROTO_DEPTH", DEFAULT_DEPTH)
+    if seed is None:
+        seed = _env_int("HVD_TPU_PROTO_SEED", DEFAULT_SEED)
+    rng = random.Random(f"{seed}:{model.name}:{n}")
+
+    init = model.initial(n)
+    visited = {init: None}
+    prop = model.invariant(init, n)
+    if prop:
+        return Violation(model, n, prop, ())
+
+    frontier = [init]
+    for _level in range(depth):
+        nxt = []
+        for state in frontier:
+            acts = sorted(model.actions(state, n), key=lambda a: a[0])
+            rng.shuffle(acts)
+            if not acts:
+                prop = model.terminal_check(state, n)
+                if prop:
+                    return Violation(model, n, prop,
+                                     _trace(visited, state))
+                continue
+            for label, succ in acts:
+                if succ in visited:
+                    continue
+                visited[succ] = (state, label)
+                prop = model.invariant(succ, n)
+                if prop:
+                    return Violation(model, n, prop,
+                                     _trace(visited, succ))
+                nxt.append(succ)
+        frontier = nxt
+        if not frontier:
+            break
+    # action-free states first reached on the last explored level still
+    # owe their bounded-liveness check
+    for state in frontier:
+        if not model.actions(state, n):
+            prop = model.terminal_check(state, n)
+            if prop:
+                return Violation(model, n, prop, _trace(visited, state))
+    return None
+
+
+def _model_anchor(model, repo_root):
+    """(relpath, line) of the model class definition, so a violation is
+    attributed to the file encoding the buggy protocol."""
+    cls = type(model)
+    try:
+        path = inspect.getsourcefile(cls)
+        _src, line = inspect.getsourcelines(cls)
+    except (OSError, TypeError):
+        return getattr(cls, "__module__", "<model>"), 1
+    if repo_root:
+        try:
+            path = os.path.relpath(path, repo_root)
+        except ValueError:
+            pass
+    return path, line
+
+
+def check(project, config):
+    """Checker entry point (hvd-proto registry contract).  ``config``
+    keys: ``models`` (defaults to protocols.REAL_MODELS), ``proto_depth``
+    / ``proto_seed`` (default from HVD_TPU_PROTO_DEPTH / _SEED), and
+    ``proto_ns`` overriding every model's ``ns``."""
+    models = config.get("models")
+    if models is None:
+        from horovod_tpu.tools.proto import protocols
+        models = protocols.REAL_MODELS
+    depth = config.get("proto_depth")
+    seed = config.get("proto_seed")
+    ns_override = config.get("proto_ns")
+    repo_root = config.get("repo_root") or os.getcwd()
+
+    findings = []
+    for model in models:
+        for n in (ns_override or model.ns):
+            violation = check_model(model, n, depth=depth, seed=seed)
+            if violation is None:
+                continue
+            path, line = _model_anchor(model, repo_root)
+            spec = to_fault_spec(violation.trace)
+            findings.append(Finding(
+                NAME, path, line, model.name,
+                f"{violation.prop}:n={n}",
+                f"protocol '{model.name}' violates '{violation.prop}' "
+                f"at n={n}; minimal counterexample: "
+                f"{violation.schedule()}"
+                + (f" (fault schedule: HVD_TPU_FAULT_SPEC={spec})"
+                   if spec else "")))
+            break   # smallest violating n is the interesting one
+    return findings

@@ -1,0 +1,377 @@
+"""The five control-plane protocols as message-passing transition
+systems (the ``mc.py`` model contract).
+
+Each model is the *intended* protocol as documented — abort fan-out
+(docs/fault_tolerance.md), elastic reconfiguration with epoch fencing
+(docs/elastic.md), coordinator leader election (coordinator fail-over),
+graceful drain, and the sequence-numbered session/replay layer
+(self-healing transport).  The checker proves the documented design
+safe within bounds; the seeded-bug fixtures under
+``tests/proto_fixtures/`` subclass these models with one transition
+broken the way the corresponding real-world bug breaks it, and must be
+caught.
+
+States are tuples of ints / frozensets only (hashable, canonical);
+action labels follow the fault-spec grammar so counterexamples replay
+(see ``mc.to_fault_spec``).
+"""
+
+
+class AbortFanout:
+    """Coordinated abort: a worker crash is detected by the coordinator
+    liveness monitor, latched as a sticky abort verdict, and pulled by
+    every surviving rank over the heartbeat channel.
+
+    Safety: no rank aborts before the coordinator latched the verdict.
+    Bounded liveness: once a crash happened, every live rank (the
+    coordinator included) eventually learns the abort.
+    """
+
+    name = "abort-fanout"
+    ns = (2, 3, 4)
+
+    # state: (crashed ranks, coordinator latched abort?, aborted ranks)
+
+    def initial(self, n):
+        return (frozenset(), False, frozenset())
+
+    def actions(self, state, n):
+        crashed, latched, aborted = state
+        out = []
+        if not crashed:   # single-crash bound
+            for i in range(1, n):
+                out.append((f"rank{i}:allreduce:1:crash",
+                            (frozenset({i}), latched, aborted)))
+        if crashed and not latched:
+            out.append(("rank0:heartbeat:2:latch-abort",
+                        (crashed, True, aborted)))
+        if latched:
+            for i in range(n):
+                if i in crashed or i in aborted:
+                    continue
+                out.append((self._deliver_label(i),
+                            self._deliver(state, n, i)))
+        return out
+
+    def _deliver_label(self, i):
+        return f"rank{i}:heartbeat:3:abort"
+
+    def _deliver(self, state, n, i):
+        crashed, latched, aborted = state
+        return (crashed, latched, aborted | {i})
+
+    def invariant(self, state, n):
+        crashed, latched, aborted = state
+        if aborted and not latched:
+            return "abort-without-verdict"
+        return None
+
+    def terminal_check(self, state, n):
+        crashed, latched, aborted = state
+        if not crashed:
+            return None
+        live = set(range(n)) - crashed
+        if live - aborted:
+            return "abort-not-delivered"
+        return None
+
+
+class ElasticReconfig:
+    """Elastic reconfiguration with epoch fencing: the coordinator
+    advances the world epoch, ranks adopt asynchronously, and every
+    delivered collective frame is fenced against the coordinator's
+    current epoch — a straggler frame from a torn-down epoch is
+    rejected, never applied (docs/elastic.md).
+
+    Safety: no frame is applied whose epoch differs from the
+    coordinator epoch at apply time.
+    """
+
+    name = "elastic-reconfig"
+    ns = (2, 3, 4)
+
+    _MAX_EPOCH = 2
+
+    # state: (coord_epoch, per-rank epochs, sent ranks, inflight
+    #         (rank, epoch) frames, stale frame applied?)
+
+    def initial(self, n):
+        return (0, (0,) * n, frozenset(), frozenset(), False)
+
+    def actions(self, state, n):
+        coord, epochs, sent, inflight, bad = state
+        out = []
+        for i in range(n):
+            if i not in sent:   # one frame per rank, at its own epoch
+                out.append((
+                    f"rank{i}:send:1:collective-e{epochs[i]}",
+                    (coord, epochs, sent | {i},
+                     inflight | {(i, epochs[i])}, bad)))
+        if coord < self._MAX_EPOCH:
+            out.append(("rank0:reconfig:2:advance",
+                        (coord + 1, epochs, sent, inflight, bad)))
+        for i in range(n):
+            if epochs[i] < coord:
+                adopted = epochs[:i] + (epochs[i] + 1,) + epochs[i + 1:]
+                out.append((f"rank{i}:reconfig:3:adopt",
+                            (coord, adopted, sent, inflight, bad)))
+        for frame in inflight:
+            out.append((self._deliver_label(state, frame),
+                        self._deliver(state, n, frame)))
+            i, e = frame
+            out.append((f"rank{i}:send:4:drop",
+                        (coord, epochs, sent, inflight - {frame}, bad)))
+        return out
+
+    def _deliver_label(self, state, frame):
+        coord = state[0]
+        i, e = frame
+        verdict = "apply" if e == coord else "reject"
+        return f"rank0:recv:5:{verdict}-r{i}e{e}"
+
+    def _deliver(self, state, n, frame):
+        coord, epochs, sent, inflight, bad = state
+        i, e = frame
+        # the fence: stale-epoch frames are rejected, not applied
+        return (coord, epochs, sent, inflight - {frame}, bad)
+
+    def invariant(self, state, n):
+        if state[4]:
+            return "stale-epoch-apply"
+        return None
+
+    def terminal_check(self, state, n):
+        return None
+
+
+class LeaderElection:
+    """Coordinator fail-over: rank 0 is gone; the survivors race a
+    compare-and-swap on the durable election slot.  The CAS is atomic —
+    exactly one proposer wins, everyone else adopts the winner.  The
+    seated winner cannot crash (its loss starts the *next* election
+    instance); one additional survivor crash is in scope.
+
+    Safety: at most one live rank believes itself leader (no
+    split-brain).  Bounded liveness: the survivors end up with a live
+    leader.
+    """
+
+    name = "leader-election"
+    ns = (2, 3, 4)
+
+    # state: (cas slot winner | -1, per-rank believed leader (-1 =
+    #         undecided; index 0 unused), crashed ranks)
+
+    def initial(self, n):
+        return (-1, (-1,) * n, frozenset())
+
+    def actions(self, state, n):
+        cas, leaders, crashed = state
+        out = []
+        for i in range(1, n):
+            if i in crashed or leaders[i] >= 0:   # already decided
+                continue
+            out.extend(self._decide(state, n, i))
+        if len(crashed) < 1:
+            for i in range(1, n):
+                if i in crashed or i == cas:
+                    continue
+                out.append((f"rank{i}:link:2:crash",
+                            (cas, leaders, crashed | {i})))
+        return out
+
+    def _decide(self, state, n, i):
+        cas, leaders, crashed = state
+        if cas == -1:   # atomic CAS: first writer wins
+            won = leaders[:i] + (i,) + leaders[i + 1:]
+            return [(f"rank{i}:connect:1:cas-win",
+                     (i, won, crashed))]
+        adopted = leaders[:i] + (cas,) + leaders[i + 1:]
+        return [(f"rank{i}:connect:1:adopt", (cas, adopted, crashed))]
+
+    def invariant(self, state, n):
+        cas, leaders, crashed = state
+        winners = [i for i in range(1, n)
+                   if i not in crashed and leaders[i] == i]
+        if len(winners) > 1:
+            return "split-brain"
+        return None
+
+    def terminal_check(self, state, n):
+        cas, leaders, crashed = state
+        live = [i for i in range(1, n) if i not in crashed]
+        if not live:
+            return None
+        if cas == -1:
+            return "no-leader-elected"
+        if any(leaders[i] != cas for i in live):
+            return "divergent-adoption"
+        return None
+
+
+class GracefulDrain:
+    """Graceful drain: a preempted worker announces its departure, the
+    coordinator forms a new membership plan excluding it, and every
+    surviving rank receives the directive before the old world tears
+    down.  The drain channel is the reliable in-order control
+    connection, so loss is out of scope (a crash is AbortFanout's job).
+
+    Safety: the draining rank is never part of the new plan.  Bounded
+    liveness: every planned survivor receives the directive.
+    """
+
+    name = "graceful-drain"
+    ns = (2, 3, 4)
+
+    # state: (preempted rank | -1, drain announced?, plan | None,
+    #         survivors holding the directive)
+
+    def initial(self, n):
+        return (-1, False, None, frozenset())
+
+    def actions(self, state, n):
+        preempted, announced, plan, delivered = state
+        out = []
+        if preempted == -1:
+            for i in range(1, n):
+                out.append((f"rank{i}:allreduce:1:preempt",
+                            (i, announced, plan, delivered)))
+        if preempted != -1 and not announced:
+            out.append((f"rank{preempted}:send:2:drain",
+                        (preempted, True, plan, delivered)))
+        if announced and plan is None:
+            out.append(("rank0:plan:3:exclude",
+                        (preempted, announced,
+                         self._plan(state, n), delivered)))
+        if plan is not None:
+            for i in sorted(plan - delivered):
+                out.append((f"rank{i}:recv:4:directive",
+                            (preempted, announced, plan,
+                             delivered | {i})))
+        return out
+
+    def _plan(self, state, n):
+        preempted = state[0]
+        return frozenset(i for i in range(n) if i != preempted)
+
+    def invariant(self, state, n):
+        preempted, announced, plan, delivered = state
+        if plan is not None and preempted in plan:
+            return "drainer-in-plan"
+        return None
+
+    def terminal_check(self, state, n):
+        preempted, announced, plan, delivered = state
+        if preempted == -1:
+            return None
+        if plan is None or plan - delivered:
+            return "drain-directive-lost"
+        return None
+
+
+class SessionReplay:
+    """The sequence-numbered session layer (self-healing transport):
+    the sender retains unacked frames, the receiver applies strictly
+    in order (duplicates dropped, gaps sever the connection), and a
+    reconnect replays the retained tail from the receiver's reported
+    high-water mark.  A replay gap (needed frame already evicted)
+    refuses the resume — the session escalates to a fresh join rather
+    than guess.
+
+    Here ``n`` is the frame count, not a world size.
+
+    Safety: the applied stream is exactly 1..k — contiguous, in order,
+    no duplicates (exactly-once delivery).
+    """
+
+    name = "session-replay"
+    ns = (2, 3, 4)
+
+    # state: (frames sent, retained buffer, inflight frames, applied
+    #         stream, receiver high-water mark, acked mark, evictions,
+    #         connection drops, severed?, resume refused?)
+
+    def initial(self, n):
+        return (0, frozenset(), frozenset(), (), 0, 0, 0, 0, False,
+                False)
+
+    def actions(self, state, n):
+        (sent, buf, inflight, applied, seen, acked, evicts, drops,
+         severed, refused) = state
+        out = []
+        if refused:
+            return out   # session escalated to a fresh join
+        if sent < n and not severed:
+            seq = sent + 1
+            out.append((f"rank0:send:1:frame-{seq}",
+                        (seq, buf | {seq}, inflight | {seq}, applied,
+                         seen, acked, evicts, drops, severed, refused)))
+        if drops < 1 and inflight and not severed:
+            out.append(("rank0:link:2:drop",
+                        (sent, buf, frozenset(), applied, seen, acked,
+                         evicts, drops + 1, True, refused)))
+        if not severed:
+            for seq in sorted(inflight):
+                out.append((f"rank1:recv:3:frame-{seq}",
+                            self._deliver(state, n, seq)))
+        if not severed and seen > acked:
+            out.append((f"rank1:send:4:ack-{seen}",
+                        (sent, frozenset(s for s in buf if s > seen),
+                         inflight, applied, seen, seen, evicts, drops,
+                         severed, refused)))
+        if evicts < 1 and buf:
+            out.append(("rank0:buffer:5:evict",
+                        (sent, buf - {min(buf)}, inflight, applied,
+                         seen, acked, evicts + 1, drops, severed,
+                         refused)))
+        if severed:
+            out.append(self._heal(state, n))
+        return out
+
+    def _deliver(self, state, n, seq):
+        (sent, buf, inflight, applied, seen, acked, evicts, drops,
+         severed, refused) = state
+        inflight = inflight - {seq}
+        if seq <= seen:
+            pass                      # duplicate: dropped
+        elif seq == seen + 1:
+            applied = applied + (seq,)
+            seen = seq
+        else:                         # gap: sever, await replay
+            inflight = frozenset()
+            severed = True
+        return (sent, buf, inflight, applied, seen, acked, evicts,
+                drops, severed, refused)
+
+    def _heal(self, state, n):
+        (sent, buf, inflight, applied, seen, acked, evicts, drops,
+         severed, refused) = state
+        # the receiver reports its high-water mark; the sender replays
+        # the retained tail above it — a hole in that tail is a replay
+        # gap and the resume is refused (escalate, never guess)
+        replay = sorted(s for s in buf if s > seen)
+        if replay and replay[0] != seen + 1:
+            return ("rank0:connect:6:refuse",
+                    (sent, buf, inflight, applied, seen, acked, evicts,
+                     drops, severed, True))
+        return ("rank0:connect:6:heal",
+                (sent, buf, frozenset(replay), applied, seen, acked,
+                 evicts, drops, False, refused))
+
+    def invariant(self, state, n):
+        applied = state[3]
+        if applied != tuple(range(1, len(applied) + 1)):
+            return "non-exactly-once-delivery"
+        return None
+
+    def terminal_check(self, state, n):
+        return None
+
+
+REAL_MODELS = [
+    AbortFanout(),
+    ElasticReconfig(),
+    LeaderElection(),
+    GracefulDrain(),
+    SessionReplay(),
+]

@@ -99,6 +99,14 @@ HVD_TPU_RACE_SCOPE = "HVD_TPU_RACE_SCOPE"
 # from launcher-spawned worker ranks
 HVD_TPU_RACE_REPORT = "HVD_TPU_RACE_REPORT"
 
+# --- protocol checking (docs/protocol_checking.md) ---------------------------
+# bounded model-checker exploration depth, in steps: how far bin/hvd-proto
+# explores each protocol's state graph before declaring it clean
+HVD_TPU_PROTO_DEPTH = "HVD_TPU_PROTO_DEPTH"
+# exploration tie-break seed — same seed + same depth give a
+# byte-identical hvd-proto report (the hvd-race determinism contract)
+HVD_TPU_PROTO_SEED = "HVD_TPU_PROTO_SEED"
+
 # --- fault-tolerant collective runtime (docs/fault_tolerance.md) -------------
 # bound on "abort initiated anywhere -> every rank raises HvdAbortedError"
 HVD_TPU_ABORT_TIMEOUT = "HVD_TPU_ABORT_TIMEOUT"

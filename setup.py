@@ -65,6 +65,8 @@ setup(
         "horovod_tpu.tools",
         "horovod_tpu.tools.lint",
         "horovod_tpu.tools.lint.checkers",
+        "horovod_tpu.tools.proto",
+        "horovod_tpu.tools.proto.checkers",
         "horovod_tpu.tools.race",
         "horovod_tpu.torch",
         "horovod_tpu.utils",

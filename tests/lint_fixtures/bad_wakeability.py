@@ -19,3 +19,9 @@ class Plane:
 
     def read(self, sock):
         return sock.recv(4096)     # BAD: socket recv, not registered
+
+    def pump(self, sock, key):
+        while True:
+            frame = read_message(sock, key, "q")   # BAD: unbounded
+            if frame is None:
+                return

@@ -11,3 +11,18 @@ def receive(sock):
 
 def send(sock, frame):
     sock.sendall(frame)          # BAD: unsigned raw send
+
+
+def admit(sock, key, hello, sessions):
+    """BAD: admits a session resume with no epoch fence."""
+    state = sessions.setdefault(hello.session_id, object())
+    network_write(sock, key, SessionWelcome(0))
+    return state
+
+
+def replay(session, welcome):
+    """BAD: a replay-buffer gap returns None; iterating it as an empty
+    replay silently skips frames."""
+    frames = session.replayable_from(welcome.rx_seen)
+    for frame in frames or ():
+        send_frame(frame)

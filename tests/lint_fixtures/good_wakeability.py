@@ -21,3 +21,14 @@ class Plane:
     def read(self, sock):
         # wakeable: abort closes the socket, breaking the recv
         return sock.recv(4096)
+
+    def pump(self, sock, key):
+        while True:
+            # wakeable: heal/teardown closes the socket, breaking it
+            frame = read_message(sock, key, "q")
+            if frame is None:
+                return
+
+    def handshake(self, sock, key, timeout):
+        sock.settimeout(timeout)   # armed timeout bounds the read
+        return read_message(sock, key, "r")

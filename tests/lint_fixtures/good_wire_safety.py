@@ -15,3 +15,21 @@ def receive(key, blob):
 
 def send(sock, key, obj):
     return network.write_message(sock, key, obj, "q")
+
+
+def admit(service, sock, key, hello, sessions):
+    """Resume fenced against the service epoch."""
+    if hello.epoch != service.session_epoch():
+        return network.write_message(
+            sock, key, SessionWelcome(0, refused=True), "r")
+    state = sessions.setdefault(hello.session_id, object())
+    network.write_message(sock, key, SessionWelcome(state.seen), "r")
+    return state
+
+
+def replay(session, welcome):
+    frames = session.replayable_from(welcome.rx_seen)
+    if frames is None:
+        raise ConnectionError("replay buffer gap: refuse the resume")
+    for frame in frames:
+        send_frame(frame)

@@ -1,0 +1,16 @@
+"""Known-bad fixture for the collective-divergence checker: collectives
+under rank-conditional branches with no match on the other arm."""
+
+
+def lopsided_if(hvd, rank, x):
+    if rank == 0:
+        x = hvd.allreduce(x)   # other ranks never enter: deadlock
+    return x
+
+
+class Trainer:
+    def broadcast_state(self, hvd, state):
+        if hvd.rank() != self._root:
+            return state       # non-root arm skips the collective
+        else:
+            return hvd.broadcast(state, root_rank=self._root)

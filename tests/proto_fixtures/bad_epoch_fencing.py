@@ -1,0 +1,43 @@
+"""Known-bad fixture for the epoch-fencing checker: one class per
+finding detail."""
+
+
+class NoEpochMsg:
+    """missing-epoch: crosses a reconfigurable boundary with no epoch
+    field and no exemption annotation."""
+
+    def __init__(self, rank):
+        self.rank = rank
+
+
+class DeadFenceMsg:
+    """no-dispatch-check: carries an epoch nobody ever reads — no
+    scanned module isinstance-dispatches this class."""
+
+    __slots__ = ("rank", "epoch")
+
+    def __init__(self, rank, epoch):
+        self.rank = rank
+        self.epoch = epoch
+
+
+class UnfencedMsg:
+    """unfenced-dispatch: carries an epoch, is dispatched below, but
+    the dispatch never compares the field."""
+
+    def __init__(self, rank, epoch):
+        self.rank = rank
+        self.epoch = epoch
+
+
+class Service:
+    def __init__(self):
+        self._epoch = 0
+
+    def _handle(self, req):
+        if isinstance(req, UnfencedMsg):
+            return self._apply(req)
+        return None
+
+    def _apply(self, req):
+        return req.rank   # acts on the message, fence never checked

@@ -1,0 +1,30 @@
+"""Seeded bug: abort latched at the coordinator but never fanned out.
+
+The real abort protocol delivers the latched verdict to every live
+rank over the heartbeat channel.  This model aborts only the
+coordinator itself — surviving workers never learn the world died and
+hang in their collectives forever (the bounded-liveness property
+``abort-not-delivered``).
+
+The counterexample trace's fault-spec projection (``mc.to_fault_spec``)
+is a pure crash schedule — tests/test_proto.py replays it on the real
+2-rank runtime and shows the *real* code upholds the property this
+model violates.
+"""
+
+from horovod_tpu.tools.proto.protocols import AbortFanout
+
+
+class CoordinatorOnlyAbort(AbortFanout):
+    name = "bad-lost-abort"
+
+    def actions(self, state, n):
+        # the fan-out stops at the coordinator: rank 0 is the only
+        # rank the latched verdict is ever delivered to
+        return [(label, succ) for label, succ
+                in AbortFanout.actions(self, state, n)
+                if not (label.endswith(":3:abort")
+                        and not label.startswith("rank0:"))]
+
+
+MODEL = CoordinatorOnlyAbort()

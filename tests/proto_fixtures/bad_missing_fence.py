@@ -1,0 +1,31 @@
+"""Seeded bug: elastic reconfiguration without the epoch fence.
+
+The real coordinator rejects any delivered frame whose epoch differs
+from the current world epoch (docs/elastic.md).  This model removes
+the fence — every delivered frame is applied, so a straggler from the
+torn-down epoch mutates the re-formed world's state.
+
+``hvd-proto --checkers model-check`` must catch this deterministically
+with a minimal counterexample attributed to this file.
+"""
+
+from horovod_tpu.tools.proto.protocols import ElasticReconfig
+
+
+class UnfencedElasticReconfig(ElasticReconfig):
+    name = "bad-missing-fence"
+
+    def _deliver_label(self, state, frame):
+        i, e = frame
+        return f"rank0:recv:5:apply-r{i}e{e}"
+
+    def _deliver(self, state, n, frame):
+        coord, epochs, sent, inflight, bad = state
+        i, e = frame
+        # no fence: the frame is applied whatever its epoch
+        if e != coord:
+            bad = True
+        return (coord, epochs, sent, inflight - {frame}, bad)
+
+
+MODEL = UnfencedElasticReconfig()

@@ -1,0 +1,39 @@
+"""Seeded bug: session resume replays without the high-water handshake.
+
+The real session layer replays only the retained tail above the
+receiver's reported high-water mark, and the receiver drops duplicate
+sequence numbers.  This model breaks both ends of that contract the
+way the historical bug did: the reconnect replays the whole retained
+buffer (ignoring what the receiver reported), and the receiver applies
+every delivered frame without the dedup/gap check — so a frame applied
+before the connection dropped is applied again after the heal
+(double-apply; exactly-once delivery violated).
+
+``hvd-proto --checkers model-check`` must catch this deterministically
+with a minimal counterexample attributed to this file.
+"""
+
+from horovod_tpu.tools.proto.protocols import SessionReplay
+
+
+class GapBlindSessionReplay(SessionReplay):
+    name = "bad-replay-gap"
+
+    def _deliver(self, state, n, seq):
+        (sent, buf, inflight, applied, seen, acked, evicts, drops,
+         severed, refused) = state
+        # no dedup, no gap check: every delivery is applied
+        return (sent, buf, inflight - {seq}, applied + (seq,),
+                max(seen, seq), acked, evicts, drops, severed, refused)
+
+    def _heal(self, state, n):
+        (sent, buf, inflight, applied, seen, acked, evicts, drops,
+         severed, refused) = state
+        # replays the whole retained buffer, ignoring the receiver's
+        # reported high-water mark
+        return ("rank0:connect:6:heal",
+                (sent, buf, frozenset(buf), applied, seen, acked,
+                 evicts, drops, False, refused))
+
+
+MODEL = GapBlindSessionReplay()

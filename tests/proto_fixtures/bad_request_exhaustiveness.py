@@ -1,0 +1,15 @@
+"""Known-bad fixture for the request-exhaustiveness checker: the
+dispatch below handles ALLREDUCE only — BROADCAST and JOIN are silent
+drops."""
+
+
+class RequestType:
+    ALLREDUCE = 0
+    BROADCAST = 1
+    JOIN = 2
+
+
+def dispatch(req):
+    if req.req_type == RequestType.ALLREDUCE:
+        return "allreduce"
+    return None   # everything else silently dropped

@@ -1,0 +1,17 @@
+"""Known-bad fixture for the signature-parity checker: plane ``b``
+misses ``shape`` (read by plane ``a``) and plane ``a`` misses
+``compression`` — each side of the diff fires."""
+
+
+def sig_a(msg):
+    """Plane a: reads shape but not compression."""
+    return (msg.req_type, msg.op, tuple(msg.shape),
+            getattr(msg, "splits", None))
+
+
+class RequestB:
+    def signature(self):
+        """Plane b: reads compression but not shape, and folds the
+        prescale alias the normalizer must unify."""
+        return (self.req_type, self.op, self.prescale_factor,
+                self.splits, self.compression)

@@ -1,0 +1,35 @@
+"""Seeded bug: racy leader election (split-brain).
+
+The real election writes the durable slot with an atomic
+compare-and-swap — first writer wins, everyone else adopts.  This model
+breaks the CAS into a read-then-write pair: a survivor reads the slot
+as empty, then writes itself later.  Two survivors that both read
+before either writes each end up believing themselves leader — the
+split-brain the coordinator fail-over design exists to rule out.
+
+``hvd-proto --checkers model-check`` must catch this deterministically
+with a minimal counterexample attributed to this file.
+"""
+
+from horovod_tpu.tools.proto.protocols import LeaderElection
+
+_PENDING = -2   # read the slot as empty, write not yet issued
+
+
+class RacyLeaderElection(LeaderElection):
+    name = "bad-split-brain"
+
+    def _decide(self, state, n, i):
+        cas, leaders, crashed = state
+        if leaders[i] == _PENDING:
+            won = leaders[:i] + (i,) + leaders[i + 1:]
+            return [(f"rank{i}:connect:1:write-self", (i, won, crashed))]
+        if cas == -1:   # non-atomic: observe empty, decide to run
+            pend = leaders[:i] + (_PENDING,) + leaders[i + 1:]
+            return [(f"rank{i}:connect:1:read-null",
+                     (cas, pend, crashed))]
+        adopted = leaders[:i] + (cas,) + leaders[i + 1:]
+        return [(f"rank{i}:connect:1:adopt", (cas, adopted, crashed))]
+
+
+MODEL = RacyLeaderElection()

@@ -1,0 +1,27 @@
+"""Known-good twin for the collective-divergence checker: matched
+collectives on both arms, annotated deliberate asymmetry, and a nested
+def that must not count as the other arm executing."""
+
+
+def symmetric(hvd, rank, x):
+    if rank == 0:
+        return hvd.allreduce(x * 2)
+    else:
+        return hvd.allreduce(x)
+
+
+def bootstrap(hvd, rank, state):
+    # divergence-ok: rank 0 seeds the store BEFORE the world exists —
+    # no other rank is inside a collective yet
+    if rank == 0:
+        state = hvd.broadcast(state, root_rank=0)
+    return state
+
+
+def deferred(hvd, rank, x):
+    if rank == 0:
+        def later():
+            # runs on another call stack — not this branch's collective
+            return hvd.allgather(x)
+        return later
+    return None

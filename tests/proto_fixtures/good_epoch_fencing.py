@@ -1,0 +1,48 @@
+"""Known-good twin for the epoch-fencing checker: fenced dispatch
+(direct and one-hop delegated), slots/dataclass field spellings, and
+the exemption annotation."""
+
+
+class FencedMsg:
+    """Fence compared right in the isinstance dispatch."""
+
+    def __init__(self, rank, epoch):
+        self.rank = rank
+        self.epoch = epoch
+
+
+class DelegatedMsg:
+    """Fence lives one hop away, in the per-message handler the
+    dispatch delegates to — the real controllers' shape."""
+
+    __slots__ = ("rank", "join_epoch")
+
+    def __init__(self, rank, join_epoch):
+        self.rank = rank
+        self.join_epoch = join_epoch
+
+
+# epoch-exempt: responses ride the fenced request's connection
+class ReplyMsg:
+    def __init__(self, payload):
+        self.payload = payload
+
+
+class Service:
+    def __init__(self):
+        self._epoch = 0
+        self._join_epoch = 0
+
+    def _handle(self, req):
+        if isinstance(req, FencedMsg):
+            if getattr(req, "epoch", 0) != self._epoch:
+                return None
+            return req.rank
+        if isinstance(req, DelegatedMsg):
+            return self._handle_delegated(req)
+        return None
+
+    def _handle_delegated(self, msg):
+        if msg.join_epoch != self._join_epoch:
+            return None
+        return msg.rank

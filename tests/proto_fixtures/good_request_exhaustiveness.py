@@ -1,0 +1,18 @@
+"""Known-good twin for the request-exhaustiveness checker: every
+member handled or exempted."""
+
+
+class RequestType:
+    ALLREDUCE = 0
+    BROADCAST = 1
+    JOIN = 2
+
+
+# req-exempt: JOIN — joins travel as a dedicated barrier message, never
+# through this dispatch
+def dispatch(req):
+    if req.req_type == RequestType.ALLREDUCE:
+        return "allreduce"
+    if req.req_type == RequestType.BROADCAST:
+        return "broadcast"
+    return None

@@ -1,0 +1,16 @@
+"""Known-good twin for the signature-parity checker: the planes agree
+after alias normalization, and the one deliberate gap is annotated."""
+
+
+def sig_a(msg):
+    return (msg.req_type, msg.op, tuple(msg.shape),
+            getattr(msg, "splits", None), msg.compression,
+            bool(msg.ring))
+
+
+class RequestB:
+    def signature(self):
+        # sig-exempt: ring — transport-local negotiation, this plane
+        # has no ring path to disagree about
+        return (self.req_type, self.op, tuple(self.shape),
+                self.splits, self.compression)

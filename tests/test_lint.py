@@ -88,7 +88,8 @@ def test_bad_fixture_details():
 
     wake = _lint_fixture("bad_wakeability.py", "abort-wakeability")
     details = {f.detail for f in wake}
-    assert {"self._cv.wait", "self._jobs.get", "sock.recv"} <= details
+    assert {"self._cv.wait", "self._jobs.get", "sock.recv",
+            "read_message"} <= details
 
     conf = _lint_fixture("bad_config_surface.py", "config-surface",
                          with_env=True)
@@ -101,7 +102,8 @@ def test_bad_fixture_details():
 
     wire = _lint_fixture("bad_wire_safety.py", "wire-safety")
     details = {f.detail for f in wire}
-    assert details == {"pickle-loads", "raw-send"}
+    assert details == {"pickle-loads", "raw-send",
+                       "unfenced-resume", "unchecked-replay"}
 
     life = _lint_fixture("bad_thread_lifecycle.py", "thread-lifecycle")
     details = {f.detail for f in life}
