@@ -29,10 +29,13 @@ Request Request::Decode(Reader* r) {
   q.prescale = r->F64();
   q.postscale = r->F64();
   q.name = r->Str();
+  // Every count-prefixed loop stops the moment the reader runs dry: a
+  // lying count word must never size the output (4G-element vectors
+  // from a 10-byte buffer), only the bytes actually present may.
   uint32_t nd = r->U32();
-  for (uint32_t i = 0; i < nd; ++i) q.shape.push_back(r->I64());
+  for (uint32_t i = 0; i < nd && r->ok(); ++i) q.shape.push_back(r->I64());
   uint32_t ns = r->U32();
-  for (uint32_t i = 0; i < ns; ++i) q.splits.push_back(r->I64());
+  for (uint32_t i = 0; i < ns && r->ok(); ++i) q.splits.push_back(r->I64());
   return q;
 }
 
@@ -52,12 +55,12 @@ ResponseEntry ResponseEntry::Decode(Reader* r) {
   ResponseEntry e;
   e.name = r->Str();
   uint32_t n = r->U32();
-  for (uint32_t i = 0; i < n; ++i) {
+  for (uint32_t i = 0; i < n && r->ok(); ++i) {
     e.ranks.push_back(r->I32());
     e.req_ids.push_back(r->U64());
   }
   uint32_t nj = r->U32();
-  for (uint32_t i = 0; i < nj; ++i) e.joined.push_back(r->I32());
+  for (uint32_t i = 0; i < nj && r->ok(); ++i) e.joined.push_back(r->I32());
   e.root_rank = r->I32();
   return e;
 }
@@ -82,7 +85,7 @@ Response Response::Decode(Reader* r) {
   resp.postscale = r->F64();
   resp.error = r->Str();
   uint32_t n = r->U32();
-  for (uint32_t i = 0; i < n; ++i) {
+  for (uint32_t i = 0; i < n && r->ok(); ++i) {
     resp.entries.push_back(ResponseEntry::Decode(r));
   }
   return resp;
@@ -103,7 +106,7 @@ ResponseBatch ResponseBatch::Decode(const uint8_t* data, size_t len) {
   b.batch_id = r.U64();
   b.shutdown = r.U8() != 0;
   uint32_t n = r.U32();
-  for (uint32_t i = 0; i < n; ++i) {
+  for (uint32_t i = 0; i < n && r.ok(); ++i) {
     b.responses.push_back(Response::Decode(&r));
   }
   return b;

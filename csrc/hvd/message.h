@@ -51,7 +51,15 @@ class Reader {
   double F64() { double v; memcpy(&v, Take(8), 8); return v; }
   std::string Str() {
     uint32_t n = U32();
-    const uint8_t* p = Take(n);
+    // Bound the claimed length by the bytes actually present BEFORE
+    // sizing anything: a lying length word must not buy an allocation,
+    // and Take()'s zero-page fallback is only 8 bytes wide.
+    if (!ok_ || static_cast<size_t>(end_ - p_) < n) {
+      ok_ = false;
+      return std::string();
+    }
+    const uint8_t* p = p_;
+    p_ += n;
     return std::string(reinterpret_cast<const char*>(p), n);
   }
   bool ok() const { return ok_; }

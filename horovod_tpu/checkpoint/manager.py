@@ -249,7 +249,12 @@ class CheckpointManager:
             try:
                 result = self._restore_one(state, step, epoch, world)
             except (store.CorruptShardError, OSError, ValueError,
-                    KeyError) as exc:
+                    KeyError, TypeError) as exc:
+                # TypeError included deliberately: manifest/shard fields
+                # are corruption-shaped input, and a torn-but-valid-JSON
+                # body can bind any of them to the wrong type (int({})
+                # and friends) — that must read as "manifest unusable,
+                # walk back", never crash the resume
                 self._log.warning(
                     "checkpoint: manifest step=%d epoch=%d world=%d "
                     "unusable (%s); trying previous", step, epoch,

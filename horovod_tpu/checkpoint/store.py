@@ -108,9 +108,21 @@ def read_shard(directory, step, epoch, world, rank) -> dict:
             meta = json.loads(f.read().decode())
         with open(path, "rb") as f:
             blob = f.read()
-    except (OSError, ValueError) as exc:
+        # the sidecar is corruption-shaped input like the shard itself:
+        # a torn write can leave VALID json of the wrong shape (a
+        # string, a list, "bytes" bound to a dict...), and every one of
+        # those must read as a corrupt shard, not a TypeError escaping
+        # the fallback walk
+        if not isinstance(meta, dict):
+            raise CorruptShardError(
+                f"{name}: meta sidecar is {type(meta).__name__}, "
+                f"expected object")
+        recorded = int(meta.get("bytes", -1))
+    except CorruptShardError:
+        raise
+    except (OSError, ValueError, TypeError) as exc:
         raise CorruptShardError(f"{name}: {exc}") from exc
-    if len(blob) != int(meta.get("bytes", -1)):
+    if len(blob) != recorded:
         raise CorruptShardError(
             f"{name}: {len(blob)} bytes on disk, meta records "
             f"{meta.get('bytes')}")
@@ -134,9 +146,22 @@ def write_manifest(directory, step, epoch, world, extra=None):
 
 
 def read_manifest(directory, step, epoch, world) -> dict:
-    path = os.path.join(directory, manifest_name(step, epoch, world))
+    """Load one manifest body; raises ``ValueError`` (which the restore
+    fallback walk already treats as "try the previous manifest") when
+    the bytes are torn json or json of the wrong shape — a manifest is
+    corruption-shaped input exactly like a shard sidecar."""
+    name = manifest_name(step, epoch, world)
+    path = os.path.join(directory, name)
     with open(path, "rb") as f:
-        return json.loads(f.read().decode())
+        try:
+            body = json.loads(f.read().decode())
+        except (ValueError, UnicodeDecodeError) as exc:
+            raise ValueError(f"{name}: {exc}") from exc
+    if not isinstance(body, dict):
+        raise ValueError(
+            f"{name}: manifest body is {type(body).__name__}, "
+            f"expected object")
+    return body
 
 
 def list_manifests(directory):

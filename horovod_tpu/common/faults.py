@@ -76,6 +76,7 @@ lookup per check, one attribute read per frame write) when no spec is
 configured.
 """
 
+import math
 import os
 import random
 import signal
@@ -140,6 +141,13 @@ def _parse_degrade_param(part, action, text):
         raise ValueError(
             f"fault spec {part!r}: {action} wants a numeric parameter, "
             f"got {text!r}") from None
+    # float() happily parses "nan"/"inf", and nan slides through every
+    # one-sided range check below (nan < 0 is False) — a nan delay
+    # would reach time.sleep() and crash the transport write path
+    if not math.isfinite(value):
+        raise ValueError(
+            f"fault spec {part!r}: {action} parameter must be finite, "
+            f"got {text!r}")
     if action == "flaky":
         if not 0.0 <= value <= 1.0:
             raise ValueError(
@@ -215,7 +223,7 @@ def parse_fault_spec(text):
                     raise ValueError(
                         f"fault spec {part!r}: duration must be "
                         f"seconds") from None
-                if duration <= 0:
+                if not math.isfinite(duration) or duration <= 0:
                     raise ValueError(
                         f"fault spec {part!r}: duration must be > 0")
         elif action == "reset":
@@ -229,7 +237,7 @@ def parse_fault_spec(text):
                 raise ValueError(
                     f"fault spec {part!r}: reset wants a probability, "
                     f"got {fields[4]!r}") from None
-            if not 0.0 <= param <= 1.0:
+            if not math.isfinite(param) or not 0.0 <= param <= 1.0:
                 raise ValueError(
                     f"fault spec {part!r}: reset probability must be in "
                     f"[0, 1], got {param:g}")
@@ -240,7 +248,7 @@ def parse_fault_spec(text):
                     raise ValueError(
                         f"fault spec {part!r}: duration must be "
                         f"seconds") from None
-                if duration <= 0:
+                if not math.isfinite(duration) or duration <= 0:
                     raise ValueError(
                         f"fault spec {part!r}: duration must be > 0")
         elif action == "blip":
@@ -254,7 +262,7 @@ def parse_fault_spec(text):
                 raise ValueError(
                     f"fault spec {part!r}: blip wants a window in ms, "
                     f"got {fields[4]!r}") from None
-            if param < 0:
+            if not math.isfinite(param) or param < 0:
                 raise ValueError(
                     f"fault spec {part!r}: blip window must be >= 0 ms")
         elif action in _ACTIONS:

@@ -94,6 +94,8 @@ _PARAMS = {
     "race_report": (env_util.HVD_TPU_RACE_REPORT, "race.report_prefix"),
     "proto_depth": (env_util.HVD_TPU_PROTO_DEPTH, "proto.depth"),
     "proto_seed": (env_util.HVD_TPU_PROTO_SEED, "proto.seed"),
+    "fuzz_seed": (env_util.HVD_TPU_FUZZ_SEED, "fuzz.seed"),
+    "fuzz_iters": (env_util.HVD_TPU_FUZZ_ITERS, "fuzz.iters"),
 }
 
 # negation flags -> env var forced to "0" (reference: --no-autotune etc.)
@@ -126,9 +128,21 @@ def load_config_file(path):
     try:
         import yaml
         with open(path) as f:
-            tree = yaml.safe_load(f) or {}
+            try:
+                tree = yaml.safe_load(f) or {}
+            except yaml.YAMLError as exc:
+                # surface the same typed error a hand-rolled-parser
+                # failure would: the runner reports it and exits
+                # instead of a raw ScannerError traceback
+                raise ValueError(f"config file {path}: {exc}") from exc
     except ImportError:
         tree = _parse_simple_yaml(path)
+    if not isinstance(tree, dict):
+        # a YAML file whose top level is a list/scalar has no sections
+        # to dig into — reject it by name rather than returning nothing
+        raise ValueError(
+            f"config file {path}: top level must be a mapping, got "
+            f"{type(tree).__name__}")
 
     out = {}
     for arg, (_env, dotted) in _PARAMS.items():

@@ -384,6 +384,17 @@ def make_parser():
                             "seed + depth give a byte-identical "
                             "report.")
 
+    fuzz = parser.add_argument_group("fuzzing")
+    fuzz.add_argument("--fuzz-seed", type=int, default=None,
+                      help="bin/hvd-fuzz mutation seed "
+                           "(HVD_TPU_FUZZ_SEED, default 0): same seed "
+                           "+ iters give a byte-identical run "
+                           "summary; see docs/fuzzing.md.")
+    fuzz.add_argument("--fuzz-iters", type=int, default=None,
+                      help="bin/hvd-fuzz mutation iterations per "
+                           "target (HVD_TPU_FUZZ_ITERS, default "
+                           "300).")
+
     stall = parser.add_argument_group("stall check")
     stall.add_argument("--no-stall-check", action="store_true", default=None)
     stall.add_argument("--stall-check", action="store_true", default=None,

@@ -173,6 +173,29 @@ _SESSION_RESP_KEEP = 256
 # bound only needs the right order of magnitude)
 _CTRL_FRAME_EST = 1024
 
+# session-id sanity bound: ours are 16 hex chars (token_hex(8)); a
+# verified-but-hostile hello must not intern megabyte strings as dict
+# keys
+_MAX_SESSION_ID_LEN = 64
+# sessions retained per service: sessions outlive sockets by design, so
+# without a cap a peer re-helloing with fresh ids would grow the table
+# forever.  At the cap, admission first evicts sessions with no live
+# socket (oldest first), then refuses.
+_MAX_SESSIONS = 1024
+
+
+def _valid_seq(value):
+    """True for a trustworthy sequence/ack number: a real int (bool is
+    an int subclass but never a seq) in the non-negative range a
+    well-behaved peer can produce.  Everything in a session record —
+    seq, ack ``seen``, welcome ``rx_seen`` — arrives inside a VERIFIED
+    envelope, but verified only means the peer holds the key, not that
+    the field is sane: these values reach dict keys, comparisons and
+    replay-buffer arithmetic, so they are bounds-checked like any other
+    wire input."""
+    return (isinstance(value, int) and not isinstance(value, bool)
+            and 0 <= value < (1 << 62))
+
 
 # process-wide session telemetry (soak gates + bench read these)
 _session_stats_lock = threading.Lock()
@@ -323,7 +346,15 @@ def _session_handshake_client(sock, key, session, timeout):
         raise ConnectionError(
             "session handshake expected SessionWelcome, got "
             f"{type(frame).__name__}")
-    return frame[1]
+    welcome = frame[1]
+    # rx_seen flows into replay-buffer arithmetic; a verified welcome
+    # carrying garbage there must fail the handshake typed, not raise
+    # TypeError inside the sender's ack bookkeeping
+    if not welcome.refused and not _valid_seq(welcome.rx_seen):
+        raise ConnectionError(
+            f"session welcome carried invalid rx_seen "
+            f"({type(welcome.rx_seen).__name__})")
+    return welcome
 
 
 # ------------------------------------------------------- retry / backoff
@@ -527,12 +558,27 @@ def read_message(sock, key, expected_direction):
     payload = _read_exact(sock, length)
     if not secret.check(key, payload, digest):
         raise PermissionError("message failed HMAC verification")
-    envelope = pickle.loads(payload)
+    envelope = _loads_checked(payload)
     if not (isinstance(envelope, tuple) and len(envelope) == 2
             and envelope[0] == expected_direction):
         raise PermissionError(
             "message direction mismatch (reflected frame?)")
     return envelope[1]
+
+
+def _loads_checked(payload):
+    """Unpickle an HMAC-verified envelope, converting any decode failure
+    into the transport's typed rejection.  A signed-but-undecodable
+    frame (a peer running different code, or stream corruption that
+    survived by chance) must surface exactly like any other malformed
+    frame — a connection-scoped error the read loops already sever on —
+    never an arbitrary exception type escaping into handler threads."""
+    try:
+        return pickle.loads(payload)
+    except Exception as exc:  # noqa: BLE001 — unpickler raises freely
+        raise PermissionError(
+            f"verified frame failed to decode: "
+            f"{type(exc).__name__}") from exc
 
 
 def _read_bulk(sock, key, expected_direction, hdr_len, digest):
@@ -554,7 +600,7 @@ def _read_bulk(sock, key, expected_direction, hdr_len, digest):
     lengths = struct.pack(">II", hdr_len, payload_len)
     if not secret.check_parts(key, digest, lengths, hdr, payload):
         raise PermissionError("bulk message failed HMAC verification")
-    envelope = pickle.loads(hdr)
+    envelope = _loads_checked(hdr)
     if not (isinstance(envelope, tuple) and len(envelope) == 2
             and envelope[0] == expected_direction):
         raise PermissionError(
@@ -563,7 +609,16 @@ def _read_bulk(sock, key, expected_direction, hdr_len, digest):
     # payload injection: the carrier (the mux (req_id, obj) pair's
     # second element, or the bare object) declared a ``payload`` slot
     carrier = obj[1] if isinstance(obj, tuple) and len(obj) == 2 else obj
-    carrier.payload = payload
+    try:
+        carrier.payload = payload
+    except (AttributeError, TypeError) as exc:
+        # a verified header whose carrier can't accept the payload
+        # (wrong type, slots without a payload slot) is still a
+        # malformed frame — typed rejection, not an AttributeError
+        # escaping into the reader loop
+        raise PermissionError(
+            f"bulk frame carrier {type(carrier).__name__} cannot "
+            f"accept a payload") from exc
     return obj
 
 
@@ -924,7 +979,13 @@ class MuxService(BasicService):
         one, tell the client how far delivery got (it retransmits the
         rest), redeliver retained responses the dying socket may have
         swallowed, then serve frames until the connection breaks."""
-        if hello.epoch != self.session_epoch():
+        # the hello is HMAC-verified, but its FIELDS are still wire
+        # input: the id becomes a dict key (unhashable -> handler
+        # crash; unbounded -> memory held per session), so reject
+        # anything but a short string before touching the table
+        if not (isinstance(hello.session_id, str)
+                and 0 < len(hello.session_id) <= _MAX_SESSION_ID_LEN) \
+                or hello.epoch != self.session_epoch():
             try:
                 with write_lock:
                     write_message(sock, self._key,
@@ -937,10 +998,27 @@ class MuxService(BasicService):
             state = self._sessions.get(hello.session_id)
             resumed = state is not None
             if not resumed:
-                state = _SessionState(hello.session_id, hello.epoch)
-                self._sessions[hello.session_id] = state
+                if len(self._sessions) >= _MAX_SESSIONS:
+                    self._evict_dead_session_locked()
+                if len(self._sessions) >= _MAX_SESSIONS:
+                    # table full of LIVE sessions: refuse rather than
+                    # grow without bound (a keyed-but-misbehaving peer
+                    # minting a fresh id per connect lands here)
+                    state = None
+                else:
+                    state = _SessionState(hello.session_id, hello.epoch)
+                    self._sessions[hello.session_id] = state
             else:
                 self.sessions_resumed += 1
+        if state is None:
+            try:
+                with write_lock:
+                    write_message(sock, self._key,
+                                  (None, SessionWelcome(0, refused=True)),
+                                  "r")
+            except OSError:
+                pass
+            return
         with state.lock:
             old_sock = state.sock
             state.sock = sock
@@ -965,6 +1043,20 @@ class MuxService(BasicService):
             return  # this socket died too; the client will be back
         self._session_loop(sock, write_lock, state, client_address)
 
+    def _evict_dead_session_locked(self):  # holds: self._sessions_lock
+        """Drop one session with no live socket (insertion order, so
+        oldest first).  Returns True when something was evicted."""
+        for sid, st in list(self._sessions.items()):
+            with st.lock:
+                # the server closes each handler's socket when its
+                # handle() returns, so a session whose connection died
+                # (and hasn't resumed) holds a closed socket
+                dead = st.sock is None or st.sock.fileno() == -1
+            if dead:
+                del self._sessions[sid]
+                return True
+        return False
+
     def _session_loop(self, sock, write_lock, state, client_address):
         """Frame pump for one live session connection: deliver exactly
         the next-in-sequence frames, drop duplicates a replay sent
@@ -980,7 +1072,7 @@ class MuxService(BasicService):
                 return
             rid, req = frame
             if not (isinstance(rid, tuple) and len(rid) in (2, 3)
-                    and rid[0] == "sq" and isinstance(rid[1], int)):
+                    and rid[0] == "sq" and _valid_seq(rid[1])):
                 return  # not session-framed: protocol violation, sever
             seq = rid[1]
             need_ack = False
@@ -1348,7 +1440,10 @@ class MuxClient:
                 return
             if req_id is None:
                 # piggybacked session ack: prune the replay buffer
-                if isinstance(resp, SessionAck) \
+                # (the seen field is wire input even inside a verified
+                # frame — a non-int would TypeError the ack arithmetic
+                # and kill this reader)
+                if isinstance(resp, SessionAck) and _valid_seq(resp.seen) \
                         and self._session is not None:  # hvd-lint: ignore[lock-discipline] — set-once reference
                     with self._send_lock:
                         self._session.ack(resp.seen)
@@ -1592,7 +1687,8 @@ class StripeClient:
             except Exception:  # noqa: BLE001 — socket gone
                 return
             if (isinstance(frame, tuple) and len(frame) == 2
-                    and isinstance(frame[1], SessionAck)):
+                    and isinstance(frame[1], SessionAck)
+                    and _valid_seq(frame[1].seen)):
                 with self._lock:
                     if self._session is not None:
                         self._session.ack(frame[1].seen)

@@ -1,0 +1,17 @@
+"""hvd-fuzz — deterministic structure-aware fuzzing of every
+untrusted-input parser (docs/fuzzing.md).
+
+The correctness-tooling ladder's fourth rung: hvd-lint checks
+invariants in code we wrote, hvd-race checks interleavings, hvd-proto
+checks the protocols — hvd-fuzz checks the BYTES WE RECEIVE.  Six
+parser targets (framed control messages, raw-bulk frames, session
+records, the fault-spec grammar, checkpoint manifests/sidecars, config
+YAML) are driven with seeded structure-aware mutations; each target
+carries an invariant oracle (typed rejection, verify-before-unpickle,
+bounded allocation, connection survives — never process death) and
+findings ride hvd-lint's baseline machinery.
+
+Determinism contract (shared with hvd-race/hvd-proto): the same
+``HVD_TPU_FUZZ_SEED`` and ``HVD_TPU_FUZZ_ITERS`` produce a
+byte-identical run summary.
+"""

@@ -1,0 +1,171 @@
+"""``bin/hvd-fuzz`` — deterministic structure-aware parser fuzzing
+(docs/fuzzing.md).
+
+Usage::
+
+    bin/hvd-fuzz                             # all six targets + corpus
+    bin/hvd-fuzz --seed 7 --iters 2000       # a deeper, pinned run
+    bin/hvd-fuzz --targets framed,bulk       # subset
+    bin/hvd-fuzz --corpus-only               # just replay the corpus
+    bin/hvd-fuzz --format json               # machine-readable
+    bin/hvd-fuzz --write-baseline            # refresh suppressions
+
+Exit codes: 0 = clean (baselined findings included), 1 = active
+findings, 2 = usage error — exact parity with ``bin/hvd-lint`` /
+``bin/hvd-race`` / ``bin/hvd-proto``.  The baseline lives at
+``.hvd-fuzz-baseline.json`` in the repo root and the tier-1 gate
+(tests/test_fuzz.py) keeps it empty: a parser bug gets FIXED and a
+distilled corpus entry, not a suppression.  Determinism: the same
+``--seed`` and ``--iters`` produce a byte-identical report across
+processes (the hvd-race/hvd-proto contract)."""
+
+import argparse
+import json
+import os
+import sys
+
+from horovod_tpu.tools.fuzz import engine
+from horovod_tpu.tools.fuzz.targets import ALL_TARGETS
+from horovod_tpu.tools.lint import findings as findings_mod
+from horovod_tpu.utils import env as env_util
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, ".hvd-fuzz-baseline.json")
+DEFAULT_CORPUS = os.path.join(REPO_ROOT, "tests", "fuzz_corpus")
+
+DEFAULT_ITERS = 300
+
+
+def run_fuzz(targets=None, seed=0, iters=DEFAULT_ITERS,
+             corpus_dir=DEFAULT_CORPUS, corpus_only=False):
+    """Programmatic entry: ``(stats_list, findings, corpus_count)`` —
+    findings are pre-baseline, sorted for byte-identical reports."""
+    names = sorted(ALL_TARGETS) if targets is None else list(targets)
+    stats_list = []
+    findings = []
+    if not corpus_only:
+        for name in names:
+            target = ALL_TARGETS[name]()
+            stats, found = engine.run_target(target, seed, iters)
+            stats_list.append(stats)
+            findings.extend(found)
+    corpus_count = 0
+    if os.path.isdir(corpus_dir):
+        corpus_count, corpus_findings = engine.replay_corpus(
+            corpus_dir, [ALL_TARGETS[name]() for name in names])
+        findings.extend(corpus_findings)
+    findings.sort(key=lambda f: (f.checker, f.path, f.detail))
+    return stats_list, findings, corpus_count
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="hvd-fuzz",
+        description="Deterministic structure-aware fuzzing of every "
+                    "untrusted-input parser (docs/fuzzing.md).")
+    parser.add_argument("--targets", default=None,
+                        help="Comma-separated target subset "
+                             f"(available: {', '.join(ALL_TARGETS)}).")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="Mutation seed (default: "
+                             "HVD_TPU_FUZZ_SEED, else 0); the same "
+                             "seed and iters give a byte-identical "
+                             "report.")
+    parser.add_argument("--iters", type=int, default=None,
+                        help="Mutation iterations per target "
+                             "(default: HVD_TPU_FUZZ_ITERS, else "
+                             f"{DEFAULT_ITERS}).")
+    parser.add_argument("--corpus", default=DEFAULT_CORPUS,
+                        help="Distilled regression corpus to replay "
+                             "(default: tests/fuzz_corpus).")
+    parser.add_argument("--corpus-only", action="store_true",
+                        help="Skip mutation runs; only replay the "
+                             "corpus (the fast tier-1 regression "
+                             "check).")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="Baseline JSON of suppressed finding keys "
+                             "(default: .hvd-fuzz-baseline.json in the "
+                             "repo root).")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="Report every finding, suppressing "
+                             "nothing.")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="Rewrite the baseline from the current "
+                             "findings (existing justifications are "
+                             "kept; new entries get a TODO the gate "
+                             "test rejects until justified).")
+    parser.add_argument("--format", choices=["text", "json"],
+                        default="text")
+    args = parser.parse_args(argv)
+
+    selected = None
+    if args.targets:
+        selected = [t.strip() for t in args.targets.split(",")]
+        unknown = [t for t in selected if t not in ALL_TARGETS]
+        if unknown:
+            parser.error(f"unknown target(s): {', '.join(unknown)}")
+        selected = sorted(selected)
+
+    seed = args.seed if args.seed is not None else \
+        env_util.get_int(env_util.HVD_TPU_FUZZ_SEED, 0)
+    iters = args.iters if args.iters is not None else \
+        env_util.get_int(env_util.HVD_TPU_FUZZ_ITERS, DEFAULT_ITERS)
+
+    stats_list, all_findings, corpus_count = run_fuzz(
+        targets=selected, seed=seed, iters=iters,
+        corpus_dir=args.corpus, corpus_only=args.corpus_only)
+
+    baseline = {} if args.no_baseline else \
+        findings_mod.load_baseline(args.baseline)
+    if args.write_baseline:
+        # suppressions for targets this run didn't execute carry over
+        # verbatim — a scoped rewrite must never delete other scopes'
+        # justifications
+        run_checkers = {f"fuzz-{name}" for name in
+                        (selected or sorted(ALL_TARGETS))}
+        run_checkers.add("fuzz-corpus")
+
+        def out_of_scope(key):
+            return key.partition(":")[0] not in run_checkers
+
+        previous = findings_mod.load_baseline(args.baseline)
+        findings_mod.write_baseline(args.baseline, all_findings,
+                                    previous=previous,
+                                    out_of_scope=out_of_scope)
+        written = len(findings_mod.load_baseline(args.baseline))
+        print(f"wrote {written} suppression(s) to {args.baseline}")
+        return 0
+    active, suppressed, stale = findings_mod.split_baselined(
+        all_findings, baseline)
+
+    if args.format == "json":
+        json.dump({
+            "seed": seed, "iters": iters, "stats": stats_list,
+            "corpus_replayed": corpus_count,
+            "findings": [f.as_dict() for f in active],
+            "suppressed": [f.as_dict() for f in suppressed],
+            "stale_baseline_keys": stale,
+        }, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for stats in stats_list:
+            print(f"fuzz {stats['target']}: iters={stats['iters']} "
+                  f"corpus={stats['corpus_seed']}->{stats['corpus']} "
+                  f"arcs={stats['arcs']} findings={stats['findings']}")
+        if corpus_count or not args.corpus_only:
+            print(f"corpus: {corpus_count} distilled entr"
+                  f"{'y' if corpus_count == 1 else 'ies'} replayed")
+        for finding in active:
+            print(finding.render())
+        summary = (f"hvd-fuzz: {len(active)} finding(s), "
+                   f"{len(suppressed)} baselined")
+        if stale:
+            summary += (f", {len(stale)} stale baseline key(s) — "
+                        f"run --write-baseline to prune")
+        print(summary)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

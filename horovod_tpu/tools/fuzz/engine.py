@@ -1,0 +1,376 @@
+"""Fuzzing engine: the deterministic mutate/execute/observe loop plus
+the harness pieces every target shares (in-memory sockets, the
+verify-before-unpickle probe, the branch-coverage tracer).
+
+Determinism is load-bearing (docs/fuzzing.md): per-target RNGs are
+seeded from ``crc32(target_name) ^ seed`` — never ``hash()``, which is
+salted per process — every iteration draws only from that RNG, and
+finding messages are scrubbed of addresses/paths/ports, so the same
+seed + iters give a byte-identical run summary across processes.
+"""
+
+import base64
+import json
+import os
+import random
+import re
+import sys
+import zlib
+
+from horovod_tpu.tools.lint.findings import Finding
+
+# corpus growth bound: coverage-steered additions stop here so a run's
+# memory stays flat and the summary's corpus count is meaningful
+MAX_CORPUS = 256
+
+# an execution that reads more than this off a fake socket in one
+# request has trusted a length field it should have bounds-checked
+ALLOC_CAP = 1 << 22
+
+
+# ------------------------------------------------------------ fake sockets
+class FakeSock:
+    """In-memory socket serving a fixed byte buffer to ``recv`` /
+    ``recv_into`` and capturing writes — parser targets execute
+    syscall-free, which keeps 2000-iteration runs fast and the engine
+    deterministic.  ``max_requested`` records the largest single read
+    request: a parser asking for more than :data:`ALLOC_CAP` at once
+    trusted an unchecked length field (the unbounded-allocation
+    oracle)."""
+
+    def __init__(self, data=b""):
+        self._data = memoryview(bytes(data))
+        self._pos = 0
+        self.sent = bytearray()
+        self.max_requested = 0
+        self._timeout = None
+
+    def recv(self, n):
+        self.max_requested = max(self.max_requested, n)
+        chunk = self._data[self._pos:self._pos + n]
+        self._pos += len(chunk)
+        return bytes(chunk)
+
+    def recv_into(self, view, n=0):
+        n = n or len(view)
+        self.max_requested = max(self.max_requested, n)
+        chunk = self._data[self._pos:self._pos + n]
+        view[:len(chunk)] = chunk
+        self._pos += len(chunk)
+        return len(chunk)
+
+    def sendall(self, data):
+        self.sent += bytes(data)
+
+    def sendmsg(self, buffers):
+        total = 0
+        for b in buffers:
+            b = memoryview(b).cast("B")
+            self.sent += bytes(b)
+            total += b.nbytes
+        return total
+
+    def settimeout(self, value):
+        self._timeout = value
+
+    def fileno(self):
+        return -1   # reads as "no live fd" (session eviction checks this)
+
+    def gettimeout(self):
+        return self._timeout
+
+    def close(self):
+        pass
+
+
+def capture_frame(write, *args, **kwargs):
+    """Run a frame-writing function against a capture sock and return
+    the exact bytes it would put on the wire."""
+    sock = FakeSock()
+    write(sock, *args, **kwargs)
+    return bytes(sock.sent)
+
+
+# ------------------------------------------------- verify-before-unpickle
+class PickleProbe:
+    """Context manager asserting the transport's central security
+    invariant while a parser runs: ``pickle.loads`` is reached only
+    AFTER an HMAC verification returned True.  Patches the ``pickle``
+    and ``secret`` references inside ``run/service/network.py`` (the
+    only untrusted-bytes unpickler) for the duration; single-threaded
+    targets only."""
+
+    def __init__(self):
+        from horovod_tpu.run.service import network
+        self._network = network
+        self.violation = None
+        self._verified = False
+
+    def __enter__(self):
+        net, probe = self._network, self
+        real_pickle, real_secret = net.pickle, net.secret
+
+        class _Pickle:
+            dumps = staticmethod(real_pickle.dumps)
+
+            @staticmethod
+            def loads(data):
+                if not probe._verified:
+                    probe.violation = "unpickle-before-verify"
+                return real_pickle.loads(data)
+
+        class _Secret:
+            DIGEST_LEN = real_secret.DIGEST_LEN
+            sign = staticmethod(real_secret.sign)
+            sign_parts = staticmethod(real_secret.sign_parts)
+            make_secret_key = staticmethod(real_secret.make_secret_key)
+
+            @staticmethod
+            def check(key, payload, digest):
+                ok = real_secret.check(key, payload, digest)
+                probe._verified = probe._verified or ok
+                return ok
+
+            @staticmethod
+            def check_parts(key, digest, *parts):
+                ok = real_secret.check_parts(key, digest, *parts)
+                probe._verified = probe._verified or ok
+                return ok
+
+        self._saved = (real_pickle, real_secret)
+        net.pickle, net.secret = _Pickle, _Secret
+        return self
+
+    def __exit__(self, *exc):
+        self._network.pickle, self._network.secret = self._saved
+        return False
+
+
+# ------------------------------------------------------- coverage tracing
+class ArcTracer:
+    """Line-arc coverage on a fixed file set via ``sys.settrace``:
+    records ``(code_name, prev_line, line)`` triples, the branch-ish
+    signal that steers mutation (a mutant reaching a new arc joins the
+    corpus).  Single-threaded executions only — settrace is
+    per-thread, which is exactly the scope the deterministic targets
+    need."""
+
+    def __init__(self, files):
+        self._files = {os.path.abspath(f) for f in files}
+        self.arcs = set()
+        self._prev = {}
+
+    def _local(self, frame, event, arg):
+        if event == "line":
+            key = id(frame)
+            self.arcs.add((frame.f_code.co_name,
+                           self._prev.get(key, 0), frame.f_lineno))
+            self._prev[key] = frame.f_lineno
+        elif event == "return":
+            self._prev.pop(id(frame), None)
+        return self._local
+
+    def _global(self, frame, event, arg):
+        if event == "call" and frame.f_code.co_filename in self._files:
+            return self._local
+        return None
+
+    def run(self, fn):
+        """Execute ``fn()`` under tracing; returns (result, new_arc_count)."""
+        before = len(self.arcs)
+        old = sys.gettrace()
+        sys.settrace(self._global)
+        try:
+            result = fn()
+        finally:
+            sys.settrace(old)
+            self._prev.clear()
+        return result, len(self.arcs) - before
+
+
+# ----------------------------------------------------------- sanitization
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+_PATH_RE = re.compile(r"(/[\w.\-]+)+")
+_PORT_RE = re.compile(r"port \d+|:\d{4,5}\b")
+
+
+def sanitize(text):
+    """Strip the nondeterministic parts of an exception message —
+    object addresses, tmp paths, ephemeral ports — so a finding's text
+    is byte-identical across runs and processes."""
+    text = str(text)
+    text = _ADDR_RE.sub("0x…", text)
+    text = _PATH_RE.sub("<path>", text)
+    text = _PORT_RE.sub("<port>", text)
+    return text[:200]
+
+
+# ------------------------------------------------------------ target base
+class FuzzTarget:
+    """One untrusted-input parser under test.
+
+    Subclasses define ``name``/``path``, produce the seed corpus
+    (valid, structure-correct inputs), a structure-aware ``mutate``,
+    and ``execute`` returning ``None`` for an in-contract outcome
+    (typed rejection or success) or ``(detail, message)`` for an
+    invariant violation.  ``trace_files`` scopes the coverage tracer;
+    empty disables steering (the threaded service leg must stay
+    untraced)."""
+
+    name = ""
+    path = ""            # repo-relative module findings anchor to
+    trace_files = ()
+
+    def setup(self):
+        """Build fixtures; returns the seed corpus (list of entries)."""
+        raise NotImplementedError
+
+    def teardown(self):
+        pass
+
+    def mutate(self, rng, entry):
+        raise NotImplementedError
+
+    def execute(self, entry):
+        raise NotImplementedError
+
+    # corpus entries are JSON files; bytes entries travel base64
+    def encode_entry(self, entry):
+        if isinstance(entry, bytes):
+            return {"encoding": "base64",
+                    "data": base64.b64encode(entry).decode()}
+        if isinstance(entry, str):
+            return {"encoding": "text", "data": entry}
+        return {"encoding": "json", "data": entry}
+
+    def decode_entry(self, blob):
+        if blob["encoding"] == "base64":
+            return base64.b64decode(blob["data"])
+        return blob["data"]
+
+
+def guard_execute(target, entry):
+    """Run one input through the target's parser, converting the
+    never-process-death oracle into a finding: SystemExit or any
+    BaseException escaping a parser is a violation regardless of the
+    target's own allowed-exception policy."""
+    try:
+        return target.execute(entry)
+    except SystemExit:
+        return ("process-exit", "parser raised SystemExit on fuzzed input")
+    except KeyboardInterrupt:
+        raise
+    except BaseException as exc:  # noqa: BLE001 — the oracle itself
+        return (f"engine-escape:{type(exc).__name__}",
+                f"exception escaped the target harness: "
+                f"{type(exc).__name__}: {sanitize(exc)}")
+
+
+# -------------------------------------------------------------- run loop
+def target_rng(name, seed):
+    return random.Random(zlib.crc32(name.encode()) ^ (seed & 0xFFFFFFFF))
+
+
+def run_target(target, seed, iters):
+    """The deterministic fuzz loop for one target: execute the seed
+    corpus, then ``iters`` mutants of rng-chosen corpus entries; a
+    mutant that reaches a new coverage arc joins the corpus (bounded at
+    :data:`MAX_CORPUS`).  Returns ``(stats, findings)`` — findings are
+    deduplicated by detail so the summary doesn't scale with how often
+    one bug fires."""
+    corpus = list(target.setup())
+    try:
+        tracer = ArcTracer(target.trace_files) if target.trace_files \
+            else None
+        rng = target_rng(target.name, seed)
+        seen = {}
+        corpus0 = len(corpus)
+
+        def observe(entry):
+            if tracer is not None:
+                violation, new_arcs = tracer.run(
+                    lambda: guard_execute(target, entry))
+            else:
+                violation, new_arcs = guard_execute(target, entry), 0
+            if violation is not None:
+                detail, message = violation
+                if detail not in seen:
+                    seen[detail] = Finding(
+                        checker=f"fuzz-{target.name}", path=target.path,
+                        line=0, context="<fuzz>", detail=detail,
+                        message=message)
+            return new_arcs
+
+        for entry in list(corpus):
+            observe(entry)
+        for _ in range(max(0, iters)):
+            base = corpus[rng.randrange(len(corpus))]
+            mutant = target.mutate(rng, base)
+            if observe(mutant) and len(corpus) < MAX_CORPUS:
+                corpus.append(mutant)
+    finally:
+        target.teardown()
+    stats = {"target": target.name, "iters": max(0, iters),
+             "corpus_seed": corpus0, "corpus": len(corpus),
+             "arcs": len(tracer.arcs) if tracer is not None else 0,
+             "findings": len(seen)}
+    return stats, [seen[k] for k in sorted(seen)]
+
+
+# --------------------------------------------------------- corpus replay
+def load_corpus_entries(corpus_dir):
+    """``[(relname, target_name, entry_blob, note)]`` sorted by file
+    name — the distilled regressions under ``tests/fuzz_corpus/``."""
+    out = []
+    for root, _dirs, names in sorted(os.walk(corpus_dir)):
+        for name in sorted(names):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(root, name)
+            with open(path) as f:
+                blob = json.load(f)
+            out.append((os.path.relpath(path, corpus_dir),
+                        blob["target"], blob, blob.get("note", "")))
+    return out
+
+
+def replay_corpus(corpus_dir, targets):
+    """Re-run every distilled corpus entry through its target's oracle.
+    Returns ``(count, findings)`` — a finding here means a previously
+    fixed parser bug regressed."""
+    by_name = {t.name: t for t in targets}
+    findings = []
+    count = 0
+    entries = load_corpus_entries(corpus_dir)
+    needed = {target_name for _, target_name, _, _ in entries}
+    live = {}
+    for name in sorted(needed):
+        if name in by_name:
+            live[name] = by_name[name]
+            live[name].setup()
+    try:
+        for relname, target_name, blob, note in entries:
+            target = live.get(target_name)
+            if target is None:
+                findings.append(Finding(
+                    checker="fuzz-corpus", path=f"tests/fuzz_corpus/{relname}",
+                    line=0, context="<corpus>",
+                    detail=f"unknown-target:{target_name}",
+                    message=f"corpus entry names unknown target "
+                            f"{target_name!r}"))
+                continue
+            count += 1
+            violation = guard_execute(target,
+                                      target.decode_entry(blob))
+            if violation is not None:
+                detail, message = violation
+                findings.append(Finding(
+                    checker=f"fuzz-{target_name}", path=target.path,
+                    line=0, context="<corpus>",
+                    detail=f"{relname}:{detail}",
+                    message=f"corpus regression ({note or relname}): "
+                            f"{message}"))
+    finally:
+        for target in live.values():
+            target.teardown()
+    return count, findings

@@ -1,0 +1,24 @@
+"""The six untrusted-input parser targets (docs/fuzzing.md).
+
+Each module exposes a :class:`~horovod_tpu.tools.fuzz.engine.FuzzTarget`
+subclass named ``Target``; ``ALL_TARGETS`` maps target name to class in
+a fixed order (the report iterates it sorted, so the registry order is
+cosmetic)."""
+
+from horovod_tpu.tools.fuzz.targets import (
+    bulk,
+    checkpoint,
+    config_yaml,
+    faultspec,
+    framed,
+    session,
+)
+
+ALL_TARGETS = {
+    framed.Target.name: framed.Target,
+    bulk.Target.name: bulk.Target,
+    session.Target.name: session.Target,
+    faultspec.Target.name: faultspec.Target,
+    checkpoint.Target.name: checkpoint.Target,
+    config_yaml.Target.name: config_yaml.Target,
+}

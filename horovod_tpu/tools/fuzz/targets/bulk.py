@@ -1,0 +1,109 @@
+"""Fuzz target 2: raw-bulk frames (``_read_bulk`` via ``read_message``)
+— the header/payload length-word binding, truncation, scatter-gather
+boundaries, and the payload-injection step.
+
+Beyond byte-level chaos (which the HMAC converts into the typed
+verification failure), the structure-aware mutations re-SIGN hostile
+frames with the fuzz key — a keyed-but-buggy peer — so the
+behind-the-verification-gate paths run: carriers that can't accept a
+payload, shifted length-word bindings, header/payload boundary
+moves."""
+
+import pickle
+import struct
+
+from horovod_tpu.run.service import network, secret
+from horovod_tpu.tools.fuzz import engine
+from horovod_tpu.tools.fuzz.targets import framed
+
+
+class Hdr:
+    """The bulk header carrier shape the data plane uses: a ``payload``
+    slot the receiver injects into."""
+
+    def __init__(self, tag="seg", rank=0):
+        self.tag = tag
+        self.rank = rank
+        self.payload = None
+
+
+class FrozenHdr:
+    """A carrier that REFUSES payload injection (slots, no ``payload``)
+    — the malformed-carrier shape the typed-rejection fix pins."""
+
+    __slots__ = ("tag",)
+
+    def __init__(self, tag="seg"):
+        self.tag = tag
+
+
+def build_bulk(obj, payload, direction="q", key=framed.FUZZ_KEY):
+    return engine.capture_frame(network.write_bulk_message, key, obj,
+                                payload, direction)
+
+
+def resign_bulk(hdr_obj, payload, direction="q", key=framed.FUZZ_KEY,
+                hdr_len=None, payload_len=None):
+    """Assemble a bulk frame BY HAND with a valid HMAC over possibly
+    hostile pieces: arbitrary pickled header object, and length words
+    that may disagree with the actual byte layout (the signature binds
+    whatever words we claim — the parser must still reject the
+    mismatch via truncation/verification, never misparse)."""
+    hdr = pickle.dumps((direction, hdr_obj))
+    payload = bytes(payload)
+    h_len = len(hdr) if hdr_len is None else hdr_len
+    p_len = len(payload) if payload_len is None else payload_len
+    lengths = struct.pack(">II", h_len, p_len)
+    digest = secret.sign_parts(key, lengths, hdr, payload)
+    return (struct.pack(">I", network.RAW_FRAME_FLAG | h_len) + digest +
+            struct.pack(">I", p_len) + hdr + payload)
+
+
+class Target(engine.FuzzTarget):
+    name = "bulk"
+    path = "horovod_tpu/run/service/network.py"
+
+    def setup(self):
+        self.trace_files = (network.__file__,)
+        seeds = []
+        for obj, payload in (
+                ((None, Hdr()), b""),
+                ((None, Hdr()), b"x" * 100),
+                ((("sq", 2), Hdr("chunk", 3)), b"\x00" * 1024),
+                (Hdr("bare"), b"abc"),
+                ((7, Hdr("resp", 1)), bytes(range(256)))):
+            seeds.append(build_bulk(obj, payload))
+        return seeds
+
+    def mutate(self, rng, entry):
+        kind = rng.randrange(12)
+        if kind == 0:
+            # non-injectable carrier, correctly signed
+            bad = rng.choice([7, "seg", (), None, FrozenHdr()])
+            shape = rng.choice([lambda c: (None, c), lambda c: c,
+                                lambda c: (("sq", 2), c)])
+            return resign_bulk(shape(bad), b"payload")
+        if kind == 1:
+            # length words that lie about the layout, signed as claimed
+            payload = b"y" * rng.randrange(64)
+            delta = rng.choice([-8, -1, 1, 8, 1024])
+            if rng.randrange(2):
+                return resign_bulk((None, Hdr()), payload,
+                                   payload_len=max(0, len(payload)
+                                                   + delta))
+            return resign_bulk((None, Hdr()), payload,
+                               hdr_len=max(0, 40 + delta))
+        if kind == 2:
+            # valid HMAC over a non-pickle header
+            garbage = bytes(rng.randrange(256)
+                            for _ in range(rng.randrange(1, 32)))
+            lengths = struct.pack(">II", len(garbage), 4)
+            digest = secret.sign_parts(framed.FUZZ_KEY, lengths,
+                                       garbage, b"pppp")
+            return (struct.pack(">I",
+                                network.RAW_FRAME_FLAG | len(garbage))
+                    + digest + struct.pack(">I", 4) + garbage + b"pppp")
+        return framed.clamp_lengths(framed.mutate_bytes(rng, entry))
+
+    def execute(self, entry):
+        return framed.wire_execute(entry)

@@ -1,0 +1,189 @@
+"""Fuzz target 5: checkpoint manifest + shard sidecar parsing
+(``checkpoint/store.py`` + the ``restore_latest`` fallback walk).
+
+Setup writes one COMPLETE two-rank checkpoint into a scratch
+directory; each entry overwrites (or deletes) exactly one of its four
+files and runs the full read stack.  Oracle: ``read_shard`` returns a
+dict or raises ``CorruptShardError``; ``read_manifest`` returns a dict
+or raises ``ValueError``/``OSError``; ``restore_latest`` NEVER raises —
+a torn file means "fall back", not a crash — and with any file DELETED
+it must return None (an incomplete world is never loaded)."""
+
+import base64
+import json
+import logging
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+from horovod_tpu.checkpoint import manager, store
+from horovod_tpu.tools.fuzz import engine
+
+STEP, EPOCH, WORLD = 5, 0, 2
+
+# wrong-typed JSON values torn writes can leave behind
+JSON_POOL = (None, True, False, 0, -1, 1 << 70, 3.5, "x", "",
+             [], [1, 2], {}, {"a": None})
+
+
+class _StubState:
+    """The slice of ``elastic.State`` the resume path touches."""
+
+    params = None
+    optimizer_state = None
+
+    def __init__(self):
+        self._committed = None
+        self._opt_full = False
+
+    def restore(self):
+        pass
+
+
+class Target(engine.FuzzTarget):
+    name = "checkpoint"
+    path = "horovod_tpu/checkpoint/store.py"
+
+    FILES = ("shard", "meta", "manifest", "shard1")
+
+    def setup(self):
+        self.trace_files = (store.__file__, manager.__file__)
+        self.dir = tempfile.mkdtemp(prefix="hvd-fuzz-ckpt-")
+        payload = {"params": np.zeros((0,), np.float32)}
+        for rank in range(WORLD):
+            store.write_shard(self.dir, STEP, EPOCH, WORLD, rank,
+                              payload)
+        store.write_manifest(self.dir, STEP, EPOCH, WORLD,
+                             extra={"n_params": 0, "opt_kind": "none",
+                                    "opt_num_leaves": 0, "root_wid": 0})
+        self.mgr = manager.CheckpointManager(self.dir, keep=0)
+        # the fallback walk warns per corrupt manifest — thousands of
+        # iterations of expected-corruption log lines help nobody
+        quiet = logging.getLogger("horovod_tpu.fuzz.quiet")
+        quiet.disabled = True
+        self.mgr._log = quiet
+        shard0 = store.shard_name(STEP, EPOCH, WORLD, 0)
+        self.paths = {
+            "shard": os.path.join(self.dir, shard0),
+            "meta": os.path.join(self.dir, f"{shard0}.meta.json"),
+            "manifest": os.path.join(
+                self.dir, store.manifest_name(STEP, EPOCH, WORLD)),
+            "shard1": os.path.join(
+                self.dir, store.shard_name(STEP, EPOCH, WORLD, 1)),
+        }
+        self.originals = {}
+        for kind, path in self.paths.items():
+            with open(path, "rb") as f:
+                self.originals[kind] = f.read()
+        seeds = [{"file": kind,
+                  "data": base64.b64encode(
+                      self.originals[kind]).decode()}
+                 for kind in self.FILES]
+        seeds += [{"file": kind, "data": None} for kind in self.FILES]
+        return seeds
+
+    def teardown(self):
+        if getattr(self, "mgr", None) is not None:
+            self.mgr.close()
+            self.mgr = None
+        if getattr(self, "dir", None):
+            shutil.rmtree(self.dir, ignore_errors=True)
+            self.dir = None
+
+    # ------------------------------------------------------------ mutate
+    def mutate(self, rng, entry):
+        kind = entry["file"]
+        original = self.originals[kind]
+        if entry["data"] is None or rng.randrange(8) == 0:
+            # deletions never mutate further; occasionally re-derive one
+            return {"file": rng.choice(self.FILES), "data": None}
+        data = base64.b64decode(entry["data"])
+        if kind in ("meta", "manifest") and rng.randrange(2):
+            # torn-but-valid-JSON: keep the body parseable, break shape
+            try:
+                body = json.loads(original.decode())
+            except ValueError:
+                body = {}
+            roll = rng.randrange(4)
+            if roll == 0 and body:
+                body.pop(rng.choice(sorted(body)), None)
+            elif roll == 1 and body:
+                body[rng.choice(sorted(body))] = rng.choice(JSON_POOL)
+            elif roll == 2:
+                body = rng.choice(JSON_POOL)
+            else:
+                body[f"extra{rng.randrange(4)}"] = rng.choice(JSON_POOL)
+            data = json.dumps(body).encode()
+        else:
+            buf = bytearray(data)
+            roll = rng.randrange(4)
+            if not buf:
+                roll = 3
+            if roll == 0:
+                buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+            elif roll == 1:
+                buf = buf[:rng.randrange(len(buf))]   # torn write
+            elif roll == 2:
+                buf[rng.randrange(len(buf))] = rng.randrange(256)
+            else:
+                buf += bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(1, 9)))
+            data = bytes(buf)
+        return {"file": kind, "data": base64.b64encode(data).decode()}
+
+    # ----------------------------------------------------------- execute
+    def execute(self, entry):
+        kind = entry["file"]
+        path = self.paths[kind]
+        try:
+            if entry["data"] is None:
+                os.remove(path)
+            else:
+                with open(path, "wb") as f:
+                    f.write(base64.b64decode(entry["data"]))
+            return self._oracle(deleted=entry["data"] is None)
+        finally:
+            with open(path, "wb") as f:
+                f.write(self.originals[kind])
+
+    def _oracle(self, deleted):
+        for rank in range(WORLD):
+            try:
+                result = store.read_shard(self.dir, STEP, EPOCH, WORLD,
+                                          rank)
+                if not isinstance(result, dict):
+                    return ("shard-shape",
+                            f"read_shard returned "
+                            f"{type(result).__name__}, expected dict")
+            except store.CorruptShardError:
+                pass
+            except Exception as exc:  # noqa: BLE001 — the oracle itself
+                return (f"untyped-rejection:{type(exc).__name__}",
+                        f"read_shard escaped as {type(exc).__name__}: "
+                        f"{engine.sanitize(exc)}")
+        try:
+            body = store.read_manifest(self.dir, STEP, EPOCH, WORLD)
+            if not isinstance(body, dict):
+                return ("manifest-shape",
+                        f"read_manifest returned "
+                        f"{type(body).__name__}, expected dict")
+        except (ValueError, OSError):
+            pass
+        except Exception as exc:  # noqa: BLE001 — the oracle itself
+            return (f"untyped-rejection:{type(exc).__name__}",
+                    f"read_manifest escaped as {type(exc).__name__}: "
+                    f"{engine.sanitize(exc)}")
+        state = _StubState()
+        try:
+            resumed = self.mgr.restore_latest(state)
+        except Exception as exc:  # noqa: BLE001 — the oracle itself
+            return (f"untyped-rejection:{type(exc).__name__}",
+                    f"restore_latest escaped on a corrupt checkpoint "
+                    f"as {type(exc).__name__}: {engine.sanitize(exc)}")
+        if deleted and resumed is not None:
+            return ("partial-world-load",
+                    f"restore_latest loaded step {resumed[0]} with a "
+                    f"checkpoint file missing")
+        return None

@@ -1,0 +1,92 @@
+"""Fuzz target 6: the launcher config file (``run/config_parser.py``).
+
+Both parsers are on the hook — ``load_config_file`` (PyYAML when
+present) and the ``_parse_simple_yaml`` fallback subset parser — and
+both promise the same contract: a flat/nested dict back, or a
+``ValueError`` naming the file.  A config typo must fail the launcher
+with a message, never a raw ScannerError/AttributeError traceback."""
+
+import os
+import shutil
+import tempfile
+
+from horovod_tpu.run import config_parser
+from horovod_tpu.tools.fuzz import engine
+
+LINES = (
+    "fuzz:", "proto:", "race:", "elastic:", "checkpoint:", "network:",
+    "  seed: 7", "  iters: 300", "  budget: 1.5", "  dir: /tmp/x",
+    "  name: \"quoted # hash\"", "  name: 'sq # uoted'",
+    "  flag: true", "  flag: off", "  deep:", "    deeper: 1",
+    "key: value", "just-a-scalar", "- item", "- item2", "42",
+    "key: [1, 2, 3]", "key: {a: 1}", "key: !!python/none",
+    "\tkey: tab-indent", "  key # comment", "a: b: c", ":", "::",
+    "  empty:", "key: nbsp", "---", "...", "key: &anchor val",
+    "other: *anchor", "other: *missing",
+)
+
+
+class Target(engine.FuzzTarget):
+    name = "config-yaml"
+    path = "horovod_tpu/run/config_parser.py"
+
+    def setup(self):
+        self.trace_files = (config_parser.__file__,)
+        self.dir = tempfile.mkdtemp(prefix="hvd-fuzz-cfg-")
+        self.path = os.path.join(self.dir, "config.yaml")
+        return [
+            "fuzz:\n  seed: 7\n  iters: 300\nproto:\n  depth: 3\n",
+            "network:\n  reconnect_budget: 2.5\n"
+            "checkpoint:\n  dir: '/tmp/ck # pt'\n",
+            "",
+            "just-a-scalar\n",
+            "- a\n- b\n",
+        ]
+
+    def teardown(self):
+        if getattr(self, "dir", None):
+            shutil.rmtree(self.dir, ignore_errors=True)
+            self.dir = None
+
+    def mutate(self, rng, entry):
+        lines = entry.split("\n")
+        kind = rng.randrange(5)
+        if kind == 0:
+            lines.insert(rng.randrange(len(lines) + 1),
+                         rng.choice(LINES))
+        elif kind == 1 and lines:
+            del lines[rng.randrange(len(lines))]
+        elif kind == 2:
+            # character noise (kept to encodable codepoints)
+            text = "\n".join(lines) or "x"
+            pos = rng.randrange(len(text))
+            ch = chr(rng.choice([0, 9, 10, 13, 32, 34, 35, 39, 45, 58,
+                                 91, 92, 123, 0x130, 0x2028, 0xFF]))
+            return text[:pos] + ch + text[pos + 1:]
+        elif kind == 3 and lines:
+            # indentation surgery on one line
+            i = rng.randrange(len(lines))
+            lines[i] = " " * rng.randrange(7) + lines[i].lstrip()
+        else:
+            text = "\n".join(lines)
+            return text[:rng.randrange(len(text) + 1)]
+        return "\n".join(lines)
+
+    def execute(self, entry):
+        with open(self.path, "w", encoding="utf-8") as f:
+            f.write(entry)
+        for parse in (config_parser.load_config_file,
+                      config_parser._parse_simple_yaml):
+            try:
+                result = parse(self.path)
+            except ValueError:
+                continue   # the typed rejection the launcher reports
+            except Exception as exc:  # noqa: BLE001 — the oracle itself
+                return (f"untyped-rejection:{type(exc).__name__}",
+                        f"{parse.__name__} escaped as "
+                        f"{type(exc).__name__}: {engine.sanitize(exc)}")
+            if not isinstance(result, dict):
+                return ("config-shape",
+                        f"{parse.__name__} returned "
+                        f"{type(result).__name__}, expected dict")
+        return None

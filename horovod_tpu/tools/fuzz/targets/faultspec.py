@@ -1,0 +1,101 @@
+"""Fuzz target 4: the ``HVD_TPU_FAULT_SPEC`` grammar
+(``common/faults.py``).
+
+Oracle: ``parse_fault_spec`` either returns a spec list or raises
+``ValueError`` naming the offending fragment — never any other
+exception (a typo'd chaos spec must fail the job at init with a
+readable message).  Accepted specs must additionally round-trip:
+``repr(spec)`` is itself a valid spec parsing to the same repr, so what
+hvd-chaos logs can be pasted back into the env var."""
+
+from horovod_tpu.common import faults
+from horovod_tpu.tools.fuzz import engine
+
+TARGETS = ("*", "rank0", "rank1", "rank12", "rank-1", "rank", "rankx",
+           "Rank1", "node1", "")
+POINTS = ("allreduce", "broadcast", "allgather", "alltoall", "adasum",
+          "ring", "send", "recv", "connect", "link", "", "LINK", "x" * 40)
+STEPS = ("1", "2", "3", "100", "*", "0", "-1", "1.5", "x", "")
+ACTIONS = ("crash", "drop", "refuse", "preempt", "delay", "jitter",
+           "throttle", "flaky", "partition", "reset", "blip", "", "boom")
+PARAMS = ("0", "1", "0.5", "200", "1e9", "-1", "nan", "inf", "-inf",
+          "1e400", "0-3", "3-0", "0-", "-", "a-b", "x", "")
+DURATIONS = ("1", "30", "0", "-1", "nan", "inf", "x", "")
+
+
+class Target(engine.FuzzTarget):
+    name = "faultspec"
+    path = "horovod_tpu/common/faults.py"
+
+    def setup(self):
+        self.trace_files = (faults.__file__,)
+        return [
+            "rank1:allreduce:2:crash",
+            "*:connect:1:refuse",
+            "rank1:link:1:delay:200:30,*:allreduce:3:flaky:0.2",
+            "rank2:link:*:reset:0.3,rank1:link:5:blip:3000",
+            "rank0:ring:4:preempt",
+            "*:link:2:partition:0-3:10",
+            "",
+        ]
+
+    def mutate(self, rng, entry):
+        kind = rng.randrange(6)
+        if kind == 0:
+            # fresh spec from the token pools (grammar-shaped chaos)
+            fields = [rng.choice(TARGETS), rng.choice(POINTS),
+                      rng.choice(STEPS), rng.choice(ACTIONS)]
+            for pool in (PARAMS, DURATIONS):
+                if rng.randrange(2):
+                    fields.append(rng.choice(pool))
+            return ":".join(fields)
+        if kind == 1:
+            # splice token into an existing spec
+            fields = entry.split(":")
+            if fields:
+                pool = (TARGETS, POINTS, STEPS, ACTIONS, PARAMS,
+                        DURATIONS)[min(rng.randrange(len(fields)), 5)]
+                fields[rng.randrange(len(fields))] = rng.choice(pool)
+            return ":".join(fields)
+        if kind == 2:
+            # comma-list surgery: join, duplicate, empty segments
+            parts = entry.split(",") if entry else []
+            parts.append(rng.choice([
+                "", " ", "rank1:link:1:delay:5",
+                ":::", "a:b:c:d:e:f:g", ","]))
+            rng.shuffle(parts)
+            return ",".join(parts)
+        if kind == 3:
+            # character-level noise
+            chars = list(entry or "x")
+            pos = rng.randrange(len(chars))
+            chars[pos] = chr(rng.choice([0, 9, 10, 32, 37, 42, 44, 45,
+                                         46, 58, 92, 120, 0x130, 0xFF]))
+            return "".join(chars)
+        if kind == 4:
+            return entry + ":" + rng.choice(PARAMS)
+        return entry[:rng.randrange(len(entry) + 1)]
+
+    def execute(self, entry):
+        try:
+            specs = faults.parse_fault_spec(entry)
+        except ValueError:
+            return None   # the typed rejection the grammar promises
+        except Exception as exc:  # noqa: BLE001 — the oracle itself
+            return (f"untyped-rejection:{type(exc).__name__}",
+                    f"fault spec escaped as {type(exc).__name__}: "
+                    f"{engine.sanitize(exc)}")
+        # accepted specs round-trip through their logged repr
+        for spec in specs:
+            text = repr(spec)
+            try:
+                again = faults.parse_fault_spec(text)
+            except Exception as exc:  # noqa: BLE001 — the oracle itself
+                return ("repr-not-reparseable",
+                        f"accepted spec's repr {engine.sanitize(text)} "
+                        f"failed to reparse: {type(exc).__name__}")
+            if len(again) != 1 or repr(again[0]) != text:
+                return ("repr-not-idempotent",
+                        f"spec repr {engine.sanitize(text)} reparses "
+                        f"to something else")
+        return None

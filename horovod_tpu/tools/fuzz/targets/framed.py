@@ -1,0 +1,189 @@
+"""Fuzz target 1: framed control messages (``read_message`` in
+``run/service/network.py``) — the length word, the HMAC digest, the
+pickled envelope, the ``MAX_FRAME_BYTES`` bound.
+
+Oracle: every outcome is either a parsed object or one of the typed
+rejections the read loops catch (PermissionError / ConnectionError /
+EOFError / OSError); ``pickle.loads`` is never reached before a
+successful HMAC check; no single socket read trusts an unchecked
+length.  The fuzz key is FIXED (not random) so frame bytes — and with
+them the whole run — are identical across processes."""
+
+import hashlib
+import struct
+
+from horovod_tpu.run.service import network, secret
+from horovod_tpu.tools.fuzz import engine
+
+FUZZ_KEY = hashlib.sha256(b"hvd-fuzz-wire-key").digest()
+
+# typed rejections the service/client read loops already catch — the
+# in-contract ways a parser may refuse bytes
+ALLOWED = (PermissionError, ConnectionError, EOFError, OSError)
+
+# structure-aware 32-bit values for length words: small (real frames),
+# boundary, over-cap, and flag-bit patterns — deliberately NOTHING in
+# the (4 MB, 1 GB] gap, where a claimed length passes the transport cap
+# but buys a pointless transient allocation per iteration
+INTERESTING_U32 = (
+    0, 1, 2, 3, 4, 7, 8, 36, 255, 256, 65535, 65536, 1 << 20,
+    network.MAX_FRAME_BYTES + 1, (1 << 31) - 1, 1 << 31,
+    network.RAW_FRAME_FLAG | 1, network.RAW_FRAME_FLAG | 65536,
+    network.RAW_FRAME_FLAG | 65537, (1 << 32) - 1,
+)
+
+# the gap described above: mutated length fields landing here are
+# rewritten over-cap so the typed-rejection branch is what runs
+_CLAMP_LO = 1 << 22
+
+
+def mutate_bytes(rng, data):
+    """One shared byte-level mutation: bit flip, byte set, truncate,
+    extend, interesting-u32 splice, or slice duplication."""
+    buf = bytearray(data)
+    choice = rng.randrange(6)
+    if not buf:
+        choice = 3
+    if choice == 0:
+        pos = rng.randrange(len(buf))
+        buf[pos] ^= 1 << rng.randrange(8)
+    elif choice == 1:
+        buf[rng.randrange(len(buf))] = rng.randrange(256)
+    elif choice == 2:
+        buf = buf[:rng.randrange(len(buf))]
+    elif choice == 3:
+        pos = rng.randrange(len(buf) + 1)
+        extra = bytes(rng.randrange(256)
+                      for _ in range(rng.randrange(1, 9)))
+        buf = buf[:pos] + extra + buf[pos:]
+    elif choice == 4:
+        value = INTERESTING_U32[rng.randrange(len(INTERESTING_U32))]
+        pos = rng.randrange(max(1, len(buf) - 3))
+        buf[pos:pos + 4] = struct.pack(">I", value)
+    else:
+        a = rng.randrange(len(buf))
+        b = rng.randrange(a, min(len(buf), a + 16) + 1)
+        buf = buf[:a] + buf[a:b] + buf[a:]
+    return bytes(buf)
+
+
+def clamp_lengths(data):
+    """Rewrite any mutated length field in the useless-allocation gap
+    to an over-cap value (see ``_CLAMP_LO``); structure-aware, applied
+    after every byte-level mutation."""
+    buf = bytearray(data)
+    if len(buf) >= 4:
+        (word,) = struct.unpack(">I", buf[:4])
+        if word & network.RAW_FRAME_FLAG:
+            if len(buf) >= 40:
+                (plen,) = struct.unpack(">I", buf[36:40])
+                if _CLAMP_LO < plen <= network.MAX_FRAME_BYTES:
+                    buf[36:40] = struct.pack(
+                        ">I", network.MAX_FRAME_BYTES + 1)
+        elif _CLAMP_LO < word <= network.MAX_FRAME_BYTES:
+            buf[:4] = struct.pack(">I", network.MAX_FRAME_BYTES + 1)
+    return bytes(buf)
+
+
+def clamp_stream(data):
+    """:func:`clamp_lengths` generalized to a CONCATENATION of frames
+    (the session target's streams): walk frame boundaries and rewrite
+    the first gap-range length word met — the parser severs there, so
+    nothing after it is reached anyway."""
+    buf = bytearray(data)
+    off = 0
+    while off + 4 <= len(buf):
+        (word,) = struct.unpack_from(">I", buf, off)
+        if word & network.RAW_FRAME_FLAG:
+            hdr_len = word & ~network.RAW_FRAME_FLAG
+            p_off = off + 4 + secret.DIGEST_LEN
+            if p_off + 4 > len(buf):
+                break
+            (plen,) = struct.unpack_from(">I", buf, p_off)
+            if _CLAMP_LO < plen <= network.MAX_FRAME_BYTES:
+                struct.pack_into(">I", buf, p_off,
+                                 network.MAX_FRAME_BYTES + 1)
+                break
+            off = p_off + 4 + hdr_len + plen
+        else:
+            if _CLAMP_LO < word <= network.MAX_FRAME_BYTES:
+                struct.pack_into(">I", buf, off,
+                                 network.MAX_FRAME_BYTES + 1)
+                break
+            off += 4 + secret.DIGEST_LEN + word
+    return bytes(buf)
+
+
+def signed_frame(payload, key=FUZZ_KEY):
+    """A control frame whose HMAC is VALID over arbitrary payload bytes
+    — the keyed-but-hostile-peer shape byte flips can't reach (they
+    break the digest first)."""
+    return struct.pack(">I", len(payload)) + secret.sign(key, payload) \
+        + payload
+
+
+def wire_execute(data, key=FUZZ_KEY, direction="q"):
+    """Shared framed/bulk execution under the full oracle set; returns
+    a violation tuple or None."""
+    sock = engine.FakeSock(data)
+    failure = None
+    with engine.PickleProbe() as probe:
+        try:
+            network.read_message(sock, key, direction)
+        except ALLOWED:
+            pass
+        except Exception as exc:  # noqa: BLE001 — the oracle itself
+            failure = (f"untyped-rejection:{type(exc).__name__}",
+                       f"malformed frame escaped as "
+                       f"{type(exc).__name__}: {engine.sanitize(exc)}")
+    if probe.violation:
+        return (probe.violation,
+                "pickle.loads reached before a successful HMAC check")
+    if sock.max_requested > engine.ALLOC_CAP:
+        return ("unbounded-read",
+                f"parser requested a {sock.max_requested}-byte read "
+                f"from an unchecked length field")
+    return failure
+
+
+class Target(engine.FuzzTarget):
+    name = "framed"
+    path = "horovod_tpu/run/service/network.py"
+
+    def setup(self):
+        self.trace_files = (network.__file__,)
+        seeds = []
+        for obj in (network.PingRequest(),
+                    (7, network.PingRequest()),
+                    (None, network.SessionAck(3)),
+                    (("sq", 1, 99), network.PingRequest()),
+                    (None, network.SessionHello("cafe", 0, 0)),
+                    # a seed frame, not a resume admission — the fence
+                    # under test is in the parser, not this builder
+                    (None, network.SessionWelcome(5)),  # hvd-lint: ignore[wire-safety]
+                    (2, network.HeartbeatMsg(1, busy=True, rtt=0.25)),
+                    (3, network.AbortMsg(2, "fuzz"))):
+            seeds.append(engine.capture_frame(
+                network.write_message, FUZZ_KEY, obj, "q"))
+        # a response-direction frame: the direction oracle's seed
+        seeds.append(engine.capture_frame(
+            network.write_message, FUZZ_KEY, (7, network.AckResponse()),
+            "r"))
+        return seeds
+
+    def mutate(self, rng, entry):
+        kind = rng.randrange(10)
+        if kind == 0:
+            # valid HMAC over non-pickle garbage: exercises the typed
+            # decode-failure path behind the verification gate
+            return signed_frame(bytes(
+                rng.randrange(256) for _ in range(rng.randrange(64))))
+        if kind == 1:
+            # valid HMAC over a pickled non-envelope (wrong shape)
+            import pickle
+            obj = rng.choice([42, "q", (1, 2, 3), ("r",), [], None])
+            return signed_frame(pickle.dumps(obj))
+        return clamp_lengths(mutate_bytes(rng, entry))
+
+    def execute(self, entry):
+        return wire_execute(entry)

@@ -1,0 +1,271 @@
+"""Fuzz target 3: session-layer records — the :class:`SessionWelcome`
+the client handshake trusts, and the hello/seq/ack stream the service's
+session admission and frame pump parse.
+
+Two legs share one target (entries are JSON dicts tagged ``leg``):
+
+* ``client`` — a welcome frame (possibly hostile ``rx_seen``, wrong
+  object shape, or byte-mutated) fed to ``_session_handshake_client``
+  with a real ``_SessionSender``; the replay-buffer arithmetic behind a
+  successful handshake runs too.
+* ``service`` — a frame stream (hello + session frames, with mutated
+  session ids / epochs / request ids / seq patterns) fed to a real
+  :class:`MuxService` session via an in-memory socket, followed by a
+  LIVENESS PROBE: a fresh known-good session must still get its welcome
+  and its response — hostile input may sever its own connection, never
+  the service."""
+
+import base64
+import threading
+
+from horovod_tpu.run.service import network
+from horovod_tpu.tools.fuzz import engine
+from horovod_tpu.tools.fuzz.targets import framed
+
+# in-contract outcomes for both legs (the read loops catch exactly these)
+ALLOWED = framed.ALLOWED
+
+# hostile values for seq-shaped fields (rx_seen, ack seen, rid seq):
+# JSON-able so corpus entries replay byte-identically
+SEQ_POOL = (0, 1, -1, 1 << 40, 1 << 62, 1 << 70, "boom", None, True,
+            3.5, [], {"a": 1})
+
+SESSION_ID_POOL = ("", "x", "deadbeefdeadbeef", "x" * 64, "x" * 65,
+                   "x" * 4096, 17, None, 3.5, True)
+
+
+def welcome_frame(rx_seen, refused=False):
+    # a HOSTILE welcome by design — the epoch fence under test lives on
+    # the parsing side, not in this frame factory
+    return engine.capture_frame(
+        network.write_message, framed.FUZZ_KEY,
+        (None, network.SessionWelcome(  # hvd-lint: ignore[wire-safety]
+            rx_seen, refused=refused)), "r")
+
+
+def shape_frame(tag):
+    """Response frames that are NOT a proper welcome envelope."""
+    objs = {
+        "ack": (None, network.SessionAck(3)),
+        # hostile by design (see welcome_frame)
+        "bare": network.SessionWelcome(0),  # hvd-lint: ignore[wire-safety]
+        "triple": (1, 2, 3),
+        "str": "welcome",
+        "none": None,
+        "ping": (None, network.PingResponse("svc")),
+    }
+    return engine.capture_frame(network.write_message, framed.FUZZ_KEY,
+                                objs[tag], "r")
+
+
+def hello_frame(session_id, epoch, rx_seen=0):
+    hello = network.SessionHello.__new__(network.SessionHello)
+    hello.session_id = session_id
+    hello.epoch = epoch
+    hello.rx_seen = rx_seen
+    return engine.capture_frame(network.write_message, framed.FUZZ_KEY,
+                                (None, hello), "q")
+
+
+def session_frame(rid, req=None):
+    return engine.capture_frame(
+        network.write_message, framed.FUZZ_KEY,
+        (rid, req if req is not None else network.PingRequest()), "q")
+
+
+def _b64(data):
+    return base64.b64encode(data).decode()
+
+
+def _client_entry(frame):
+    return {"leg": "client", "frame": _b64(frame)}
+
+
+def _service_entry(*frames):
+    return {"leg": "service", "stream": _b64(b"".join(frames))}
+
+
+class Target(engine.FuzzTarget):
+    name = "session"
+    path = "horovod_tpu/run/service/network.py"
+
+    def setup(self):
+        self.trace_files = (network.__file__,)
+        # a MuxService WITHOUT its TCP listener: sessions are served
+        # straight off in-memory sockets, so the loop stays in-process
+        # and (on the pump thread) deterministic
+        svc = network.MuxService.__new__(network.MuxService)
+        svc._name = "fuzz"
+        svc._key = framed.FUZZ_KEY
+        svc._inflight = 0
+        svc._inflight_cv = threading.Condition()
+        svc._sessions = {}
+        svc._sessions_lock = threading.Lock()
+        svc.sessions_resumed = 0
+        svc.session_dup_drops = 0
+        self.svc = svc
+        return [
+            _client_entry(welcome_frame(0)),
+            _client_entry(welcome_frame(5)),
+            _client_entry(welcome_frame(0, refused=True)),
+            _service_entry(hello_frame("deadbeefdeadbeef", 0),
+                           session_frame(("sq", 1)),
+                           session_frame(("sq", 2, 7))),
+            _service_entry(hello_frame("cafecafecafecafe", 0),
+                           *[session_frame(("sq", i))
+                             for i in range(1, 21)]),
+        ]
+
+    def teardown(self):
+        self.svc = None
+
+    # ------------------------------------------------------------ mutate
+    def mutate(self, rng, entry):
+        if entry["leg"] == "client":
+            kind = rng.randrange(4)
+            if kind == 0:
+                return _client_entry(welcome_frame(
+                    rng.choice(SEQ_POOL),
+                    refused=rng.randrange(4) == 0))
+            if kind == 1:
+                return _client_entry(shape_frame(rng.choice(
+                    ["ack", "bare", "triple", "str", "none", "ping"])))
+            raw = base64.b64decode(entry["frame"])
+            return _client_entry(framed.clamp_lengths(
+                framed.mutate_bytes(rng, raw)))
+        kind = rng.randrange(5)
+        if kind == 0:
+            return _service_entry(
+                hello_frame(rng.choice(SESSION_ID_POOL),
+                            rng.choice([0, 1, -1, "x", None, 3.5])),
+                session_frame(("sq", 1)))
+        if kind == 1:
+            rid = rng.choice([
+                ("sq", 0), ("sq", -1), ("sq", True), ("sq", 3.5),
+                ("sq", "1"), ("sq", 1 << 70), ("sq", None),
+                ("qq", 1), ("sq",), ("sq", 1, 2, 3), "sq", 1, None,
+                ("sq", [2]), ("sq", 2, {}),
+            ])
+            return _service_entry(hello_frame("deadbeefdeadbeef", 0),
+                                  session_frame(("sq", 1)),
+                                  session_frame(rid))
+        if kind == 2:
+            # seq patterns: dups, gaps, interleavings
+            seqs = [rng.choice([1, 1, 2, 2, 3, 5, 9, 1 << 40])
+                    for _ in range(rng.randrange(1, 6))]
+            return _service_entry(
+                hello_frame(f"seed{rng.randrange(8):012d}", 0),
+                *[session_frame(("sq", s)) for s in seqs])
+        raw = base64.b64decode(entry["stream"])
+        return _service_entry(framed.clamp_stream(
+            framed.mutate_bytes(rng, raw)))
+
+    # ----------------------------------------------------------- execute
+    def execute(self, entry):
+        if entry["leg"] == "client":
+            return self._run_client(base64.b64decode(entry["frame"]))
+        violation = self._run_service(base64.b64decode(entry["stream"]))
+        if violation is not None:
+            return violation
+        return self._probe_liveness()
+
+    def _run_client(self, frame):
+        sock = engine.FakeSock(frame)
+        sender = network._SessionSender(epoch=0, replay_bytes=4096)
+        try:
+            welcome = network._session_handshake_client(
+                sock, framed.FUZZ_KEY, sender, timeout=5)
+            if not welcome.refused:
+                # the caller immediately runs replay arithmetic on the
+                # welcome's rx_seen — part of the parsing contract (the
+                # harness only cares that it doesn't throw, so the gap
+                # sentinel is deliberately not consulted here)
+                sender.append(lambda seq: (("sq", seq), None),
+                              network._CTRL_FRAME_EST)
+                sender.replayable_from(  # hvd-lint: ignore[wire-safety]
+                    welcome.rx_seen)
+        except ALLOWED:
+            pass
+        except Exception as exc:  # noqa: BLE001 — the oracle itself
+            return (f"untyped-rejection:{type(exc).__name__}",
+                    f"session welcome escaped as {type(exc).__name__}: "
+                    f"{engine.sanitize(exc)}")
+        if sock.max_requested > engine.ALLOC_CAP:
+            return ("unbounded-read",
+                    f"handshake requested a {sock.max_requested}-byte "
+                    f"read from an unchecked length field")
+        return None
+
+    def _serve_stream(self, stream):
+        """The handler-loop prologue (first frame decides session-ness)
+        + ``_session_serve``, against an in-memory socket; returns the
+        sock or an (allowed-rejection) None."""
+        sock = engine.FakeSock(stream)
+        try:
+            frame = network.read_message(sock, framed.FUZZ_KEY, "q")
+        except ALLOWED:
+            return sock
+        if not (isinstance(frame, tuple) and len(frame) == 2):
+            return sock
+        _rid, req = frame
+        if isinstance(req, network.SessionHello):
+            self.svc._session_serve(sock, threading.Lock(), req,
+                                    ("127.0.0.1", 0))
+        return sock
+
+    def _run_service(self, stream):
+        try:
+            sock = self._serve_stream(stream)
+        except ALLOWED:
+            return None
+        except Exception as exc:  # noqa: BLE001 — the oracle itself
+            return (f"untyped-rejection:{type(exc).__name__}",
+                    f"session admission escaped as "
+                    f"{type(exc).__name__}: {engine.sanitize(exc)}")
+        if sock.max_requested > engine.ALLOC_CAP:
+            return ("unbounded-read",
+                    f"session pump requested a {sock.max_requested}-"
+                    f"byte read from an unchecked length field")
+        return None
+
+    def _probe_liveness(self):
+        """A known-good session must still be served after whatever the
+        mutant did: welcome granted, response delivered (fresh session)
+        or redelivered from the retained-responses stash (resume)."""
+        stream = (hello_frame("probe-session-00", 0)
+                  + session_frame(("sq", 1, 42)))
+        try:
+            sock = self._serve_stream(stream)
+        except Exception as exc:  # noqa: BLE001 — liveness oracle
+            return ("liveness-lost",
+                    f"known-good probe session raised "
+                    f"{type(exc).__name__}: {engine.sanitize(exc)}")
+        # response frames are written by a handler thread; drain behind
+        # the service's own in-flight barrier before reading them
+        deadline_ok = True
+        with self.svc._inflight_cv:
+            deadline_ok = self.svc._inflight_cv.wait_for(
+                lambda: self.svc._inflight == 0, timeout=10)
+        if not deadline_ok:
+            return ("liveness-lost",
+                    "probe session's handler never completed")
+        welcomed = answered = False
+        reply = engine.FakeSock(bytes(sock.sent))
+        while True:
+            try:
+                frame = network.read_message(reply, framed.FUZZ_KEY, "r")
+            except ALLOWED:
+                break
+            if not (isinstance(frame, tuple) and len(frame) == 2):
+                continue
+            rid, obj = frame
+            if isinstance(obj, network.SessionWelcome) \
+                    and not obj.refused:
+                welcomed = True
+            if rid == 42 and isinstance(obj, network.PingResponse):
+                answered = True
+        if not (welcomed and answered):
+            return ("liveness-lost",
+                    f"probe session got welcome={welcomed} "
+                    f"response={answered} after hostile input")
+        return None

@@ -13,6 +13,8 @@ encodes):
 - ``thread_lifecycle_modules``: relpath suffixes whose Thread starts
   must be joined or daemon-and-registered
 - ``wire_pickle_allowlist``: modules allowed to unpickle network input
+- ``parse_modules``: relpath suffixes holding byte-parsing sites to the
+  bound-before-allocate rule (docs/fuzzing.md)
 - ``docs_dir``: where the tri-surface checker greps for knob mentions
 - ``skip_tri_surface``: disable the project-level tri-surface rule
 """
@@ -21,6 +23,7 @@ from horovod_tpu.tools.lint.checkers import (
     config_surface,
     lock_discipline,
     lock_order,
+    parse_hardening,
     thread_lifecycle,
     wakeability,
     wire_safety,
@@ -33,4 +36,5 @@ ALL_CHECKERS = {
     thread_lifecycle.NAME: thread_lifecycle,
     config_surface.NAME: config_surface,
     wire_safety.NAME: wire_safety,
+    parse_hardening.NAME: parse_hardening,
 }

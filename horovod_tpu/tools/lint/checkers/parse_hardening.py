@@ -1,0 +1,131 @@
+"""Checker 7 — parse hardening (docs/fuzzing.md).
+
+The constraint class behind hvd-fuzz's unbounded-read oracle, made
+static: a byte-parsing site that decodes a length/count out of wire
+bytes (``struct.unpack``/``unpack_from``, ``int.from_bytes``) must
+compare it against a ``MAX_*`` bound before the value reaches an
+allocation or a socket read.  Trusting a length field unchecked turns
+one hostile frame into a gigabyte ``bytearray`` (or a read that never
+completes) before the HMAC is ever looked at.
+
+Two details:
+
+- **unbounded-alloc**: a decoded length flows into ``bytearray()`` /
+  ``bytes()`` with no ``MAX_*`` comparison in the function.
+- **unchecked-length-read**: a decoded length sizes a socket read
+  (``recv``/``recv_into``/``read``/``_read_exact``/
+  ``_read_exact_into``) with no ``MAX_*`` comparison in the function.
+
+The comparison is recognized lexically anywhere in the same function
+(the transport's cap-then-allocate idiom); ``min(value, MAX_*)``
+clamping counts too.  Scope: ``parse_modules`` (None = every scanned
+module, which is what the fixture tests use)."""
+
+import ast
+
+from horovod_tpu.tools.lint import model
+from horovod_tpu.tools.lint.findings import Finding
+
+NAME = "parse-hardening"
+
+_DECODERS = {"unpack", "unpack_from", "from_bytes"}
+_ALLOC_SINKS = {"bytearray", "bytes"}
+_READ_SINKS = {"recv", "recv_into", "read", "_read_exact",
+               "_read_exact_into"}
+
+
+def _decoded_names(funcdef):
+    """{name: assignment lineno} for every variable bound (possibly via
+    tuple unpacking or a subscript of the call) to a wire decoder."""
+    out = {}
+    for node in ast.walk(funcdef):
+        if not isinstance(node, ast.Assign):
+            continue
+        decodes = any(
+            isinstance(sub, ast.Call)
+            and (model.expr_text(sub.func) or "").rsplit(".", 1)[-1]
+            in _DECODERS
+            for sub in ast.walk(node.value))
+        if not decodes:
+            continue
+        for target in node.targets:
+            for sub in ast.walk(target):
+                if isinstance(sub, ast.Name):
+                    out[sub.id] = node.lineno
+    return out
+
+
+def _is_max_bound(node):
+    return any(
+        isinstance(sub, ast.Name) and sub.id.startswith("MAX_")
+        or isinstance(sub, ast.Attribute) and sub.attr.startswith("MAX_")
+        for sub in ast.walk(node))
+
+
+def _bounded_names(funcdef, tracked):
+    """Tracked names that some Compare (or ``min()`` clamp) holds
+    against a MAX_* bound anywhere in the function."""
+    bounded = set()
+    for node in ast.walk(funcdef):
+        if isinstance(node, ast.Compare):
+            operands = [node.left] + list(node.comparators)
+            if any(_is_max_bound(op) for op in operands):
+                for op in operands:
+                    for sub in ast.walk(op):
+                        if isinstance(sub, ast.Name) \
+                                and sub.id in tracked:
+                            bounded.add(sub.id)
+        elif isinstance(node, ast.Call):
+            text = (model.expr_text(node.func) or "").rsplit(".", 1)[-1]
+            if text == "min" and any(_is_max_bound(a)
+                                     for a in node.args):
+                for arg in node.args:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name) \
+                                and sub.id in tracked:
+                            bounded.add(sub.id)
+    return bounded
+
+
+def check(project, config):
+    findings = []
+    scope = config.get("parse_modules")
+    for module in project.modules.values():
+        if not model.in_scope(module, scope):
+            continue
+        for ctx, _cls, funcdef in model.iter_functions(module):
+            tracked = _decoded_names(funcdef)
+            if not tracked:
+                continue
+            bounded = _bounded_names(funcdef, tracked)
+            unbounded = set(tracked) - bounded
+            if not unbounded:
+                continue
+            for node in ast.walk(funcdef):
+                if not isinstance(node, ast.Call):
+                    continue
+                meth = (model.expr_text(node.func) or "") \
+                    .rsplit(".", 1)[-1]
+                if meth in _ALLOC_SINKS:
+                    detail = "unbounded-alloc"
+                    what = "sizes an allocation"
+                elif meth in _READ_SINKS:
+                    detail = "unchecked-length-read"
+                    what = "sizes a socket read"
+                else:
+                    continue
+                used = sorted(
+                    sub.id for arg in node.args for sub in ast.walk(arg)
+                    if isinstance(sub, ast.Name) and sub.id in unbounded)
+                if not used:
+                    continue
+                if module.is_wire_safe_annotated(node.lineno) \
+                        or module.has_ignore(node.lineno, NAME):
+                    continue
+                findings.append(Finding(
+                    NAME, module.relpath, node.lineno, ctx, detail,
+                    f"length field {used[0]!r} decoded from wire bytes "
+                    f"{what} ({meth}) with no MAX_* bound check in the "
+                    f"function — a hostile frame buys the allocation "
+                    f"before any verification (docs/fuzzing.md)"))
+    return findings

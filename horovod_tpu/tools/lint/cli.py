@@ -48,6 +48,10 @@ PROJECT_CONFIG = {
     "wire_pickle_allowlist": [
         "run/service/network.py",
     ],
+    "parse_modules": [
+        "run/service/network.py",
+        "common/wire.py",
+    ],
     "docs_dir": os.path.join(REPO_ROOT, "docs"),
 }
 

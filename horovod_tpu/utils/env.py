@@ -107,6 +107,14 @@ HVD_TPU_PROTO_DEPTH = "HVD_TPU_PROTO_DEPTH"
 # byte-identical hvd-proto report (the hvd-race determinism contract)
 HVD_TPU_PROTO_SEED = "HVD_TPU_PROTO_SEED"
 
+# --- parser fuzzing (docs/fuzzing.md) ----------------------------------------
+# deterministic mutation seed for bin/hvd-fuzz — same seed + same
+# iteration count give a byte-identical run summary (the
+# hvd-race/hvd-proto determinism contract)
+HVD_TPU_FUZZ_SEED = "HVD_TPU_FUZZ_SEED"
+# mutation iterations per fuzz target
+HVD_TPU_FUZZ_ITERS = "HVD_TPU_FUZZ_ITERS"
+
 # --- fault-tolerant collective runtime (docs/fault_tolerance.md) -------------
 # bound on "abort initiated anywhere -> every rank raises HvdAbortedError"
 HVD_TPU_ABORT_TIMEOUT = "HVD_TPU_ABORT_TIMEOUT"

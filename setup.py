@@ -63,6 +63,8 @@ setup(
         "horovod_tpu.spark",
         "horovod_tpu.tensorflow",
         "horovod_tpu.tools",
+        "horovod_tpu.tools.fuzz",
+        "horovod_tpu.tools.fuzz.targets",
         "horovod_tpu.tools.lint",
         "horovod_tpu.tools.lint.checkers",
         "horovod_tpu.tools.proto",

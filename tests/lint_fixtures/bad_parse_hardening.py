@@ -1,0 +1,27 @@
+"""Known-bad fixture for the parse-hardening checker: length fields
+decoded from wire bytes reach allocations and socket reads with no
+MAX_* bound check anywhere in the function."""
+
+import struct
+
+MAX_FRAME_BYTES = 1 << 30
+
+
+def read_frame(sock):
+    # unbounded-alloc: `length` sizes a bytearray with no bound check
+    (length,) = struct.unpack(">I", sock.recv(4))
+    buf = bytearray(length)
+    sock.recv_into(buf)
+    return buf
+
+
+def read_header(sock):
+    # unchecked-length-read: `n` sizes a recv with no bound check
+    n = struct.unpack_from(">I", sock.recv(4), 0)[0]
+    return sock.recv(n)
+
+
+def read_count(stream):
+    # unbounded-alloc via int.from_bytes
+    count = int.from_bytes(stream.read(4), "big")
+    return bytes(count)

@@ -1,0 +1,37 @@
+"""Known-good twin for the parse-hardening checker: every decoded
+length is held against a MAX_* bound (comparison or min() clamp)
+before it sizes an allocation or a read."""
+
+import struct
+
+MAX_FRAME_BYTES = 1 << 30
+MAX_RAW_HEADER_BYTES = 1 << 16
+
+
+def read_frame(sock):
+    (length,) = struct.unpack(">I", sock.recv(4))
+    if length > MAX_FRAME_BYTES:
+        raise ConnectionError(f"frame length {length} over limit")
+    buf = bytearray(length)
+    sock.recv_into(buf)
+    return buf
+
+
+def read_header(sock):
+    n = struct.unpack_from(">I", sock.recv(4), 0)[0]
+    if n > MAX_RAW_HEADER_BYTES:
+        raise ConnectionError(f"header length {n} over limit")
+    return sock.recv(n)
+
+
+def read_count(stream):
+    # a min() clamp against the MAX_* bound counts as hardening too
+    count = int.from_bytes(stream.read(4), "big")
+    return bytes(min(count, MAX_FRAME_BYTES))
+
+
+def read_fixed(sock):
+    # constant-sized reads decode nothing untrusted — never flagged
+    header = sock.recv(4)
+    (kind,) = struct.unpack(">I", header)
+    return kind

@@ -57,6 +57,7 @@ CASES = [
     ("thread-lifecycle", "thread_lifecycle", False),
     ("config-surface", "config_surface", True),
     ("wire-safety", "wire_safety", False),
+    ("parse-hardening", "parse_hardening", False),
 ]
 
 
@@ -104,6 +105,10 @@ def test_bad_fixture_details():
     details = {f.detail for f in wire}
     assert details == {"pickle-loads", "raw-send",
                        "unfenced-resume", "unchecked-replay"}
+
+    parse = _lint_fixture("bad_parse_hardening.py", "parse-hardening")
+    details = {f.detail for f in parse}
+    assert details == {"unbounded-alloc", "unchecked-length-read"}
 
     life = _lint_fixture("bad_thread_lifecycle.py", "thread-lifecycle")
     details = {f.detail for f in life}

@@ -14,6 +14,7 @@ healing-peer registry the liveness heartbeat reports from.
 """
 
 import socket as socket_mod
+import struct
 import threading
 import time
 
@@ -454,3 +455,98 @@ def test_session_stats_snapshot_shape():
     for k in ("reconnects_healed", "reconnects_failed",
               "frames_replayed"):
         assert k in stats and stats[k] >= 0
+
+
+# ---------------------------------------- malformed-frame rejection matrix --
+def _frame_bytes(key, obj, direction="q"):
+    """The exact bytes write_message would put on the wire."""
+    class _Pipe:
+        def __init__(self):
+            self.sent = bytearray()
+
+        def sendall(self, data):
+            self.sent += data
+
+    pipe = _Pipe()
+    network.write_message(pipe, key, obj, direction)
+    return bytes(pipe.sent)
+
+
+def _truncated(frame):
+    # the last bytes of the payload never arrive
+    return frame[:len(frame) - 3]
+
+
+def _flipped_bulk_flag(frame):
+    # a control frame whose length word grew the RAW_FRAME_FLAG bit:
+    # the pump misreads it as a bulk header and must still reject typed
+    (word,) = struct.unpack(">I", frame[:4])
+    return struct.pack(">I", word | network.RAW_FRAME_FLAG) + frame[4:]
+
+
+def _corrupted_hmac(frame):
+    buf = bytearray(frame)
+    buf[4 + 7] ^= 0x40  # inside the 32-byte digest
+    return bytes(buf)
+
+
+def _oversize_raw_header(frame):
+    # a bulk frame claiming a header over MAX_RAW_HEADER_BYTES: rejected
+    # on the length word alone, before a single header byte is read
+    return struct.pack(
+        ">I", network.RAW_FRAME_FLAG | (network.MAX_RAW_HEADER_BYTES + 1)
+    ) + frame[4:]
+
+
+def _midstream_garbage(frame):
+    return bytes((i * 37 + 11) % 256 for i in range(64))
+
+
+@pytest.mark.parametrize(
+    "mutate", [_truncated, _flipped_bulk_flag, _corrupted_hmac,
+               _oversize_raw_header, _midstream_garbage],
+    ids=lambda f: f.__name__.strip("_"))
+def test_malformed_frame_rejection_matrix(echo, key, mutate):
+    """Hostile bytes on an established session sever THAT connection
+    with a typed rejection — the session state survives for the heal,
+    and the service's liveness is untouched (the fuzz gate's oracle,
+    pinned here against a live service; docs/fuzzing.md)."""
+    sid = ("mal-" + mutate.__name__.strip("_"))[:32]
+    sock, welcome = _raw_session(echo.port, key, session_id=sid)
+    assert not welcome.refused
+    try:
+        network.write_message(sock, key, (("sq", 1), ("good", sid)), "q")
+        _wait_for(lambda: ("good", sid) in echo.received(),
+                  msg="pre-poison delivery")
+        sock.sendall(mutate(_frame_bytes(key, (("sq", 2), ("lost", sid)))))
+        # half-close so a parser blocked awaiting claimed-but-absent
+        # bytes sees EOF instead of hanging the test
+        sock.shutdown(socket_mod.SHUT_WR)
+        sock.settimeout(10)
+        with pytest.raises((ConnectionError, EOFError, OSError)):
+            while True:
+                network.read_message(sock, key, "r")
+    finally:
+        sock.close()
+    # the connection died; the SESSION did not: with reconnect budget
+    # left a peer resumes, the welcome names how far delivery got, and
+    # the next frame rides the healed session
+    sock2, welcome2 = _raw_session(echo.port, key, session_id=sid)
+    try:
+        assert isinstance(welcome2, network.SessionWelcome)
+        assert not welcome2.refused
+        assert welcome2.rx_seen == 1
+        network.write_message(sock2, key, (("sq", 2), ("next", sid)), "q")
+        _wait_for(lambda: ("next", sid) in echo.received(),
+                  msg="post-heal delivery")
+    finally:
+        sock2.close()
+    # liveness unaffected: a brand-new session on the same listener
+    sock3, welcome3 = _raw_session(echo.port, key,
+                                   session_id=("f-" + sid)[:32])
+    sock3.close()
+    assert not welcome3.refused
+    # exactly-once ledger: the poisoned frame never half-delivered
+    got = [r for r in echo.received()
+           if isinstance(r, tuple) and len(r) == 2 and r[1] == sid]
+    assert got == [("good", sid), ("next", sid)]
